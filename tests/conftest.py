@@ -1,0 +1,29 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip hardware is unavailable locally, so sharding tests run against
+``--xla_force_host_platform_device_count=8`` on CPU, exactly as the driver's
+multi-chip dry-run does.  This must happen before the first ``import jax``
+resolves a backend, hence it lives at conftest import time.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The machine image's sitecustomize registers the "axon" TPU plugin and sets
+# jax_platforms="axon,cpu" at interpreter start — BEFORE this conftest runs —
+# so the env var alone is not enough: the first array op would try to create
+# the axon TPU client, which blocks whenever another process holds the single
+# TPU tunnel.  Overriding at the config level keeps the whole test run on the
+# virtual 8-device CPU mesh and off the TPU.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

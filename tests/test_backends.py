@@ -1,0 +1,92 @@
+"""Backend registry + CPU/TPU parity: same loss, independent optimizers
+(scipy L-BFGS-B per series vs the batched JAX solver) must land on forecasts
+with near-identical accuracy — the driver's sMAPE-parity criterion
+(BASELINE.json:2)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsspark_tpu import (
+    ProphetConfig,
+    SeasonalityConfig,
+    SolverConfig,
+    get_backend,
+    list_backends,
+    register_backend,
+)
+from tsspark_tpu.backends.registry import ForecastBackend
+from tsspark_tpu.data import datasets
+from tsspark_tpu.eval import metrics
+
+
+def test_registry_lists_builtins():
+    assert {"cpu", "tpu"} <= set(list_backends())
+
+
+def test_registry_unknown_backend():
+    with pytest.raises(KeyError):
+        get_backend("cuda")
+
+
+def test_register_custom_backend():
+    @register_backend
+    class EchoBackend(ForecastBackend):
+        name = "echo-test"
+
+        def fit(self, ds, y, **kw):
+            return "fitted"
+
+        def predict(self, state, ds, **kw):
+            return {}
+
+    assert get_backend("echo-test").fit(None, None) == "fitted"
+
+
+@pytest.fixture(scope="module")
+def small_batch():
+    batch = datasets.peyton_manning_like(n_days=500, seed=7)
+    # Three series with different scales/offsets derived from one generator.
+    y0 = batch.y[0]
+    y = np.stack([y0, 3.0 * y0 + 5.0, 0.5 * y0 - 2.0])
+    return batch.ds, y
+
+
+def test_cpu_tpu_smape_parity(small_batch):
+    ds, y = small_batch
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 3),),
+        n_changepoints=8,
+    )
+    solver = SolverConfig(max_iters=300)
+    y_j = jnp.asarray(y)
+
+    st_cpu = get_backend("cpu", cfg, solver).fit(ds, y_j)
+    st_tpu = get_backend("tpu", cfg, solver).fit(ds, y_j)
+    fc_cpu = get_backend("cpu", cfg, solver).predict(st_cpu, ds, num_samples=0)
+    fc_tpu = get_backend("tpu", cfg, solver).predict(st_tpu, ds, num_samples=0)
+
+    mask = jnp.asarray(np.isfinite(y).astype(np.float32))
+    y_clean = jnp.asarray(np.nan_to_num(y))
+    s_cpu = np.asarray(metrics.smape(y_clean, fc_cpu["yhat"], mask))
+    s_tpu = np.asarray(metrics.smape(y_clean, fc_tpu["yhat"], mask))
+    # Parity: batched solver must be as accurate as the scipy oracle.
+    np.testing.assert_allclose(s_tpu, s_cpu, atol=0.25)
+    # And both must actually fit well.
+    assert s_cpu.max() < 6.0 and s_tpu.max() < 6.0
+
+
+def test_tpu_chunked_fit_matches_unchunked(small_batch):
+    ds, y = small_batch
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=4
+    )
+    solver = SolverConfig(max_iters=150)
+    y_j = jnp.asarray(y)
+    whole = get_backend("tpu", cfg, solver).fit(ds, y_j)
+    chunked = get_backend("tpu", cfg, solver, chunk_size=2).fit(ds, y_j)
+    assert chunked.theta.shape == whole.theta.shape
+    # Chunk padding must not perturb real series' results.
+    np.testing.assert_allclose(
+        np.asarray(chunked.loss), np.asarray(whole.loss), rtol=1e-3, atol=1e-3
+    )

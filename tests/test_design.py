@@ -1,0 +1,138 @@
+"""Seasonality features, fit-data prep, and the batched forward model."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsspark_tpu.config import (
+    ProphetConfig,
+    RegressorConfig,
+    SeasonalityConfig,
+    WEEKLY,
+    YEARLY,
+)
+from tsspark_tpu.models.prophet import seasonality
+from tsspark_tpu.models.prophet.design import model_yhat, prepare_fit_data
+from tsspark_tpu.models.prophet.params import ProphetParams, pack, unpack, init_theta
+
+
+def test_fourier_features_values():
+    t = jnp.asarray([0.0, 1.75, 14.0])
+    x = np.asarray(seasonality.fourier_features(t, period=7.0, order=2))
+    assert x.shape == (3, 4)
+    for i, tt in enumerate([0.0, 1.75, 14.0]):
+        want = [
+            np.sin(2 * np.pi * 1 * tt / 7),
+            np.cos(2 * np.pi * 1 * tt / 7),
+            np.sin(2 * np.pi * 2 * tt / 7),
+            np.cos(2 * np.pi * 2 * tt / 7),
+        ]
+        np.testing.assert_allclose(x[i], want, atol=1e-6)
+
+
+def test_fourier_large_t_phase_stable():
+    # Large absolute day counts must not lose phase (mod-period fold).
+    t = jnp.asarray([100000.0 + 1.75], dtype=jnp.float32)
+    x = np.asarray(seasonality.fourier_features(t, period=7.0, order=1))
+    tt = (100000.0 + 1.75) % 7.0
+    np.testing.assert_allclose(
+        x[0], [np.sin(2 * np.pi * tt / 7), np.cos(2 * np.pi * tt / 7)], atol=1e-4
+    )
+
+
+def test_param_pack_roundtrip():
+    cfg = ProphetConfig(n_changepoints=5, seasonalities=(WEEKLY,))
+    rng = np.random.default_rng(0)
+    theta = jnp.asarray(rng.normal(size=(3, cfg.num_params)))
+    p = unpack(theta, cfg)
+    np.testing.assert_allclose(np.asarray(pack(p)), np.asarray(theta))
+    assert p.delta.shape == (3, 5)
+    assert p.beta.shape == (3, WEEKLY.num_features)
+
+
+def test_prepare_fit_data_scaling_and_mask():
+    cfg = ProphetConfig(seasonalities=(WEEKLY,), n_changepoints=3)
+    ds = jnp.arange(10.0)
+    y = np.ones((2, 10))
+    y[0] *= 4.0
+    y[1] *= -2.0
+    y[1, 7:] = np.nan  # missing tail
+    data, meta = prepare_fit_data(ds, jnp.asarray(y), cfg)
+
+    np.testing.assert_allclose(np.asarray(meta.y_scale), [4.0, 2.0])
+    np.testing.assert_allclose(np.asarray(data.mask[1]), [1] * 7 + [0] * 3)
+    # Scaled y in [-1, 1]; masked entries zeroed.
+    assert np.abs(np.asarray(data.y)).max() <= 1.0 + 1e-6
+    assert (np.asarray(data.y[1, 7:]) == 0).all()
+    # Scaled time: series 1 spans only 6 observed days.
+    np.testing.assert_allclose(float(data.t[0, -1]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(data.t[1, 6]), 1.0, atol=1e-6)
+    # Shared grid -> shared (T, F) seasonal matrix.
+    assert data.X_season.shape == (10, WEEKLY.num_features)
+
+
+def test_prepare_logistic_requires_cap():
+    cfg = ProphetConfig(growth="logistic", seasonalities=())
+    with pytest.raises(ValueError):
+        prepare_fit_data(jnp.arange(5.0), jnp.ones((1, 5)), cfg)
+
+
+def test_regressor_standardization():
+    cfg = ProphetConfig(
+        seasonalities=(),
+        n_changepoints=0,
+        regressors=(
+            RegressorConfig("temp"),
+            RegressorConfig("promo"),  # binary -> left unscaled
+        ),
+    )
+    rng = np.random.default_rng(1)
+    temp = rng.normal(20.0, 5.0, (2, 40, 1))
+    promo = (rng.uniform(size=(2, 40, 1)) < 0.3).astype(float)
+    reg = np.concatenate([temp, promo], axis=-1)
+    data, meta = prepare_fit_data(
+        jnp.arange(40.0), jnp.asarray(rng.normal(size=(2, 40))), cfg,
+        regressors=jnp.asarray(reg),
+    )
+    x = np.asarray(data.X_reg)
+    np.testing.assert_allclose(x[:, :, 0].mean(axis=1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(x[:, :, 0].std(axis=1), 1.0, atol=1e-3)
+    np.testing.assert_allclose(x[:, :, 1], reg[:, :, 1], atol=1e-6)  # untouched
+
+
+def test_model_yhat_additive_vs_multiplicative():
+    weekly_add = SeasonalityConfig("weekly", 7.0, 2, mode="additive")
+    weekly_mult = SeasonalityConfig("weekly", 7.0, 2, mode="multiplicative")
+    rng = np.random.default_rng(2)
+    ds = jnp.arange(60.0)
+    y = jnp.asarray(rng.normal(10, 1, (1, 60)))
+    beta = rng.normal(size=4)
+
+    for mode_cfg, mult in ((weekly_add, False), (weekly_mult, True)):
+        cfg = ProphetConfig(seasonalities=(mode_cfg,), n_changepoints=0)
+        data, _ = prepare_fit_data(ds, y, cfg)
+        p = ProphetParams(
+            k=jnp.asarray([0.5]),
+            m=jnp.asarray([1.0]),
+            log_sigma=jnp.asarray([0.0]),
+            delta=jnp.zeros((1, 0)),
+            beta=jnp.asarray(beta[None, :]),
+        )
+        yhat, g = model_yhat(pack(p), data, cfg)
+        x = np.asarray(data.X_season)
+        season = x @ beta
+        want = np.asarray(g[0]) * (1 + season) if mult else np.asarray(g[0]) + season
+        np.testing.assert_allclose(np.asarray(yhat[0]), want, rtol=1e-4, atol=1e-5)
+
+
+def test_init_theta_reasonable():
+    cfg = ProphetConfig(seasonalities=(YEARLY,), n_changepoints=4)
+    ds = jnp.arange(100.0)
+    y_raw = 2.0 + 3.0 * np.arange(100) / 99.0  # line from 2 to 5
+    data, meta = prepare_fit_data(ds, jnp.asarray(y_raw[None, :]), cfg)
+    theta0 = init_theta(cfg, data.y, data.mask, data.t)
+    p = unpack(theta0, cfg)
+    # Scaled: y/5 spans 0.4 -> 1.0 over t 0 -> 1: slope 0.6, intercept 0.4.
+    np.testing.assert_allclose(float(p.k[0]), 0.6, atol=1e-3)
+    np.testing.assert_allclose(float(p.m[0]), 0.4, atol=1e-3)
+    assert np.asarray(p.delta).shape == (1, 4)

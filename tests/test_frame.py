@@ -1,0 +1,106 @@
+"""Long-format DataFrame front-end: pivot, datetime round-trip, and
+regressions for review findings (floor alignment, standardize opt-out,
+chunked per-series grids)."""
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+import pytest
+
+import tsspark_tpu as tt
+from tsspark_tpu.config import ProphetConfig, RegressorConfig, WEEKLY
+from tsspark_tpu.frame import Forecaster, pivot_long
+from tsspark_tpu.models.prophet.design import prepare_fit_data
+
+
+def _long_df(n_days=120, n_series=2, seed=0, start="2023-01-01"):
+    rng = np.random.default_rng(seed)
+    dates = pd.date_range(start, periods=n_days, freq="D")
+    frames = []
+    for i in range(n_series):
+        lvl = 10.0 * (i + 1)
+        y = lvl + np.sin(2 * np.pi * np.arange(n_days) / 7) + rng.normal(
+            0, 0.1, n_days
+        )
+        frames.append(
+            pd.DataFrame({"series_id": f"s{i}", "ds": dates, "y": y})
+        )
+    return pd.concat(frames, ignore_index=True)
+
+
+def test_pivot_long_shapes_and_holes():
+    df = _long_df(n_days=10)
+    df = df.drop(df[(df.series_id == "s1") & (df.ds < "2023-01-04")].index)
+    batch = pivot_long(df)
+    assert batch.y.shape == (2, 10)
+    assert np.isnan(batch.y[1, :3]).all() and np.isfinite(batch.y[1, 3:]).all()
+
+
+def test_pivot_floor_staggered_start():
+    """Review finding: floor must come from each series' first OBSERVED row,
+    not union-grid column 0."""
+    df = _long_df(n_days=10)
+    df["floor"] = np.where(df.series_id == "s0", 5.0, 8.0)
+    df = df.drop(df[(df.series_id == "s1") & (df.ds < "2023-01-04")].index)
+    batch = pivot_long(df, floor_col="floor")
+    np.testing.assert_allclose(batch.floor, [5.0, 8.0])
+
+
+def test_datetime_roundtrip_us_resolution():
+    """Regression: pandas >= 2 may store datetime64 at us resolution; output
+    ds must continue the training calendar, not land in 1970."""
+    df = _long_df(n_days=60)
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(WEEKLY,), n_changepoints=3), backend="tpu"
+    ).fit(df)
+    out = fc.predict(horizon=5, num_samples=0)
+    assert out.ds.min() == pd.Timestamp("2023-03-02")
+    assert out.ds.max() == pd.Timestamp("2023-03-06")
+
+
+def test_numeric_ds_passthrough():
+    df = _long_df(n_days=40)
+    df["ds"] = (df.ds - pd.Timestamp("1970-01-01")).dt.days.astype(float)
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(WEEKLY,), n_changepoints=3), backend="tpu"
+    ).fit(df)
+    out = fc.predict(horizon=3, num_samples=0)
+    assert np.issubdtype(out.ds.dtype, np.floating)
+    assert len(out) == 2 * 3
+
+
+def test_regressor_standardize_opt_out():
+    """Review finding: standardize=False must leave continuous columns raw."""
+    cfg = ProphetConfig(
+        seasonalities=(),
+        n_changepoints=0,
+        regressors=(RegressorConfig("temp", standardize=False),),
+    )
+    rng = np.random.default_rng(1)
+    reg = rng.normal(20.0, 5.0, (1, 50, 1))
+    data, meta = prepare_fit_data(
+        jnp.arange(50.0), jnp.asarray(rng.normal(size=(1, 50))), cfg,
+        regressors=jnp.asarray(reg),
+    )
+    np.testing.assert_allclose(np.asarray(data.X_reg), reg, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(meta.reg_std), 1.0)
+
+
+def test_chunked_fit_with_per_series_grids():
+    """Review finding: (B, T) ds must survive chunking + padding."""
+    rng = np.random.default_rng(2)
+    b, t_len = 3, 60
+    ds = np.stack([np.arange(t_len, dtype=float) + 10 * i for i in range(b)])
+    y = 5.0 + 0.1 * ds + rng.normal(0, 0.1, (b, t_len))
+    backend = tt.get_backend(
+        "tpu",
+        ProphetConfig(seasonalities=(), n_changepoints=2),
+        tt.SolverConfig(max_iters=50),
+        chunk_size=2,
+    )
+    state = backend.fit(jnp.asarray(ds), jnp.asarray(y))
+    assert state.theta.shape[0] == b
+    assert bool(jnp.isfinite(state.loss).all())
+    np.testing.assert_allclose(
+        np.asarray(state.meta.ds_start), ds[:, 0], atol=1e-6
+    )

@@ -1,0 +1,100 @@
+"""Batched L-BFGS: convex quadratics (exact answer), Rosenbrock (hard),
+and mixed batches where series converge at different rates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tsspark_tpu.config import SolverConfig
+from tsspark_tpu.ops import lbfgs
+
+
+def _batch_fun(f_single):
+    """Lift a scalar objective to the (B,) losses + (B, P) grads contract."""
+
+    def fun(theta):
+        f = jax.vmap(f_single)(theta)
+        g = jax.vmap(jax.grad(f_single))(theta)
+        return f, g
+
+    return fun
+
+
+def test_batched_quadratic_exact():
+    rng = np.random.default_rng(0)
+    b, p = 16, 8
+    # Random SPD quadratics with distinct conditioning per series.
+    a_half = rng.normal(size=(b, p, p))
+    a_mats = np.einsum("bij,bkj->bik", a_half, a_half) + 0.1 * np.eye(p)
+    centers = rng.normal(size=(b, p))
+    a_j = jnp.asarray(a_mats)
+    c_j = jnp.asarray(centers)
+
+    def fun(theta):
+        d = theta - c_j
+        ad = jnp.einsum("bij,bj->bi", a_j, d)
+        f = 0.5 * jnp.sum(d * ad, axis=-1)
+        return f, ad
+
+    res = lbfgs.minimize(fun, jnp.asarray(rng.normal(size=(b, p))))
+    assert bool(res.converged.all())
+    np.testing.assert_allclose(np.asarray(res.theta), centers, atol=1e-3)
+    assert np.asarray(res.f).max() < 1e-6
+
+
+def test_rosenbrock_batch():
+    def rosen(x):
+        return jnp.sum(
+            100.0 * (x[1:] - x[:-1] ** 2) ** 2 + (1.0 - x[:-1]) ** 2
+        )
+
+    rng = np.random.default_rng(1)
+    theta0 = jnp.asarray(rng.uniform(-1.5, 1.5, size=(8, 4)))
+    res = lbfgs.minimize(
+        _batch_fun(rosen), theta0, SolverConfig(max_iters=500, tol=0.0, gtol=1e-5)
+    )
+    # Every series must reach a stationary point (4D Rosenbrock has a genuine
+    # local minimum near (-1, 1, 1, 1), so not all starts reach all-ones).
+    assert np.asarray(res.grad_norm).max() < 1e-3
+    # Which basin each start lands in is trajectory luck; stationarity above
+    # is the real check.  Still require the global optimum to dominate.
+    at_global = np.abs(np.asarray(res.theta) - 1.0).max(axis=-1) < 1e-2
+    assert at_global.sum() >= 4
+
+
+def test_mixed_convergence_rates_freeze_correctly():
+    # Series 0: trivial 1-step quadratic; series 1: badly conditioned.
+    scales = jnp.asarray([[1.0, 1.0], [1.0, 1e4]])
+
+    def fun(theta):
+        f = 0.5 * jnp.sum(scales * theta * theta, axis=-1)
+        return f, scales * theta
+
+    theta0 = jnp.full((2, 2), 3.0)
+    res = lbfgs.minimize(fun, theta0, SolverConfig(max_iters=300, tol=0.0))
+    assert bool(res.converged.all())
+    np.testing.assert_allclose(np.asarray(res.theta), 0.0, atol=1e-3)
+    # The easy series must have stopped iterating earlier than the hard one.
+    assert int(res.n_iters[0]) <= int(res.n_iters[1])
+
+
+def test_nonfinite_trial_rejected():
+    # Objective explodes to NaN outside |x| < 2: line search must shrink past it.
+    def f_single(x):
+        v = jnp.sum(x * x)
+        return jnp.where(v > 4.0, jnp.nan, v)
+
+    theta0 = jnp.asarray([[1.9, 0.0]])
+    res = lbfgs.minimize(_batch_fun(f_single), theta0, SolverConfig(max_iters=100))
+    assert np.isfinite(float(res.f[0]))
+    np.testing.assert_allclose(np.asarray(res.theta), 0.0, atol=1e-3)
+
+
+def test_jit_compatible():
+    def fun(theta):
+        f = 0.5 * jnp.sum(theta * theta, axis=-1)
+        return f, theta
+
+    jitted = jax.jit(lambda t0: lbfgs.minimize(fun, t0))
+    res = jitted(jnp.ones((4, 3)))
+    np.testing.assert_allclose(np.asarray(res.theta), 0.0, atol=1e-4)

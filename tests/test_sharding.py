@@ -1,0 +1,71 @@
+"""Sharded fit over the virtual 8-device CPU mesh: results must match the
+single-device fit, for pure series-sharding and for (series x time) meshes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsspark_tpu.config import (
+    ProphetConfig,
+    SeasonalityConfig,
+    ShardingConfig,
+    SolverConfig,
+)
+from tsspark_tpu.data import datasets
+from tsspark_tpu.models.prophet.design import prepare_fit_data
+from tsspark_tpu.models.prophet.params import init_theta
+from tsspark_tpu.ops import lbfgs
+from tsspark_tpu.models.prophet.loss import value_and_grad_batch
+from tsspark_tpu.parallel import mesh as mesh_mod
+from tsspark_tpu.parallel import sharding
+
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=4
+)
+SOLVER = SolverConfig(max_iters=60)
+
+
+@pytest.fixture(scope="module")
+def batch_data():
+    batch = datasets.m4_hourly_like(n_series=11, max_len=280, seed=3)
+    data, _ = prepare_fit_data(batch.ds, jnp.asarray(batch.y), CFG)
+    theta0 = init_theta(CFG, data.y, data.mask, data.t)
+    return data, theta0
+
+
+def test_requires_8_devices():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+
+
+def test_series_sharded_fit_matches_single_device(batch_data):
+    data, theta0 = batch_data
+    ref = lbfgs.minimize(
+        lambda th: value_and_grad_batch(th, data, CFG), theta0, SOLVER
+    )
+    m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
+    res = sharding.fit_sharded(data, theta0, CFG, SOLVER, m)
+    assert res.theta.shape == theta0.shape  # padding stripped (11 -> 16 -> 11)
+    np.testing.assert_allclose(
+        np.asarray(res.f), np.asarray(ref.f), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_series_time_mesh_fit(batch_data):
+    data, theta0 = batch_data
+    ref = lbfgs.minimize(
+        lambda th: value_and_grad_batch(th, data, CFG), theta0, SOLVER
+    )
+    m = mesh_mod.make_mesh(n_series_shards=4, n_time_shards=2)
+    res = sharding.fit_sharded(
+        data, theta0, CFG, SOLVER, m, ShardingConfig(time_axis="time")
+    )
+    np.testing.assert_allclose(
+        np.asarray(res.f), np.asarray(ref.f), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError):
+        mesh_mod.make_mesh(n_series_shards=3, n_time_shards=3)

@@ -1,0 +1,102 @@
+"""Trend math: the cumsum+gather formulation must match the naive A-matrix
+Prophet formulation exactly."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tsspark_tpu.models.prophet import trend
+
+
+def _naive_piecewise_linear(t, k, m, delta, s):
+    """Textbook Prophet: g(t) = (k + A@delta) t + (m + A@(-s*delta))."""
+    a = (t[:, None] >= s[None, :]).astype(np.float64)  # (T, n_cp)
+    slope = k + a @ delta
+    offset = m + a @ (-s * delta)
+    return slope * t + offset
+
+
+def test_piecewise_linear_matches_naive():
+    rng = np.random.default_rng(0)
+    b, t_len, n_cp = 4, 50, 7
+    t = np.sort(rng.uniform(0, 1, (b, t_len)), axis=-1)
+    s = np.sort(rng.uniform(0.05, 0.8, (b, n_cp)), axis=-1)
+    k = rng.normal(size=b)
+    m = rng.normal(size=b)
+    delta = rng.normal(size=(b, n_cp))
+
+    got = np.asarray(
+        trend.piecewise_linear(
+            jnp.asarray(t), jnp.asarray(k), jnp.asarray(m), jnp.asarray(delta),
+            jnp.asarray(s),
+        )
+    )
+    for i in range(b):
+        want = _naive_piecewise_linear(t[i], k[i], m[i], delta[i], s[i])
+        np.testing.assert_allclose(got[i], want, rtol=1e-5, atol=1e-5)
+
+
+def test_piecewise_linear_continuous_at_changepoints():
+    # Evaluate just before/after each changepoint: jump must vanish.
+    s = jnp.asarray([[0.25, 0.5, 0.75]])
+    k = jnp.asarray([1.3])
+    m = jnp.asarray([0.2])
+    delta = jnp.asarray([[2.0, -3.0, 1.0]])
+    eps = 1e-5
+    t = jnp.asarray([[0.25 - eps, 0.25 + eps, 0.5 - eps, 0.5 + eps]])
+    g = trend.piecewise_linear(t, k, m, delta, s)
+    assert abs(float(g[0, 1] - g[0, 0])) < 1e-3
+    assert abs(float(g[0, 3] - g[0, 2])) < 1e-3
+
+
+def test_piecewise_linear_no_changepoints():
+    t = jnp.linspace(0, 1, 10)[None, :]
+    g = trend.piecewise_linear(
+        t, jnp.asarray([2.0]), jnp.asarray([1.0]),
+        jnp.zeros((1, 0)), jnp.zeros((1, 0)),
+    )
+    np.testing.assert_allclose(np.asarray(g[0]), 2.0 * np.asarray(t[0]) + 1.0, rtol=1e-6)
+
+
+def test_logistic_continuity_and_cap():
+    rng = np.random.default_rng(1)
+    b, n_cp = 3, 5
+    s = np.sort(rng.uniform(0.1, 0.8, (b, n_cp)), axis=-1)
+    k = rng.uniform(1.0, 3.0, b)
+    m = rng.uniform(0.2, 0.5, b)
+    delta = rng.normal(scale=0.5, size=(b, n_cp))
+    t = np.linspace(0, 1, 400)[None, :].repeat(b, axis=0)
+    cap = np.full_like(t, 2.5)
+
+    g = np.asarray(
+        trend.logistic(
+            jnp.asarray(t), jnp.asarray(cap), jnp.asarray(k), jnp.asarray(m),
+            jnp.asarray(delta), jnp.asarray(s),
+        )
+    )
+    # Bounded by (0, cap).
+    assert (g > 0).all() and (g < 2.5).all()
+    # Continuity: max step between adjacent dense samples stays small.
+    assert np.abs(np.diff(g, axis=-1)).max() < 0.05
+
+
+def test_logistic_no_changepoints_closed_form():
+    t = jnp.linspace(0, 1, 20)[None, :]
+    cap = jnp.full((1, 20), 3.0)
+    k, m = jnp.asarray([2.0]), jnp.asarray([0.4])
+    g = trend.logistic(t, cap, k, m, jnp.zeros((1, 0)), jnp.zeros((1, 0)))
+    want = 3.0 / (1.0 + np.exp(-2.0 * (np.asarray(t[0]) - 0.4)))
+    np.testing.assert_allclose(np.asarray(g[0]), want, rtol=1e-5)
+
+
+def test_flat():
+    t = jnp.linspace(0, 1, 11)[None, :]
+    g = trend.flat(t, jnp.asarray([0.7]))
+    np.testing.assert_allclose(np.asarray(g), 0.7, rtol=1e-6)
+
+
+def test_uniform_changepoints():
+    s = trend.uniform_changepoints(
+        jnp.zeros((2,)), jnp.ones((2,)), n_changepoints=4, changepoint_range=0.8
+    )
+    np.testing.assert_allclose(np.asarray(s[0]), [0.2, 0.4, 0.6, 0.8], rtol=1e-6)
+    assert s.shape == (2, 4)

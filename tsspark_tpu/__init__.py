@@ -1,0 +1,58 @@
+"""tsspark_tpu — a TPU-native time-series forecasting framework.
+
+A from-scratch re-design of the capabilities of ``mageky/time-series-spark``
+(Prophet-family decomposable forecasting at scale): instead of fanning
+per-series CPU fits out through Spark ``mapPartitions`` UDFs, the design
+matrix build and the L-BFGS MAP solve are batched JAX programs sharded over
+TPU meshes, behind a ``ForecastBackend`` plugin registry
+(see BASELINE.json:5 for the driver north star; the reference source itself
+was unavailable — SURVEY.md §0).
+
+Quick start::
+
+    import pandas as pd
+    from tsspark_tpu import Forecaster, ProphetConfig
+
+    fc = Forecaster(ProphetConfig(), backend="tpu")
+    fc.fit(df)                       # long frame: series_id, ds, y
+    out = fc.predict(horizon=28)     # long frame with yhat + intervals
+"""
+
+from tsspark_tpu.config import (
+    DAILY,
+    ProphetConfig,
+    RegressorConfig,
+    SeasonalityConfig,
+    ShardingConfig,
+    SolverConfig,
+    WEEKLY,
+    YEARLY,
+)
+from tsspark_tpu.backends.registry import (
+    ForecastBackend,
+    get_backend,
+    list_backends,
+    register_backend,
+)
+from tsspark_tpu.frame import Forecaster
+from tsspark_tpu.models.prophet.model import FitState, ProphetModel
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DAILY",
+    "Forecaster",
+    "ForecastBackend",
+    "FitState",
+    "ProphetConfig",
+    "ProphetModel",
+    "RegressorConfig",
+    "SeasonalityConfig",
+    "ShardingConfig",
+    "SolverConfig",
+    "WEEKLY",
+    "YEARLY",
+    "get_backend",
+    "list_backends",
+    "register_backend",
+]

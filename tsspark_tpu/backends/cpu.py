@@ -1,0 +1,113 @@
+"""CPU reference backend: per-series scipy L-BFGS-B.
+
+This is the analog of the reference's CPU executor path (per-series scipy
+L-BFGS MAP fits inside Spark ``mapPartitions`` workers, BASELINE.json:5) and
+serves as the parity oracle for the batched TPU solver: same loss, same
+design tensors, independent battle-tested optimizer.  It is intentionally a
+straight per-series Python loop — its job is correctness, not speed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import scipy.optimize
+
+from tsspark_tpu.backends.registry import ForecastBackend, register_backend
+from tsspark_tpu.models.prophet import predict as predict_mod
+from tsspark_tpu.models.prophet.design import FitData, prepare_fit_data
+from tsspark_tpu.models.prophet.loss import neg_log_posterior
+from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.models.prophet.params import init_theta
+
+
+@register_backend
+class CpuBackend(ForecastBackend):
+    name = "cpu"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._cpu = jax.devices("cpu")[0]
+        # Single-series objective jitted once on CPU; scipy drives it.
+        cfg = self.config
+
+        @jax.jit
+        def vag(theta, data):
+            f = lambda th: neg_log_posterior(th[None, :], data, cfg)[0]
+            return jax.value_and_grad(f)(theta)
+
+        self._vag = vag
+
+    def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
+            init=None):
+        with jax.default_device(self._cpu):
+            data, meta = prepare_fit_data(
+                ds, y, self.config, mask=mask, cap=cap, floor=floor,
+                regressors=regressors,
+            )
+            theta0 = init if init is not None else init_theta(
+                self.config, data.y, data.mask, data.t
+            )
+            theta0 = np.asarray(theta0, np.float64)
+            b = theta0.shape[0]
+            out = np.empty_like(theta0)
+            losses = np.empty(b)
+            grad_norms = np.empty(b)
+            conv = np.empty(b, bool)
+            n_iters = np.empty(b, np.int32)
+            shared_x = data.X_season.ndim == 2
+
+            for i in range(b):
+                data_i = FitData(
+                    t=data.t[i : i + 1],
+                    y=data.y[i : i + 1],
+                    mask=data.mask[i : i + 1],
+                    s=data.s[i : i + 1],
+                    cap=data.cap[i : i + 1],
+                    X_season=data.X_season if shared_x else data.X_season[i : i + 1],
+                    X_reg=data.X_reg[i : i + 1],
+                    prior_scales=data.prior_scales,
+                    mult_mask=data.mult_mask,
+                )
+
+                def f_and_g(th):
+                    f, g = self._vag(jnp.asarray(th, jnp.float32), data_i)
+                    return float(f), np.asarray(g, np.float64)
+
+                res = scipy.optimize.minimize(
+                    f_and_g,
+                    theta0[i],
+                    jac=True,
+                    method="L-BFGS-B",
+                    options={
+                        "maxiter": self.solver_config.max_iters,
+                        "ftol": 1e-9,
+                        "gtol": 1e-7,
+                    },
+                )
+                out[i] = res.x
+                losses[i] = res.fun
+                grad_norms[i] = np.abs(np.asarray(res.jac)).max()
+                conv[i] = res.success
+                n_iters[i] = res.nit
+
+            return FitState(
+                theta=jnp.asarray(out, jnp.float32),
+                meta=meta,
+                loss=jnp.asarray(losses, jnp.float32),
+                grad_norm=jnp.asarray(grad_norms, jnp.float32),
+                converged=jnp.asarray(conv),
+                n_iters=jnp.asarray(n_iters),
+            )
+
+    def predict(self, state, ds, cap=None, regressors=None, seed=0,
+                num_samples=None):
+        with jax.default_device(self._cpu):
+            data = predict_mod.prepare_predict_data(
+                ds, state.meta, self.config, cap=cap, regressors=regressors
+            )
+            return predict_mod.forecast(
+                state.theta, data, state.meta, self.config,
+                key=jax.random.PRNGKey(seed), num_samples=num_samples,
+            )

@@ -1,0 +1,169 @@
+"""Configuration dataclasses for the TPU-native Prophet-family framework.
+
+Design notes
+------------
+All configs are frozen dataclasses so they can be used as static (hashable)
+arguments to ``jax.jit``.  Anything that changes array *shapes* (number of
+changepoints, Fourier orders, regressor count, horizon) lives here and is
+static; anything that is a *value* (prior scales, caps) is carried as data so
+re-fits with different regularization do not trigger recompilation.
+
+Reference parity: mirrors the knobs of the reference's ``tsspark.fit.prophet``
+module as described by the driver north star (BASELINE.json:5 — changepoint
+prior regularization, holiday/external regressors, logistic growth caps,
+additive and multiplicative seasonality).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class SeasonalityConfig:
+    """One Fourier seasonality block (e.g. yearly / weekly / daily).
+
+    period is in days (Prophet convention); fourier_order K produces 2K
+    feature columns sin(2*pi*n*t/period), cos(2*pi*n*t/period) for n=1..K.
+    """
+
+    name: str
+    period: float
+    fourier_order: int
+    prior_scale: float = 10.0
+    mode: str = "additive"  # "additive" | "multiplicative"
+
+    def __post_init__(self):
+        if self.fourier_order < 1:
+            raise ValueError(f"fourier_order must be >= 1, got {self.fourier_order}")
+        if self.period <= 0:
+            raise ValueError(f"period must be > 0, got {self.period}")
+        if self.mode not in ("additive", "multiplicative"):
+            raise ValueError(f"mode must be additive|multiplicative, got {self.mode}")
+
+    @property
+    def num_features(self) -> int:
+        return 2 * self.fourier_order
+
+
+@dataclasses.dataclass(frozen=True)
+class RegressorConfig:
+    """An external regressor column (includes holiday indicator columns)."""
+
+    name: str
+    prior_scale: float = 10.0
+    standardize: bool = True
+    mode: str = "additive"
+
+    def __post_init__(self):
+        if self.mode not in ("additive", "multiplicative"):
+            raise ValueError(f"mode must be additive|multiplicative, got {self.mode}")
+
+
+# Default seasonalities mirroring Prophet's auto-seasonality choices.
+YEARLY = SeasonalityConfig("yearly", period=365.25, fourier_order=10)
+WEEKLY = SeasonalityConfig("weekly", period=7.0, fourier_order=3)
+DAILY = SeasonalityConfig("daily", period=1.0, fourier_order=4)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProphetConfig:
+    """Full model specification for a (batch of) Prophet-style fit(s).
+
+    One config describes every series in a batch; per-series data (caps,
+    changepoint locations, masks) is carried in the design matrices.
+    """
+
+    growth: str = "linear"  # "linear" | "logistic" | "flat"
+    n_changepoints: int = 25
+    changepoint_range: float = 0.8
+    changepoint_prior_scale: float = 0.05
+    seasonalities: Tuple[SeasonalityConfig, ...] = (YEARLY, WEEKLY)
+    regressors: Tuple[RegressorConfig, ...] = ()
+    seasonality_mode: str = "additive"  # default mode for seasonalities
+    interval_width: float = 0.8
+    uncertainty_samples: int = 256
+    # Prior scales for the base trend params (Prophet uses 5.0 in its Stan model).
+    k_prior_scale: float = 5.0
+    m_prior_scale: float = 5.0
+    sigma_prior_scale: float = 0.5  # half-normal scale on observation noise
+
+    def __post_init__(self):
+        if self.growth not in ("linear", "logistic", "flat"):
+            raise ValueError(f"growth must be linear|logistic|flat, got {self.growth}")
+        if not 0.0 < self.changepoint_range <= 1.0:
+            raise ValueError("changepoint_range must be in (0, 1]")
+        if self.n_changepoints < 0:
+            raise ValueError("n_changepoints must be >= 0")
+        if self.seasonality_mode not in ("additive", "multiplicative"):
+            raise ValueError("seasonality_mode must be additive|multiplicative")
+        names = [s.name for s in self.seasonalities] + [r.name for r in self.regressors]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate seasonality/regressor names: {names}")
+
+    # ---- static shape helpers -------------------------------------------------
+
+    @property
+    def num_seasonal_features(self) -> int:
+        return sum(s.num_features for s in self.seasonalities)
+
+    @property
+    def num_regressors(self) -> int:
+        return len(self.regressors)
+
+    @property
+    def num_features(self) -> int:
+        """Total beta dimension: seasonal Fourier columns + regressor columns."""
+        return self.num_seasonal_features + self.num_regressors
+
+    def feature_modes(self) -> Tuple[bool, ...]:
+        """Per-feature flag: True if the column is multiplicative."""
+        modes = []
+        for s in self.seasonalities:
+            modes.extend([s.mode == "multiplicative"] * s.num_features)
+        for r in self.regressors:
+            modes.append(r.mode == "multiplicative")
+        return tuple(modes)
+
+    def feature_prior_scales(self) -> Tuple[float, ...]:
+        scales = []
+        for s in self.seasonalities:
+            scales.extend([s.prior_scale] * s.num_features)
+        for r in self.regressors:
+            scales.append(r.prior_scale)
+        return tuple(scales)
+
+    @property
+    def num_params(self) -> int:
+        """Flat parameter vector length: k, m, log_sigma, delta, beta."""
+        return 3 + self.n_changepoints + self.num_features
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverConfig:
+    """Batched L-BFGS MAP solver settings (see ops/lbfgs.py)."""
+
+    max_iters: int = 200
+    history: int = 10  # L-BFGS memory
+    tol: float = 2e-9  # relative objective-decrease tolerance (scipy's ftol)
+    gtol: float = 1e-6  # gradient-inf-norm convergence tolerance
+    ls_max_steps: int = 20  # backtracking line-search steps
+    ls_shrink: float = 0.5
+    ls_armijo_c1: float = 1e-4
+    init_step: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingConfig:
+    """How a fit batch is laid out over a jax.sharding.Mesh.
+
+    series_axis shards the embarrassingly-parallel series batch (the analog of
+    the reference's Spark ``mapPartitions`` fan-out); time_axis optionally
+    shards long series over chips (sequence parallelism: loss/grad reductions
+    over time become psums over the time axis).
+    """
+
+    series_axis: str = "series"
+    time_axis: Optional[str] = None
+    donate_params: bool = True

@@ -1,0 +1,191 @@
+"""Synthetic dataset generators standing in for the driver's eval datasets.
+
+The machine has zero network egress and no bundled copies of Peyton-Manning /
+M4 / M5 / Wikipedia-pageviews, so each generator produces series with the
+same shape, calendar, and statistical character as its namesake
+(BASELINE.json:7-11): sizes match (414 series for M4-Hourly, 30,490 for M5),
+and the generating processes exercise exactly the model features each eval
+config targets (changepoints, multi-seasonality, holidays/external
+regressors, logistic saturation, warm-start drift).
+
+All generators are deterministic in their seed and return plain numpy arrays
+(host-side data prep; device work starts at prepare_fit_data).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional
+
+import numpy as np
+
+
+class SeriesBatch(NamedTuple):
+    """A padded batch of series on a shared calendar grid."""
+
+    ds: np.ndarray            # (T,) absolute days since epoch
+    y: np.ndarray             # (B, T) observations, NaN where missing
+    mask: np.ndarray          # (B, T) 1.0 where observed
+    series_ids: np.ndarray    # (B,) string ids
+    cap: Optional[np.ndarray] = None         # (B, T) logistic capacity
+    regressors: Optional[np.ndarray] = None  # (B, T, R)
+    regressor_names: tuple = ()
+
+
+def _trend_with_changepoints(rng, t, n_cp=4, base_slope=1.0, cp_scale=1.5):
+    """Piecewise-linear trend on t in [0, 1]."""
+    cps = np.sort(rng.uniform(0.05, 0.9, n_cp))
+    deltas = rng.normal(0, cp_scale, n_cp)
+    g = base_slope * t
+    for c, d in zip(cps, deltas):
+        g = g + d * np.maximum(t - c, 0.0)
+    return g
+
+
+def peyton_manning_like(
+    n_days: int = 2905, start_day: float = 10957.0, seed: int = 0
+) -> SeriesBatch:
+    """One daily series shaped like log Wikipedia pageviews of a celebrity:
+    ~8 years, strong yearly + weekly seasonality, a few trend changepoints,
+    heavy-ish noise, occasional missing days.  Stands in for eval config 1."""
+    rng = np.random.default_rng(seed)
+    ds = start_day + np.arange(n_days, dtype=np.float64)
+    t = np.linspace(0, 1, n_days)
+    trend = 8.0 + _trend_with_changepoints(rng, t, n_cp=5, base_slope=-0.5)
+    yearly = (
+        0.45 * np.sin(2 * np.pi * ds / 365.25)
+        + 0.25 * np.cos(2 * np.pi * ds / 365.25)
+        + 0.18 * np.sin(4 * np.pi * ds / 365.25)
+    )
+    dow = ds.astype(np.int64) % 7
+    weekly = np.asarray([0.12, 0.3, 0.22, 0.18, 0.1, -0.35, -0.42])[dow]
+    y = trend + yearly + weekly + rng.normal(0, 0.25, n_days)
+    miss = rng.uniform(size=n_days) < 0.02
+    y[miss] = np.nan
+    mask = (~miss).astype(np.float64)
+    return SeriesBatch(
+        ds=ds, y=y[None, :], mask=mask[None, :],
+        series_ids=np.asarray(["peyton_manning_like"]),
+    )
+
+
+def m4_hourly_like(
+    n_series: int = 414, max_len: int = 960, seed: int = 1,
+    min_len: Optional[int] = None,
+) -> SeriesBatch:
+    """414 hourly series with daily + weekly seasonality and ragged lengths
+    (M4-Hourly lengths span 700-960).  Stands in for eval config 2."""
+    rng = np.random.default_rng(seed)
+    if min_len is None:
+        min_len = min(700, max(2, int(0.73 * max_len)))
+    hours = np.arange(max_len, dtype=np.float64)
+    ds = 15000.0 + hours / 24.0  # days, hourly grid
+    y = np.full((n_series, max_len), np.nan)
+    mask = np.zeros((n_series, max_len))
+    lengths = rng.integers(min_len, max_len + 1, n_series)
+    for i in range(n_series):
+        n = lengths[i]
+        t = np.linspace(0, 1, n)
+        level = rng.uniform(10, 5000)
+        trend = level * (1 + 0.3 * _trend_with_changepoints(rng, t, 3, 0.5, 0.8))
+        hod = ds[:n] * 24 % 24
+        daily = 0.25 * level * np.sin(2 * np.pi * hod / 24 + rng.uniform(0, 2 * np.pi))
+        daily += 0.1 * level * np.sin(4 * np.pi * hod / 24 + rng.uniform(0, 2 * np.pi))
+        dow = (ds[:n].astype(np.int64)) % 7
+        weekly = 0.12 * level * np.asarray(
+            [1.0, 0.9, 0.85, 0.9, 1.0, 1.3, 1.4]
+        )[dow] - 0.12 * level
+        noise = rng.normal(0, 0.05 * level, n)
+        # Right-align on the shared grid (all series end "now", like M4).
+        y[i, max_len - n:] = (trend + daily + weekly + noise)[:n]
+        mask[i, max_len - n:] = 1.0
+    ids = np.asarray([f"H{i+1}" for i in range(n_series)])
+    return SeriesBatch(ds=ds, y=y, mask=mask, series_ids=ids)
+
+
+def m5_like(
+    n_series: int = 30490, n_days: int = 1941, seed: int = 2,
+    with_regressors: bool = True,
+) -> SeriesBatch:
+    """M5-scale retail batch: 30,490 daily series, 1,941 days, holiday
+    indicator + price + promo regressors.  Stands in for eval config 3.
+
+    Generation is vectorized (30k x 1941 is ~59M points; a Python loop over
+    series would take minutes)."""
+    rng = np.random.default_rng(seed)
+    ds = 13514.0 + np.arange(n_days, dtype=np.float64)
+    t = np.linspace(0, 1, n_days)
+
+    level = rng.lognormal(1.0, 1.0, (n_series, 1))
+    slope = rng.normal(0.2, 0.4, (n_series, 1))
+    n_cp = 3
+    cps = np.sort(rng.uniform(0.1, 0.9, (n_series, n_cp)), axis=-1)
+    deltas = rng.normal(0, 0.5, (n_series, n_cp))
+    trend = 1.0 + slope * t[None, :]
+    for j in range(n_cp):
+        trend += deltas[:, j : j + 1] * np.maximum(t[None, :] - cps[:, j : j + 1], 0)
+
+    dow = ds.astype(np.int64) % 7
+    wk_pattern = rng.normal(0, 0.15, (n_series, 7))
+    weekly = np.take_along_axis(
+        wk_pattern, np.broadcast_to(dow[None, :], (n_series, n_days)), axis=1
+    )
+    yearly_phase = rng.uniform(0, 2 * np.pi, (n_series, 1))
+    yearly = 0.2 * np.sin(2 * np.pi * ds[None, :] / 365.25 + yearly_phase)
+
+    # Holiday calendar: ~12 fixed days/year, shared; per-series effect size.
+    doy = ds.astype(np.int64) % 365
+    holiday_days = np.asarray([0, 31, 59, 120, 151, 185, 243, 304, 327, 330, 358, 359])
+    is_holiday = np.isin(doy, holiday_days).astype(np.float64)
+    hol_effect = rng.normal(0.3, 0.2, (n_series, 1))
+
+    price = 1.0 + 0.1 * np.cumsum(rng.normal(0, 0.02, (n_series, n_days)), axis=1)
+    promo = (rng.uniform(size=(n_series, n_days)) < 0.05).astype(np.float64)
+    price_beta = rng.normal(-0.3, 0.1, (n_series, 1))
+    promo_beta = rng.normal(0.4, 0.15, (n_series, 1))
+
+    signal = (
+        trend
+        + weekly
+        + yearly
+        + hol_effect * is_holiday[None, :]
+        + price_beta * (price - 1.0)
+        + promo_beta * promo
+    )
+    y = level * np.maximum(signal + rng.normal(0, 0.15, (n_series, n_days)), 0.0)
+
+    # Leading zeros before "product launch" (M5's onset pattern): mask them.
+    launch = rng.integers(0, n_days // 3, n_series)
+    mask = (np.arange(n_days)[None, :] >= launch[:, None]).astype(np.float64)
+    y = np.where(mask > 0, y, np.nan)
+
+    reg = None
+    names: tuple = ()
+    if with_regressors:
+        reg = np.stack([is_holiday[None, :].repeat(n_series, 0), price, promo], axis=-1)
+        names = ("holiday", "price", "promo")
+    ids = np.asarray([f"M5_{i:05d}" for i in range(n_series)])
+    return SeriesBatch(
+        ds=ds, y=y, mask=mask, series_ids=ids, regressors=reg,
+        regressor_names=names,
+    )
+
+
+def wiki_logistic_like(
+    n_series: int = 8, n_days: int = 1200, seed: int = 3
+) -> SeriesBatch:
+    """Saturating-growth pageview series with known capacity (eval config 4)."""
+    rng = np.random.default_rng(seed)
+    ds = 14000.0 + np.arange(n_days, dtype=np.float64)
+    t = np.linspace(0, 1, n_days)
+    caps = rng.uniform(5e3, 5e4, (n_series, 1))
+    k = rng.uniform(4, 10, (n_series, 1))
+    m = rng.uniform(0.2, 0.5, (n_series, 1))
+    base = caps / (1.0 + np.exp(-k * (t[None, :] - m)))
+    dow = ds.astype(np.int64) % 7
+    weekly_mult = 1.0 + 0.1 * np.asarray([0.5, 1, 0.8, 0.6, 0.2, -1.5, -1.8])[dow]
+    y = base * weekly_mult[None, :] * (1 + rng.normal(0, 0.04, (n_series, n_days)))
+    ids = np.asarray([f"wiki_{i}" for i in range(n_series)])
+    return SeriesBatch(
+        ds=ds, y=y, mask=np.ones_like(y), series_ids=ids,
+        cap=np.broadcast_to(caps * 1.1, y.shape).copy(),
+    )

@@ -1,0 +1,52 @@
+"""Forecast accuracy metrics, masked and batched.
+
+sMAPE is the parity metric named by the driver north star (BASELINE.json:2);
+the rest are the standard companions for the M-competition datasets.  All
+functions accept (..., T) arrays plus an optional validity mask and reduce
+over the trailing time axis, working with numpy or jax arrays.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _masked(err, mask):
+    if mask is None:
+        return err, err.shape[-1]
+    return err * mask, jnp.maximum(mask.sum(axis=-1), 1.0)
+
+
+def smape(y_true, y_pred, mask=None, eps: float = 1e-9):
+    """Symmetric MAPE in percent: 200/n * sum |y-yhat| / (|y|+|yhat|)."""
+    denom = jnp.abs(y_true) + jnp.abs(y_pred) + eps
+    err, n = _masked(jnp.abs(y_true - y_pred) / denom, mask)
+    return 200.0 * err.sum(axis=-1) / n
+
+
+def mae(y_true, y_pred, mask=None):
+    err, n = _masked(jnp.abs(y_true - y_pred), mask)
+    return err.sum(axis=-1) / n
+
+
+def rmse(y_true, y_pred, mask=None):
+    err, n = _masked((y_true - y_pred) ** 2, mask)
+    return jnp.sqrt(err.sum(axis=-1) / n)
+
+
+def mase(y_true, y_pred, y_train, season: int = 1, mask=None, train_mask=None):
+    """MAE scaled by the in-sample seasonal-naive MAE (M4's headline metric)."""
+    naive = jnp.abs(y_train[..., season:] - y_train[..., :-season])
+    if train_mask is not None:
+        m = train_mask[..., season:] * train_mask[..., :-season]
+        scale = (naive * m).sum(axis=-1) / jnp.maximum(m.sum(axis=-1), 1.0)
+    else:
+        scale = naive.mean(axis=-1)
+    return mae(y_true, y_pred, mask) / jnp.maximum(scale, 1e-9)
+
+
+def coverage(y_true, lower, upper, mask=None):
+    """Fraction of observations inside [lower, upper]."""
+    inside = ((y_true >= lower) & (y_true <= upper)).astype(lower.dtype)
+    err, n = _masked(inside, mask)
+    return err.sum(axis=-1) / n

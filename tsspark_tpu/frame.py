@@ -1,0 +1,227 @@
+"""Long-format DataFrame front-end: the user-facing fit/predict API.
+
+The reference exposes a Spark-DataFrame API (long format: series id, ``ds``
+timestamp, ``y`` value) whose TPU path collapses to collect -> shard -> fit ->
+scatter (BASELINE.json:5).  This module is that collapse: pivot the long
+frame onto a shared calendar grid (collect), hand padded arrays to a
+``ForecastBackend`` (shard+fit happens inside), and explode results back to
+long format (scatter).
+
+Timestamps are converted to float days since the Unix epoch; any pandas
+datetime64 resolution or plain numeric "days" column works.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+from tsspark_tpu.backends.registry import ForecastBackend, get_backend
+from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.models.prophet.model import FitState
+
+_SECONDS_PER_DAY = 86400.0
+
+
+def _ds_to_days(ds: pd.Series) -> np.ndarray:
+    if np.issubdtype(ds.dtype, np.number):
+        return ds.to_numpy(np.float64)
+    # Resolution-agnostic (pandas >= 2 may store datetime64 in s/ms/us/ns).
+    ts = pd.to_datetime(ds)
+    delta = ts - pd.Timestamp("1970-01-01")
+    return (delta / pd.Timedelta(days=1)).to_numpy(np.float64)
+
+
+def _days_to_ts(days: np.ndarray) -> pd.Series:
+    return pd.Timestamp("1970-01-01") + pd.to_timedelta(
+        np.round(days * _SECONDS_PER_DAY * 1e3).astype("int64"), unit="ms"
+    )
+
+
+class PivotedBatch(NamedTuple):
+    ds: np.ndarray             # (T,) shared grid in days
+    y: np.ndarray              # (B, T) with NaN holes
+    series_ids: np.ndarray     # (B,)
+    cap: Optional[np.ndarray]
+    floor: Optional[np.ndarray]       # (B,)
+    regressors: Optional[np.ndarray]  # (B, T, R)
+
+
+def pivot_long(
+    df: pd.DataFrame,
+    id_col: str = "series_id",
+    ds_col: str = "ds",
+    y_col: str = "y",
+    cap_col: Optional[str] = None,
+    floor_col: Optional[str] = None,
+    regressor_cols: Sequence[str] = (),
+) -> PivotedBatch:
+    """Collect: long frame -> padded (B, T) arrays on the union calendar grid."""
+    days = _ds_to_days(df[ds_col])
+    work = df.assign(__days=days)
+    grid = np.unique(days)
+    t_index = {d: i for i, d in enumerate(grid)}
+    ids = work[id_col].unique()
+    id_index = {s: i for i, s in enumerate(ids)}
+    b, t_len = len(ids), len(grid)
+
+    rows = work[id_col].map(id_index).to_numpy()
+    cols = work["__days"].map(t_index).to_numpy()
+
+    def scatter(col, fill=np.nan):
+        out = np.full((b, t_len), fill)
+        out[rows, cols] = work[col].to_numpy(np.float64)
+        return out
+
+    y = scatter(y_col)
+    cap = scatter(cap_col) if cap_col else None
+    if floor_col:
+        # First *observed* floor per series (a series may have no row at the
+        # earliest union-grid timestamp, so column 0 is not safe).
+        floor_grid = scatter(floor_col)
+        first_obs = np.argmax(np.isfinite(floor_grid), axis=1)
+        floor = np.nan_to_num(floor_grid[np.arange(b), first_obs])
+    else:
+        floor = None
+    reg = None
+    if regressor_cols:
+        reg = np.stack([np.nan_to_num(scatter(c)) for c in regressor_cols], axis=-1)
+    return PivotedBatch(
+        ds=grid, y=y, series_ids=ids, cap=cap, floor=floor, regressors=reg
+    )
+
+
+class Forecaster:
+    """High-level fit/predict over long DataFrames, backed by a plugin backend.
+
+    Example:
+      fc = Forecaster(config, backend="tpu")
+      fc.fit(train_df)
+      out = fc.predict(horizon=28)   # long frame: series_id, ds, yhat, bounds
+    """
+
+    def __init__(
+        self,
+        config: ProphetConfig = ProphetConfig(),
+        solver_config: SolverConfig = SolverConfig(),
+        backend: str = "tpu",
+        id_col: str = "series_id",
+        ds_col: str = "ds",
+        y_col: str = "y",
+        cap_col: Optional[str] = None,
+        floor_col: Optional[str] = None,
+        regressor_cols: Sequence[str] = (),
+        **backend_kwargs,
+    ):
+        self.config = config
+        self.backend: ForecastBackend = get_backend(
+            backend, config, solver_config, **backend_kwargs
+        )
+        self.id_col, self.ds_col, self.y_col = id_col, ds_col, y_col
+        self.cap_col, self.floor_col = cap_col, floor_col
+        self.regressor_cols = tuple(regressor_cols)
+        self._was_datetime = False
+        self.state: Optional[FitState] = None
+        self.series_ids: Optional[np.ndarray] = None
+        self._train_ds: Optional[np.ndarray] = None
+        self._freq_days: Optional[float] = None
+
+    # -- fit -------------------------------------------------------------------
+
+    def fit(self, df: pd.DataFrame, init: Optional[jnp.ndarray] = None
+            ) -> "Forecaster":
+        self._was_datetime = not np.issubdtype(df[self.ds_col].dtype, np.number)
+        batch = pivot_long(
+            df, self.id_col, self.ds_col, self.y_col,
+            self.cap_col, self.floor_col, self.regressor_cols,
+        )
+        self.series_ids = batch.series_ids
+        self._train_ds = batch.ds
+        diffs = np.diff(batch.ds)
+        self._freq_days = float(np.median(diffs)) if len(diffs) else 1.0
+        self.state = self.backend.fit(
+            jnp.asarray(batch.ds),
+            jnp.asarray(batch.y),
+            cap=None if batch.cap is None else jnp.asarray(np.nan_to_num(batch.cap)),
+            floor=None if batch.floor is None else jnp.asarray(batch.floor),
+            regressors=None if batch.regressors is None
+            else jnp.asarray(batch.regressors),
+            init=init,
+        )
+        return self
+
+    # -- predict ---------------------------------------------------------------
+
+    def make_future_grid(self, horizon: int, include_history: bool = False
+                         ) -> np.ndarray:
+        if self._train_ds is None:
+            raise RuntimeError("fit before predict")
+        last = self._train_ds[-1]
+        fut = last + self._freq_days * np.arange(1, horizon + 1)
+        return np.concatenate([self._train_ds, fut]) if include_history else fut
+
+    def predict(
+        self,
+        horizon: Optional[int] = None,
+        future_df: Optional[pd.DataFrame] = None,
+        include_history: bool = False,
+        seed: int = 0,
+        num_samples: Optional[int] = None,
+    ) -> pd.DataFrame:
+        """Scatter: forecast back to a long frame.
+
+        Either give ``horizon`` (regular grid continuing the training
+        frequency; only valid without external regressors) or ``future_df``
+        (long frame carrying ds plus cap/regressor columns per series).
+        """
+        if self.state is None:
+            raise RuntimeError("fit before predict")
+        if future_df is not None:
+            batch = pivot_long(
+                future_df, self.id_col, self.ds_col,
+                y_col=self.ds_col,  # y unused at predict; reuse ds column
+                cap_col=self.cap_col, floor_col=self.floor_col,
+                regressor_cols=self.regressor_cols,
+            )
+            # Align series order with training order.
+            order = {s: i for i, s in enumerate(batch.series_ids)}
+            perm = np.asarray([order[s] for s in self.series_ids])
+            grid = batch.ds
+            cap = None if batch.cap is None else batch.cap[perm]
+            reg = None if batch.regressors is None else batch.regressors[perm]
+        else:
+            if horizon is None:
+                raise ValueError("give horizon or future_df")
+            if self.regressor_cols:
+                raise ValueError(
+                    "models with external regressors need future_df with "
+                    "future regressor values"
+                )
+            grid = self.make_future_grid(horizon, include_history)
+            cap = None
+            reg = None
+            if self.cap_col is not None:
+                raise ValueError("logistic models need future_df with cap")
+
+        fc = self.backend.predict(
+            self.state, jnp.asarray(grid),
+            cap=None if cap is None else jnp.asarray(np.nan_to_num(cap)),
+            regressors=None if reg is None else jnp.asarray(reg),
+            seed=seed, num_samples=num_samples,
+        )
+        return self._to_long(grid, fc)
+
+    def _to_long(self, grid: np.ndarray, fc: Dict[str, jnp.ndarray]
+                 ) -> pd.DataFrame:
+        b, t_len = len(self.series_ids), len(grid)
+        ds_rep = np.tile(grid, b)
+        out = {
+            self.id_col: np.repeat(self.series_ids, t_len),
+            self.ds_col: _days_to_ts(ds_rep) if self._was_datetime else ds_rep,
+        }
+        for k, v in fc.items():
+            out[k] = np.asarray(v).reshape(-1)
+        return pd.DataFrame(out)

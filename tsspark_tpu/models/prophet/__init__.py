@@ -1,0 +1,1 @@
+"""Prophet-family model: batched TPU-native decomposable forecaster."""

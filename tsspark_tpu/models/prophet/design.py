@@ -1,0 +1,241 @@
+"""Fit-data assembly and the batched forward model.
+
+This is the TPU-native replacement for the reference's per-series
+design-matrix build in ``tsspark.fit.prophet`` (BASELINE.json:5): instead of
+building one small design matrix per series inside a Spark ``mapPartitions``
+UDF, we build *one* set of padded, batched tensors for the whole series batch
+and evaluate the model as a handful of large fused ops:
+
+  * seasonal component — ``(B, Fs) @ (Fs, T)`` matmul (MXU) when the batch
+    shares a calendar grid, batched matmul otherwise;
+  * regressor component — small batched einsum (per-series covariates);
+  * trend — cumsum + gather (see trend.py), VPU-bound, O(B*T).
+
+Everything is a NamedTuple of arrays so it jits, vmaps, and shards cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig
+from tsspark_tpu.models.prophet import seasonality, trend
+from tsspark_tpu.models.prophet.params import ProphetParams, unpack
+
+
+class ScalingMeta(NamedTuple):
+    """Per-series affine scalings needed to map predictions back to data units."""
+
+    y_scale: jnp.ndarray        # (B,)
+    floor: jnp.ndarray          # (B,)
+    ds_start: jnp.ndarray       # (B,) absolute days of first observation
+    ds_span: jnp.ndarray        # (B,) observed span in days (>= 1 step)
+    reg_mean: jnp.ndarray       # (B, R) regressor standardization mean
+    reg_std: jnp.ndarray        # (B, R) regressor standardization std
+
+
+class FitData(NamedTuple):
+    """Everything the batched loss needs, padded to (B, T).
+
+    X_season may be (T, Fs) — shared calendar grid, the fast path — or
+    (B, T, Fs).  X_reg is (B, T, R) (external features are per-series).
+    """
+
+    t: jnp.ndarray            # (B, T) per-series scaled time
+    y: jnp.ndarray            # (B, T) scaled observations (0 where masked)
+    mask: jnp.ndarray         # (B, T) 1.0 where observed
+    s: jnp.ndarray            # (B, n_cp) changepoints in scaled time
+    cap: jnp.ndarray          # (B, T) scaled capacity (ones unless logistic)
+    X_season: jnp.ndarray     # (T, Fs) or (B, T, Fs)
+    X_reg: jnp.ndarray        # (B, T, R)
+    prior_scales: jnp.ndarray  # (F,) per-feature normal prior scale
+    mult_mask: jnp.ndarray    # (F,) 1.0 where the feature is multiplicative
+
+
+def _component(beta: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """beta (B, F) times features (T, F) or (B, T, F) -> (B, T)."""
+    if x.shape[-1] == 0:
+        return jnp.zeros(beta.shape[:-1] + x.shape[-2:-1], beta.dtype)
+    if x.ndim == 2:
+        return beta @ x.T
+    return jnp.einsum("bf,btf->bt", beta, x)
+
+
+def trend_fn(
+    params: ProphetParams, data: FitData, config: ProphetConfig
+) -> jnp.ndarray:
+    if config.growth == "linear":
+        return trend.piecewise_linear(data.t, params.k, params.m, params.delta, data.s)
+    if config.growth == "logistic":
+        return trend.logistic(
+            data.t, data.cap, params.k, params.m, params.delta, data.s
+        )
+    return trend.flat(data.t, params.m)
+
+
+def seasonal_split(
+    theta: jnp.ndarray, data: FitData, config: ProphetConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(additive_total, multiplicative_total) in scaled units, each (B, T)."""
+    p = unpack(theta, config)
+    fs = config.num_seasonal_features
+    beta_season, beta_reg = p.beta[..., :fs], p.beta[..., fs:]
+    mm_season, mm_reg = data.mult_mask[:fs], data.mult_mask[fs:]
+
+    add = _component(beta_season * (1.0 - mm_season), data.X_season)
+    add = add + _component(beta_reg * (1.0 - mm_reg), data.X_reg)
+    mult = _component(beta_season * mm_season, data.X_season)
+    mult = mult + _component(beta_reg * mm_reg, data.X_reg)
+    return add, mult
+
+
+def model_yhat(
+    theta: jnp.ndarray, data: FitData, config: ProphetConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched forward pass in scaled units.
+
+    Returns (yhat, trend) each (B, T):
+      yhat = trend * (1 + X_mult @ beta_mult) + X_add @ beta_add
+    """
+    p = unpack(theta, config)
+    g = trend_fn(p, data, config)
+    add, mult = seasonal_split(theta, data, config)
+    return g * (1.0 + mult) + add, g
+
+
+def prepare_fit_data(
+    ds: jnp.ndarray,
+    y: jnp.ndarray,
+    config: ProphetConfig,
+    mask: Optional[jnp.ndarray] = None,
+    cap: Optional[jnp.ndarray] = None,
+    floor: Optional[jnp.ndarray] = None,
+    regressors: Optional[jnp.ndarray] = None,
+    dtype: jnp.dtype = jnp.float32,
+) -> Tuple[FitData, ScalingMeta]:
+    """Scale, mask, and assemble a padded batch for fitting.
+
+    Args:
+      ds: (T,) shared calendar grid or (B, T) per-series grids, absolute days.
+      y:  (B, T) raw observations; NaN marks missing (merged into mask).
+      mask: optional (B, T) validity; default = finite(y).
+      cap: (B, T) capacities, required for logistic growth (data units).
+      floor: (B,) or (B, T) logistic floor, defaults to 0.
+      regressors: (B, T, R) raw external regressor values.
+
+    Returns:
+      (FitData, ScalingMeta).
+    """
+    y = jnp.asarray(y, dtype)
+    if y.ndim != 2:
+        raise ValueError(f"y must be (B, T), got {y.shape}")
+    b, t_len = y.shape
+    ds = jnp.asarray(ds, dtype)
+    ds_b = jnp.broadcast_to(ds, (b, t_len)) if ds.ndim == 1 else ds
+
+    finite = jnp.isfinite(y)
+    if mask is None:
+        mask = finite.astype(dtype)
+    else:
+        mask = jnp.asarray(mask, dtype) * finite.astype(dtype)
+    y = jnp.where(mask > 0, jnp.nan_to_num(y), 0.0)
+
+    # Per-series observed span -> scaled time in [0, 1].  Fully-masked rows
+    # (dummy padding series) fall back to the raw grid span so every
+    # downstream quantity stays finite.
+    any_obs = mask.sum(axis=-1) > 0
+    big = jnp.where(mask > 0, ds_b, jnp.inf)
+    small = jnp.where(mask > 0, ds_b, -jnp.inf)
+    ds_start = jnp.where(any_obs, jnp.min(big, axis=-1), jnp.min(ds_b, axis=-1))
+    ds_end = jnp.where(any_obs, jnp.max(small, axis=-1), jnp.max(ds_b, axis=-1))
+    # Span floor = one grid step, so degenerate (single-observation) series
+    # keep future scaled times O(1) instead of exploding.
+    grid_span = jnp.max(ds_b, axis=-1) - jnp.min(ds_b, axis=-1)
+    step = grid_span / jnp.maximum(t_len - 1, 1)
+    ds_span = jnp.maximum(ds_end - ds_start, jnp.maximum(step, 1e-9))
+    t = (ds_b - ds_start[:, None]) / ds_span[:, None]
+
+    # Per-series y scaling (Prophet absmax scaling; floor only for logistic).
+    if floor is None:
+        floor_b = jnp.zeros((b,), dtype)
+    else:
+        floor_b = jnp.asarray(floor, dtype)
+        if floor_b.ndim == 2:
+            floor_b = floor_b[:, 0]
+    y_shift = y - floor_b[:, None]
+    y_scale = jnp.max(jnp.abs(y_shift) * mask, axis=-1)
+    y_scale = jnp.maximum(y_scale, 1e-10)
+    y_s = jnp.where(mask > 0, y_shift / y_scale[:, None], 0.0)
+
+    if config.growth == "logistic":
+        if cap is None:
+            raise ValueError("logistic growth requires cap")
+        cap_s = (jnp.asarray(cap, dtype) - floor_b[:, None]) / y_scale[:, None]
+    else:
+        cap_s = jnp.ones((b, t_len), dtype)
+
+    # Changepoints: observed span maps to exactly [0, 1] in scaled time.
+    s = trend.uniform_changepoints(
+        jnp.zeros((b,), dtype),
+        jnp.ones((b,), dtype),
+        config.n_changepoints,
+        config.changepoint_range,
+    )
+
+    # Seasonal features from absolute time; shared grid -> shared matrix.
+    x_season = seasonality.seasonal_feature_matrix(
+        ds if ds.ndim == 1 else ds_b, config.seasonalities
+    ).astype(dtype)
+
+    # External regressors: per-series standardization over observed window.
+    r = config.num_regressors
+    if r:
+        if regressors is None:
+            raise ValueError(f"config declares {r} regressors but none given")
+        reg = jnp.asarray(regressors, dtype)
+        if reg.shape != (b, t_len, r):
+            raise ValueError(f"regressors shape {reg.shape} != {(b, t_len, r)}")
+        n = jnp.maximum(mask.sum(-1), 1.0)[:, None]
+        mean = (reg * mask[..., None]).sum(1) / n
+        var = (((reg - mean[:, None, :]) ** 2) * mask[..., None]).sum(1) / n
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        # Don't rescale columns the user opted out of, nor (near-)constant
+        # or binary-indicator columns (Prophet's standardize='auto' rule).
+        opt_out = jnp.asarray(
+            [not rc.standardize for rc in config.regressors], bool
+        )[None, :]
+        skip = opt_out | jnp.all(
+            (mask[..., None] == 0) | (reg == 0) | (reg == 1), axis=1
+        ) | (std < 1e-8)
+        std_eff = jnp.where(skip, 1.0, std)
+        mean_eff = jnp.where(skip, 0.0, mean)
+        x_reg = (reg - mean_eff[:, None, :]) / std_eff[:, None, :]
+    else:
+        x_reg = jnp.zeros((b, t_len, 0), dtype)
+        mean_eff = jnp.zeros((b, 0), dtype)
+        std_eff = jnp.ones((b, 0), dtype)
+
+    data = FitData(
+        t=t,
+        y=y_s,
+        mask=mask,
+        s=s,
+        cap=cap_s,
+        X_season=x_season,
+        X_reg=x_reg,
+        prior_scales=jnp.asarray(config.feature_prior_scales(), dtype),
+        mult_mask=jnp.asarray(
+            [1.0 if m else 0.0 for m in config.feature_modes()], dtype
+        ),
+    )
+    meta = ScalingMeta(
+        y_scale=y_scale,
+        floor=floor_b,
+        ds_start=ds_start,
+        ds_span=ds_span,
+        reg_mean=mean_eff,
+        reg_std=std_eff,
+    )
+    return data, meta

@@ -1,0 +1,135 @@
+"""High-level batched Prophet model: fit / predict on padded arrays.
+
+This is the array-level API the backends (backends/tpu.py, backends/cpu.py)
+and the DataFrame front-end (frame.py) sit on.  One ``fit`` call fits ALL
+series in the batch simultaneously — the TPU-native collapse of the
+reference's Spark fan-out (collect -> shard -> fit -> scatter,
+BASELINE.json:5).  The fit core is a single jitted program: design tensors
+in, MAP parameters out.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.models.prophet import predict as predict_mod
+from tsspark_tpu.models.prophet.design import (
+    FitData,
+    ScalingMeta,
+    prepare_fit_data,
+)
+from tsspark_tpu.models.prophet.loss import value_and_grad_batch
+from tsspark_tpu.models.prophet.params import init_theta
+from tsspark_tpu.ops import lbfgs
+
+
+class FitState(NamedTuple):
+    """Fitted parameters + scaling metadata + solver diagnostics (all (B,...))."""
+
+    theta: jnp.ndarray
+    meta: ScalingMeta
+    loss: jnp.ndarray
+    grad_norm: jnp.ndarray
+    converged: jnp.ndarray
+    n_iters: jnp.ndarray
+
+
+@functools.partial(jax.jit, static_argnames=("config", "solver_config"))
+def fit_core(
+    data: FitData,
+    theta0: jnp.ndarray,
+    config: ProphetConfig,
+    solver_config: SolverConfig,
+) -> lbfgs.LbfgsResult:
+    """The jitted batched MAP solve: the whole fit is one XLA program."""
+    fun = lambda th: value_and_grad_batch(th, data, config)
+    return lbfgs.minimize(fun, theta0, solver_config)
+
+
+class ProphetModel:
+    """Batched Prophet-style forecaster.
+
+    Example:
+      model = ProphetModel(ProphetConfig(seasonalities=(YEARLY, WEEKLY)))
+      state = model.fit(ds_days, y)          # y: (n_series, n_timesteps)
+      fc = model.predict(state, future_days)  # dict of (n_series, horizon)
+    """
+
+    def __init__(
+        self,
+        config: ProphetConfig = ProphetConfig(),
+        solver_config: SolverConfig = SolverConfig(),
+    ):
+        self.config = config
+        self.solver_config = solver_config
+
+    # -- fitting ---------------------------------------------------------------
+
+    def prepare(self, ds, y, **kw):
+        return prepare_fit_data(ds, y, self.config, **kw)
+
+    def fit(
+        self,
+        ds: jnp.ndarray,
+        y: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        cap: Optional[jnp.ndarray] = None,
+        floor: Optional[jnp.ndarray] = None,
+        regressors: Optional[jnp.ndarray] = None,
+        init: Optional[jnp.ndarray] = None,
+    ) -> FitState:
+        """Fit every series in the (B, T) batch.
+
+        ``init`` warm-starts the solver from previous parameters (the
+        streaming incremental-refit path, BASELINE.json:11).
+        """
+        data, meta = prepare_fit_data(
+            ds, y, self.config, mask=mask, cap=cap, floor=floor,
+            regressors=regressors,
+        )
+        theta0 = init if init is not None else init_theta(
+            self.config, data.y, data.mask, data.t
+        )
+        res = fit_core(data, theta0, self.config, self.solver_config)
+        return FitState(
+            theta=res.theta,
+            meta=meta,
+            loss=res.f,
+            grad_norm=res.grad_norm,
+            converged=res.converged,
+            n_iters=res.n_iters,
+        )
+
+    # -- prediction ------------------------------------------------------------
+
+    def predict(
+        self,
+        state: FitState,
+        ds: jnp.ndarray,
+        cap: Optional[jnp.ndarray] = None,
+        regressors: Optional[jnp.ndarray] = None,
+        seed: int = 0,
+        num_samples: Optional[int] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Forecast on an arbitrary time grid (in-sample and/or future)."""
+        data = predict_mod.prepare_predict_data(
+            ds, state.meta, self.config, cap=cap, regressors=regressors
+        )
+        key = jax.random.PRNGKey(seed)
+        return predict_mod.forecast(
+            state.theta, data, state.meta, self.config,
+            key=key, num_samples=num_samples,
+        )
+
+    def components(self, state: FitState, ds, cap=None, regressors=None):
+        data = predict_mod.prepare_predict_data(
+            ds, state.meta, self.config, cap=cap, regressors=regressors
+        )
+        return predict_mod.component_breakdown(
+            state.theta, data, state.meta, self.config
+        )

@@ -1,0 +1,112 @@
+"""Parameter layout for the batched Prophet MAP fit.
+
+The solver operates on a flat ``(batch, P)`` float array so the L-BFGS
+two-loop recursion is a handful of big fused VPU ops; this module defines the
+canonical packing  ``[k, m, log_sigma, delta[0:n_cp], beta[0:F]]``  and
+structured views into it.  Slices are static (derived from ProphetConfig), so
+everything stays jit/vmap friendly.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig
+
+
+class ProphetParams(NamedTuple):
+    """Structured view of one (or a batch of) parameter vector(s).
+
+    Shapes below are for a batch of B series; unbatched arrays drop the
+    leading axis.
+    """
+
+    k: jnp.ndarray          # (B,)   base trend growth rate
+    m: jnp.ndarray          # (B,)   trend offset
+    log_sigma: jnp.ndarray  # (B,)   log observation noise
+    delta: jnp.ndarray      # (B, n_changepoints) changepoint rate adjustments
+    beta: jnp.ndarray       # (B, F) seasonal + regressor coefficients
+
+
+def unpack(theta: jnp.ndarray, config: ProphetConfig) -> ProphetParams:
+    """Split a flat (..., P) parameter array into structured fields."""
+    n_cp = config.n_changepoints
+    f = config.num_features
+    if theta.shape[-1] != 3 + n_cp + f:
+        raise ValueError(
+            f"theta last dim {theta.shape[-1]} != expected {3 + n_cp + f}"
+        )
+    return ProphetParams(
+        k=theta[..., 0],
+        m=theta[..., 1],
+        log_sigma=theta[..., 2],
+        delta=theta[..., 3 : 3 + n_cp],
+        beta=theta[..., 3 + n_cp :],
+    )
+
+
+def pack(params: ProphetParams) -> jnp.ndarray:
+    """Inverse of :func:`unpack`."""
+    return jnp.concatenate(
+        [
+            params.k[..., None],
+            params.m[..., None],
+            params.log_sigma[..., None],
+            params.delta,
+            params.beta,
+        ],
+        axis=-1,
+    )
+
+
+def init_theta(
+    config: ProphetConfig,
+    y: jnp.ndarray,
+    mask: jnp.ndarray,
+    t: jnp.ndarray,
+) -> jnp.ndarray:
+    """Data-driven initialization, batched over series.
+
+    Mirrors Prophet's initializer: k/m from the endpoints of the (scaled)
+    series, deltas and betas at zero, sigma at the masked std of y.
+
+    Args:
+      y:    (B, T) scaled observations (already divided by per-series scale).
+      mask: (B, T) 1.0 where observed.
+      t:    (B, T) scaled time in [0, 1] (per series).
+
+    Returns:
+      (B, P) flat initial parameters.
+    """
+    eps = 1e-8
+    n = jnp.maximum(mask.sum(axis=-1), 1.0)
+
+    # First/last observed values and times per series (masked argmin/argmax).
+    big = jnp.where(mask > 0, t, jnp.inf)
+    small = jnp.where(mask > 0, t, -jnp.inf)
+    i0 = jnp.argmin(big, axis=-1)
+    i1 = jnp.argmax(small, axis=-1)
+    b_idx = jnp.arange(y.shape[0])
+    t0, t1 = t[b_idx, i0], t[b_idx, i1]
+    y0, y1 = y[b_idx, i0], y[b_idx, i1]
+
+    k0 = (y1 - y0) / jnp.maximum(t1 - t0, eps)
+    m0 = y0 - k0 * t0
+
+    mean = (y * mask).sum(axis=-1) / n
+    var = (((y - mean[:, None]) ** 2) * mask).sum(axis=-1) / n
+    sigma0 = jnp.sqrt(jnp.maximum(var, eps))
+    log_sigma0 = jnp.log(jnp.maximum(sigma0, 1e-3))
+
+    batch = y.shape[0]
+    return pack(
+        ProphetParams(
+            k=k0,
+            m=m0,
+            log_sigma=log_sigma0,
+            delta=jnp.zeros((batch, config.n_changepoints), y.dtype),
+            beta=jnp.zeros((batch, config.num_features), y.dtype),
+        )
+    )

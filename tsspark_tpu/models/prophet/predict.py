@@ -1,0 +1,221 @@
+"""Forecasting: point predictions, component decomposition, and uncertainty.
+
+Uncertainty follows the public Prophet recipe: the MAP fit is a point
+estimate, so predictive intervals come from simulating future trend
+changepoints (same frequency as history, delta magnitudes ~ Laplace with the
+MLE scale of the fitted deltas) plus Gaussian observation noise, then taking
+quantiles over samples.  All simulation is batched: one jitted program draws
+``(S, B, T_future)`` trend paths with no Python loops over samples or series
+(the reference runs this per-series inside its Spark UDF; BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig
+from tsspark_tpu.models.prophet import seasonality, trend
+from tsspark_tpu.models.prophet.design import (
+    FitData,
+    ScalingMeta,
+    _component,
+    model_yhat,
+    seasonal_split,
+    trend_fn,
+)
+from tsspark_tpu.models.prophet.params import unpack
+
+
+def prepare_predict_data(
+    ds: jnp.ndarray,
+    meta: ScalingMeta,
+    config: ProphetConfig,
+    cap: Optional[jnp.ndarray] = None,
+    regressors: Optional[jnp.ndarray] = None,
+    dtype: jnp.dtype = jnp.float32,
+) -> FitData:
+    """Assemble design tensors for a (future or in-sample) time grid.
+
+    Scalings are the *training* scalings from ``meta`` — predictions must be
+    produced in the same parameter space the model was fit in.
+    """
+    ds = jnp.asarray(ds, dtype)
+    b = meta.y_scale.shape[0]
+    ds_b = jnp.broadcast_to(ds, (b,) + ds.shape[-1:]) if ds.ndim == 1 else ds
+    t_len = ds_b.shape[-1]
+    t = (ds_b - meta.ds_start[:, None]) / meta.ds_span[:, None]
+
+    if config.growth == "logistic":
+        if cap is None:
+            raise ValueError("logistic growth requires cap at predict time")
+        cap_s = (jnp.asarray(cap, dtype) - meta.floor[:, None]) / meta.y_scale[:, None]
+    else:
+        cap_s = jnp.ones((b, t_len), dtype)
+
+    x_season = seasonality.seasonal_feature_matrix(
+        ds if ds.ndim == 1 else ds_b, config.seasonalities
+    ).astype(dtype)
+
+    r = config.num_regressors
+    if r:
+        if regressors is None:
+            raise ValueError(f"config declares {r} regressors but none given")
+        reg = jnp.asarray(regressors, dtype)
+        x_reg = (reg - meta.reg_mean[:, None, :]) / meta.reg_std[:, None, :]
+    else:
+        x_reg = jnp.zeros((b, t_len, 0), dtype)
+
+    s = trend.uniform_changepoints(
+        jnp.zeros((b,), dtype),
+        jnp.ones((b,), dtype),
+        config.n_changepoints,
+        config.changepoint_range,
+    )
+    return FitData(
+        t=t,
+        y=jnp.zeros((b, t_len), dtype),
+        mask=jnp.zeros((b, t_len), dtype),
+        s=s,
+        cap=cap_s,
+        X_season=x_season,
+        X_reg=x_reg,
+        prior_scales=jnp.asarray(config.feature_prior_scales(), dtype),
+        mult_mask=jnp.asarray(
+            [1.0 if m else 0.0 for m in config.feature_modes()], dtype
+        ),
+    )
+
+
+def component_breakdown(
+    theta: jnp.ndarray, data: FitData, meta: ScalingMeta, config: ProphetConfig
+) -> Dict[str, jnp.ndarray]:
+    """Per-block components in data units (additive) / relative units (mult)."""
+    p = unpack(theta, config)
+    out: Dict[str, jnp.ndarray] = {}
+    offset = 0
+    scale = meta.y_scale[:, None]
+    for s_cfg in config.seasonalities:
+        nf = s_cfg.num_features
+        beta_blk = jnp.zeros_like(p.beta).at[..., offset : offset + nf].set(
+            p.beta[..., offset : offset + nf]
+        )
+        blk = _component(beta_blk[..., : config.num_seasonal_features], data.X_season)
+        out[s_cfg.name] = blk * (1.0 if s_cfg.mode == "multiplicative" else scale)
+        offset += nf
+    for i, r_cfg in enumerate(config.regressors):
+        col = p.beta[..., config.num_seasonal_features + i]
+        blk = col[:, None] * data.X_reg[..., i]
+        out[r_cfg.name] = blk * (1.0 if r_cfg.mode == "multiplicative" else scale)
+    return out
+
+
+def _simulate_trends(
+    key: jax.Array,
+    theta: jnp.ndarray,
+    data: FitData,
+    config: ProphetConfig,
+    num_samples: int,
+) -> jnp.ndarray:
+    """(S, B, T) scaled trend sample paths with simulated future changepoints."""
+    p = unpack(theta, config)
+    b, t_len = data.t.shape
+    future = (data.t > 1.0).astype(data.t.dtype)  # (B, T)
+
+    # Mean spacing of future points (scaled units) -> per-step changepoint
+    # probability matching the historical changepoint frequency (n_cp per
+    # unit of scaled time).
+    dt = jnp.diff(data.t, axis=-1, prepend=data.t[..., :1])
+    mean_dt = (dt * future).sum(-1) / jnp.maximum(future.sum(-1), 1.0)
+    cp_prob = jnp.clip(config.n_changepoints * mean_dt, 0.0, 1.0)  # (B,)
+
+    # Laplace MLE scale of fitted deltas (Prophet's lambda), per series.
+    if config.n_changepoints:
+        lam = jnp.abs(p.delta).mean(-1)
+    else:
+        lam = jnp.zeros((b,), data.t.dtype)
+    lam = jnp.maximum(lam, 1e-8)
+
+    k_bern, k_lap = jax.random.split(key)
+    ind = (
+        jax.random.uniform(k_bern, (num_samples, b, t_len)) < cp_prob[None, :, None]
+    ).astype(data.t.dtype) * future[None]
+    lap = jax.random.laplace(k_lap, (num_samples, b, t_len)) * lam[None, :, None]
+    new_delta = ind * lap  # (S, B, T)
+
+    det = trend_fn(p, data, config)  # (B, T) deterministic trend
+
+    if config.growth == "linear":
+        # Slope change delta_j at future grid point t_j adds
+        # delta_j * (t - t_j) for t >= t_j:  t*cumsum(d) - cumsum(d*t).
+        c = jnp.cumsum(new_delta, axis=-1)
+        d = jnp.cumsum(new_delta * data.t[None], axis=-1)
+        return det[None] + data.t[None] * c - d
+    if config.growth == "logistic":
+        # Full recompute with history + sampled future changepoints.  The
+        # concatenated changepoint vector must stay sorted even when the
+        # prediction grid includes in-sample times (t <= 1): in-sample
+        # positions carry delta == 0 (the `future` mask above), so clamping
+        # them to just past the history keeps the array sorted without
+        # changing the trend.  History changepoints live in [0, 1).
+        t_clamped = jnp.maximum(data.t, 1.0 + 1e-6)
+        s_ext = jnp.concatenate(
+            [jnp.broadcast_to(data.s, (num_samples,) + data.s.shape),
+             jnp.broadcast_to(t_clamped[None], new_delta.shape)],
+            axis=-1,
+        )
+        d_ext = jnp.concatenate(
+            [jnp.broadcast_to(p.delta, (num_samples,) + p.delta.shape), new_delta],
+            axis=-1,
+        )
+        sim = jax.vmap(
+            lambda dd, ss: trend.logistic(data.t, data.cap, p.k, p.m, dd, ss)
+        )(d_ext, s_ext)
+        return sim
+    return jnp.broadcast_to(det[None], (num_samples,) + det.shape)
+
+
+def forecast(
+    theta: jnp.ndarray,
+    data: FitData,
+    meta: ScalingMeta,
+    config: ProphetConfig,
+    key: Optional[jax.Array] = None,
+    num_samples: Optional[int] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Point forecast + components + predictive intervals, in data units.
+
+    Returns a dict with "yhat", "trend", "additive", "multiplicative",
+    and (when sampling) "yhat_lower"/"yhat_upper"/"trend_lower"/"trend_upper",
+    all (B, T).
+    """
+    p = unpack(theta, config)
+    yhat_s, trend_s = model_yhat(theta, data, config)
+    scale = meta.y_scale[:, None]
+    floor = meta.floor[:, None]
+    out = {
+        "yhat": yhat_s * scale + floor,
+        "trend": trend_s * scale + floor,
+    }
+    add, mult = seasonal_split(theta, data, config)
+    out["additive"] = add * scale
+    out["multiplicative"] = mult
+
+    n_s = config.uncertainty_samples if num_samples is None else num_samples
+    if n_s and key is not None:
+        k_tr, k_noise = jax.random.split(key)
+        trends = _simulate_trends(k_tr, theta, data, config, n_s)  # (S, B, T)
+        sigma = jnp.exp(p.log_sigma)[None, :, None]
+        noise = jax.random.normal(k_noise, trends.shape) * sigma
+        samples = trends * (1.0 + mult[None]) + add[None] + noise
+        lo_q = (1.0 - config.interval_width) / 2.0
+        hi_q = 1.0 - lo_q
+        qs = jnp.quantile(samples, jnp.asarray([lo_q, hi_q]), axis=0)
+        out["yhat_lower"] = qs[0] * scale + floor
+        out["yhat_upper"] = qs[1] * scale + floor
+        t_qs = jnp.quantile(trends, jnp.asarray([lo_q, hi_q]), axis=0)
+        out["trend_lower"] = t_qs[0] * scale + floor
+        out["trend_upper"] = t_qs[1] * scale + floor
+    return out

@@ -1,0 +1,85 @@
+"""Fourier seasonality features.
+
+Seasonal features are a function of *absolute* time (days since a fixed
+epoch), not per-series scaled time, so for a batch of series sharing one
+calendar grid the feature matrix is a single shared (T, F) array — the
+seasonal component of every series is then one (B, F) @ (F, T) matmul on the
+MXU instead of B independent matvecs (the reference fans these out per-series
+through Spark executors; see BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig
+
+
+def fourier_features(
+    t_days: jnp.ndarray, period: float, order: int
+) -> jnp.ndarray:
+    """Fourier basis for one seasonality block.
+
+    Args:
+      t_days: (..., T) time in days since a fixed epoch.
+      period: period in days.
+      order:  number of harmonics K.
+
+    Returns:
+      (..., T, 2K) features [sin(2pi*1*t/p), cos(2pi*1*t/p), ..., sin(2pi*K*t/p),
+      cos(2pi*K*t/p)].
+    """
+    n = jnp.arange(1, order + 1, dtype=t_days.dtype)
+    # (..., T, K) angles; fold t into [0, period) first so float32 keeps phase
+    # precision even for large day counts.
+    t_mod = jnp.mod(t_days, period)
+    angles = 2.0 * jnp.pi * t_mod[..., None] * n / period
+    feats = jnp.stack([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+    return feats.reshape(feats.shape[:-2] + (2 * order,))
+
+
+def seasonal_feature_matrix(
+    t_days: jnp.ndarray, seasonalities: Sequence[SeasonalityConfig]
+) -> jnp.ndarray:
+    """Concatenate all seasonality blocks into one (..., T, F_seasonal) matrix."""
+    if not seasonalities:
+        return jnp.zeros(t_days.shape + (0,), t_days.dtype)
+    blocks = [
+        fourier_features(t_days, s.period, s.fourier_order) for s in seasonalities
+    ]
+    return jnp.concatenate(blocks, axis=-1)
+
+
+def feature_matrix(
+    t_days: jnp.ndarray,
+    config: ProphetConfig,
+    regressors: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Full design matrix: Fourier seasonal columns + external regressor columns.
+
+    Args:
+      t_days: (..., T) absolute days.
+      regressors: (..., T, R) standardized external regressor values (holiday
+        indicators and covariates), or None when config.regressors is empty.
+
+    Returns:
+      (..., T, F) with F == config.num_features, column order matching
+      config.feature_prior_scales() / config.feature_modes().
+    """
+    x = seasonal_feature_matrix(t_days, config.seasonalities)
+    r = config.num_regressors
+    if r:
+        if regressors is None:
+            raise ValueError(
+                f"config declares {r} regressors but no regressor values given"
+            )
+        if regressors.shape[-1] != r:
+            raise ValueError(
+                f"regressors last dim {regressors.shape[-1]} != {r} declared"
+            )
+        x = jnp.concatenate([x, regressors.astype(x.dtype)], axis=-1)
+    elif regressors is not None and regressors.shape[-1] != 0:
+        raise ValueError("regressor values given but config declares none")
+    return x

@@ -1,0 +1,159 @@
+"""Trend functions: piecewise-linear, logistic-growth-with-cap, and flat.
+
+TPU-first design: the classic Prophet formulation materializes a changepoint
+indicator matrix ``A`` with shape (T, n_changepoints) and computes
+``A @ delta``.  Batched over 30k series that would be a (B, T, n_cp) tensor
+(gigabytes of HBM traffic for what is a step function).  Instead we exploit
+that changepoints are sorted: the active slope at time t is
+``k + cumsum(delta)[searchsorted(s, t)]`` — a (B, n_cp) cumulative sum plus a
+(B, T) gather.  This keeps HBM traffic at O(B*T) and leaves the MXU free for
+the seasonal matmul.  Gradients flow through the gather as a scatter-add,
+which XLA handles natively.
+
+Parity target: the trend family of the reference's ``tsspark.fit.prophet``
+(piecewise-linear + logistic-growth caps, BASELINE.json:5).  The reference
+source is unavailable (SURVEY.md §0), so semantics follow the public Prophet
+model definition.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def changepoint_index(t: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Number of changepoints at or before each time.
+
+    Args:
+      t: (B, T) scaled times.
+      s: (B, n_cp) *sorted* changepoint locations in scaled time.
+
+    Returns:
+      (B, T) int32 index into [0, n_cp].
+    """
+    if s.shape[-1] == 0:
+        return jnp.zeros(t.shape, dtype=jnp.int32)
+    return jax.vmap(
+        lambda tt, ss: jnp.searchsorted(ss, tt, side="right").astype(jnp.int32)
+    )(t, s)
+
+
+def _gathered_cumsum(values: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """cumsum(values) prefixed with 0, gathered at idx.
+
+    values: (B, n_cp); idx: (B, T) in [0, n_cp] -> (B, T).
+    """
+    csum = jnp.cumsum(values, axis=-1)
+    padded = jnp.concatenate([jnp.zeros_like(csum[..., :1]), csum], axis=-1)
+    return jnp.take_along_axis(padded, idx, axis=-1)
+
+
+def piecewise_linear(
+    t: jnp.ndarray,
+    k: jnp.ndarray,
+    m: jnp.ndarray,
+    delta: jnp.ndarray,
+    s: jnp.ndarray,
+) -> jnp.ndarray:
+    """g(t) = (k + sum_{j: s_j <= t} delta_j) * t + (m + sum gamma_j),
+    gamma_j = -s_j * delta_j  (keeps the trend continuous at changepoints).
+
+    Shapes: t (B, T); k, m (B,); delta, s (B, n_cp).  Returns (B, T).
+    """
+    idx = changepoint_index(t, s)
+    slope = k[..., None] + _gathered_cumsum(delta, idx)
+    offset = m[..., None] + _gathered_cumsum(-s * delta, idx)
+    return slope * t + offset
+
+
+def _logistic_gamma(
+    k: jnp.ndarray, m: jnp.ndarray, delta: jnp.ndarray, s: jnp.ndarray
+) -> jnp.ndarray:
+    """Offset adjustments keeping the logistic trend continuous.
+
+    Sequential recursion over changepoints (public Prophet definition):
+      gamma_j = (s_j - m - sum_{l<j} gamma_l) * (1 - k_{j-1} / k_j)
+    with k_j = k + sum_{l<=j} delta_l.  n_cp is small (default 25) so a
+    lax.scan over changepoints costs nothing; everything inside is batched
+    over series.
+    """
+    eps = 1e-10
+
+    def safe_div(a, b):
+        return a / jnp.where(jnp.abs(b) < eps, jnp.where(b < 0, -eps, eps), b)
+
+    k_cum = k[..., None] + jnp.concatenate(
+        [jnp.zeros_like(delta[..., :1]), jnp.cumsum(delta, axis=-1)], axis=-1
+    )  # (B, n_cp + 1)
+
+    def step(gamma_sum, inputs):
+        s_j, k_prev, k_next = inputs
+        gamma_j = (s_j - m - gamma_sum) * (1.0 - safe_div(k_prev, k_next))
+        return gamma_sum + gamma_j, gamma_j
+
+    n_cp = delta.shape[-1]
+    xs = (
+        jnp.moveaxis(s, -1, 0),               # (n_cp, B)
+        jnp.moveaxis(k_cum[..., :-1], -1, 0),  # k_{j-1}
+        jnp.moveaxis(k_cum[..., 1:], -1, 0),   # k_j
+    )
+    _, gammas = jax.lax.scan(step, jnp.zeros_like(m), xs, length=n_cp)
+    return jnp.moveaxis(gammas, 0, -1)  # (B, n_cp)
+
+
+def logistic(
+    t: jnp.ndarray,
+    cap: jnp.ndarray,
+    k: jnp.ndarray,
+    m: jnp.ndarray,
+    delta: jnp.ndarray,
+    s: jnp.ndarray,
+) -> jnp.ndarray:
+    """Logistic growth trend with (possibly time-varying) capacity.
+
+    g(t) = cap(t) / (1 + exp(-(k + A(t)delta) * (t - (m + A(t)gamma)))).
+
+    Shapes: t, cap (B, T); k, m (B,); delta, s (B, n_cp).  Returns (B, T).
+    """
+    idx = changepoint_index(t, s)
+    rate = k[..., None] + _gathered_cumsum(delta, idx)
+    if delta.shape[-1] > 0:
+        gamma = _logistic_gamma(k, m, delta, s)
+        offset = m[..., None] + _gathered_cumsum(gamma, idx)
+    else:
+        offset = m[..., None] * jnp.ones_like(t)
+    return cap * jax.nn.sigmoid(rate * (t - offset))
+
+
+def flat(t: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Constant trend g(t) = m."""
+    return jnp.broadcast_to(m[..., None], t.shape).astype(t.dtype)
+
+
+def uniform_changepoints(
+    t_first: jnp.ndarray,
+    t_last: jnp.ndarray,
+    n_changepoints: int,
+    changepoint_range: float,
+) -> jnp.ndarray:
+    """Per-series changepoint grid, uniform over the first
+    ``changepoint_range`` fraction of each series' observed span.
+
+    Prophet places changepoints at quantiles of observed timestamps; for
+    regularly sampled series (the M4/M5 cases) a uniform grid over the
+    observed span is identical up to sampling jitter, and it is batchable
+    with zero gathers.
+
+    Args:
+      t_first, t_last: (B,) scaled time of first/last observation.
+    Returns:
+      (B, n_changepoints) sorted changepoints.
+    """
+    if n_changepoints == 0:
+        return jnp.zeros(t_first.shape + (0,), t_first.dtype)
+    span = (t_last - t_first) * changepoint_range
+    # Fractions in (0, 1]: skip 0 so the first changepoint is strictly after
+    # the first observation (a changepoint at t_first is unidentifiable).
+    fracs = jnp.arange(1, n_changepoints + 1, dtype=t_first.dtype) / n_changepoints
+    return t_first[..., None] + span[..., None] * fracs[None, :]

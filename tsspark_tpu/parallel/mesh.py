@@ -1,0 +1,46 @@
+"""Device-mesh construction for sharded batch fitting.
+
+The fit workload is data-parallel over series (the TPU-native analog of the
+reference's Spark partition fan-out, BASELINE.json:5) with optional
+sequence parallelism over the time axis for very long series: a 2-D
+``(series, time)`` mesh.  Collectives ride ICI within a host and DCN across
+hosts — XLA inserts them from the sharding annotations; nothing here issues
+explicit collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from tsspark_tpu.config import ShardingConfig
+
+
+def make_mesh(
+    n_series_shards: Optional[int] = None,
+    n_time_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    config: ShardingConfig = ShardingConfig(),
+) -> Mesh:
+    """Build a (series, time) mesh over the available devices.
+
+    Defaults put every device on the series axis — the right layout for the
+    M5-style many-short-series regime.  ``n_time_shards > 1`` trades series
+    parallelism for sequence parallelism (long-series regime).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n_series_shards is None:
+        if n % n_time_shards:
+            raise ValueError(f"{n} devices not divisible by time={n_time_shards}")
+        n_series_shards = n // n_time_shards
+    if n_series_shards * n_time_shards != n:
+        raise ValueError(
+            f"mesh {n_series_shards}x{n_time_shards} != {n} devices"
+        )
+    arr = np.asarray(devices).reshape(n_series_shards, n_time_shards)
+    time_axis = config.time_axis or "time"
+    return Mesh(arr, (config.series_axis, time_axis))
