@@ -24,10 +24,11 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 
+from tsspark_tpu.utils.platform import honor_env_platforms
+
 # sitecustomize force-selects the axon TPU platform; honor an explicit
 # JAX_PLATFORMS env override (e.g. CPU pipeline smoke checks).
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+honor_env_platforms()
 
 # Persistent compile cache: repeat benches skip XLA compilation, matching the
 # steady-state serving pattern (the reference's JVM also amortizes JIT).

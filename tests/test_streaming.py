@@ -1,0 +1,187 @@
+"""Streaming incremental refit (eval config 5 analog): param store,
+checkpoint round-trip, warm-start space transfer, and the micro-batch loop."""
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.data import datasets
+from tsspark_tpu.models.prophet.design import prepare_fit_data
+from tsspark_tpu.models.prophet.loss import neg_log_posterior
+from tsspark_tpu.models.prophet.model import ProphetModel
+from tsspark_tpu.models.prophet.params import init_theta
+from tsspark_tpu.streaming.driver import StreamingForecaster
+from tsspark_tpu.streaming.source import InMemorySource, KafkaSource
+from tsspark_tpu.streaming.state import ParamStore
+from tsspark_tpu.streaming.warmstart import transfer_theta
+from tsspark_tpu.utils import checkpoint as ckpt
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=5
+)
+
+
+def _series_df(n_days, sid="s0", seed=0, start_day=0):
+    rng = np.random.default_rng(seed)
+    t = np.arange(start_day, start_day + n_days, dtype=float)
+    y = 10 + 0.02 * t + 1.5 * np.sin(2 * np.pi * t / 7) + rng.normal(0, 0.1, n_days)
+    return pd.DataFrame({"series_id": sid, "ds": t, "y": y})
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    model = ProphetModel(CFG, SolverConfig(max_iters=60))
+    df = _series_df(200)
+    state = model.fit(df.ds.to_numpy(), jnp.asarray(df.y.to_numpy()[None, :]))
+    path = str(tmp_path / "ck")
+    ckpt.save_state(path, state, CFG, series_ids=np.asarray(["s0"]))
+    loaded, ids = ckpt.load_state(path, CFG)
+    np.testing.assert_allclose(
+        np.asarray(loaded.theta), np.asarray(state.theta), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(loaded.meta.y_scale), np.asarray(state.meta.y_scale)
+    )
+    assert list(ids) == ["s0"]
+
+
+def test_checkpoint_fingerprint_mismatch(tmp_path):
+    model = ProphetModel(CFG, SolverConfig(max_iters=30))
+    df = _series_df(100)
+    state = model.fit(df.ds.to_numpy(), jnp.asarray(df.y.to_numpy()[None, :]))
+    path = str(tmp_path / "ck")
+    ckpt.save_state(path, state, CFG)
+    other = ProphetConfig(seasonalities=(), n_changepoints=5)
+    with pytest.raises(ValueError):
+        ckpt.load_state(path, other)
+
+
+def test_param_store_lookup_mask():
+    store = ParamStore(CFG)
+    model = ProphetModel(CFG, SolverConfig(max_iters=30))
+    df = _series_df(100)
+    state = model.fit(df.ds.to_numpy(), jnp.asarray(df.y.to_numpy()[None, :]))
+    store.update(["s0"], state)
+    theta, meta, found = store.lookup(["s0", "unknown"])
+    assert found.tolist() == [True, False]
+    np.testing.assert_allclose(np.asarray(theta[0]), np.asarray(state.theta[0]))
+    assert "s0" in store and "unknown" not in store
+
+
+def test_warmstart_transfer_preserves_fit():
+    """Transfer old params onto extended data: the transferred theta must
+    score a loss close to a fresh converged fit on the new data — i.e. the
+    space mapping is right, not just 'some init'."""
+    df_old = _series_df(400)
+    df_new = _series_df(460)  # 60 more days: scalings + changepoints move
+    model = ProphetModel(CFG, SolverConfig(max_iters=300))
+
+    old = model.fit(df_old.ds.to_numpy(), jnp.asarray(df_old.y.to_numpy()[None, :]))
+    data_new, meta_new = prepare_fit_data(
+        jnp.asarray(df_new.ds.to_numpy()),
+        jnp.asarray(df_new.y.to_numpy()[None, :]), CFG,
+    )
+    warm = transfer_theta(old.theta, old.meta, meta_new, CFG)
+    fresh = model.fit(df_new.ds.to_numpy(), jnp.asarray(df_new.y.to_numpy()[None, :]))
+
+    f_warm = float(neg_log_posterior(warm, data_new, CFG)[0])
+    f_fresh = float(fresh.loss[0])
+    f_cold = float(
+        neg_log_posterior(
+            init_theta(CFG, data_new.y, data_new.mask, data_new.t),
+            data_new, CFG,
+        )[0]
+    )
+    # Warm init must be far closer to the optimum than the cold init.
+    assert f_warm < f_cold - 0.5 * (f_cold - f_fresh), (f_warm, f_cold, f_fresh)
+
+
+def test_streaming_loop_warm_starts_and_improves():
+    df_full = _series_df(360, seed=3)
+    batches = [
+        df_full.iloc[:300],
+        df_full.iloc[300:330],
+        df_full.iloc[330:360],
+    ]
+    sf = StreamingForecaster(
+        CFG, SolverConfig(max_iters=60), backend="tpu", chunk_size=1
+    )
+    stats = sf.run(InMemorySource(batches))
+    assert stats.micro_batches == 3
+    assert stats.cold_starts == 1      # first sight of s0
+    assert stats.warm_starts == 2      # subsequent refits warm-start
+    fc = sf.forecast(["s0"], horizon=14, num_samples=0)
+    assert len(fc) == 14
+    t = fc.ds.to_numpy()
+    want = 10 + 0.02 * t + 1.5 * np.sin(2 * np.pi * t / 7)
+    assert np.abs(fc.yhat.to_numpy() - want).mean() < 0.5
+
+
+def test_streaming_multi_series_and_new_series_midstream():
+    b1 = pd.concat([_series_df(120, "a", 1), _series_df(120, "b", 2)])
+    b2 = pd.concat([
+        _series_df(30, "a", 1, start_day=120),
+        _series_df(150, "c", 4),  # new series appears mid-stream
+    ])
+    sf = StreamingForecaster(CFG, SolverConfig(max_iters=40), backend="tpu")
+    sf.run(InMemorySource([b1, b2]))
+    assert len(sf.store) == 3
+    fc = sf.forecast(["a", "b", "c"], horizon=7, num_samples=0)
+    assert set(fc.series_id.unique()) == {"a", "b", "c"}
+    with pytest.raises(KeyError):
+        sf.forecast(["nope"], horizon=3)
+
+
+def test_kafka_source_gated():
+    with pytest.raises(ImportError):
+        KafkaSource("topic")
+
+
+def test_param_store_persistence(tmp_path):
+    sf = StreamingForecaster(CFG, SolverConfig(max_iters=40), backend="tpu")
+    sf.run(InMemorySource([_series_df(150, "x", 9)]))
+    path = str(tmp_path / "store")
+    sf.store.save(path)
+    restored = ParamStore.load(path, CFG)
+    assert "x" in restored
+    theta, _, found = restored.lookup(["x"])
+    np.testing.assert_allclose(
+        np.asarray(theta[0]), np.asarray(sf.store.lookup(["x"])[0][0])
+    )
+
+
+def test_warmstart_transfer_window_slide():
+    """When the history window slides (old changepoints fall before the new
+    window start), the transferred params must reproduce the same data-unit
+    trend on the overlapping days."""
+    from tsspark_tpu.models.prophet import predict as predict_mod
+
+    df = _series_df(500, seed=7)
+    model = ProphetModel(CFG, SolverConfig(max_iters=300))
+    old = model.fit(
+        df.ds.to_numpy()[:400], jnp.asarray(df.y.to_numpy()[None, :400])
+    )
+    # New window: days 150..499 (start slides forward 150, end extends 100).
+    ds_new = df.ds.to_numpy()[150:]
+    _, meta_new = prepare_fit_data(
+        jnp.asarray(ds_new), jnp.asarray(df.y.to_numpy()[None, 150:]), CFG
+    )
+    warm = transfer_theta(old.theta, old.meta, meta_new, CFG)
+
+    overlap = df.ds.to_numpy()[150:400]
+    fc_old = predict_mod.forecast(
+        old.theta,
+        predict_mod.prepare_predict_data(jnp.asarray(overlap), old.meta, CFG),
+        old.meta, CFG,
+    )
+    fc_new = predict_mod.forecast(
+        warm,
+        predict_mod.prepare_predict_data(jnp.asarray(overlap), meta_new, CFG),
+        meta_new, CFG,
+    )
+    # Trend (data units) must carry over; tolerance covers changepoint-grid
+    # quantization between the two windows.
+    err = np.abs(np.asarray(fc_old["trend"] - fc_new["trend"]))
+    scale = float(np.abs(np.asarray(fc_old["trend"])).mean())
+    assert err.max() / scale < 0.05, err.max() / scale

@@ -1,0 +1,42 @@
+"""Structured logging, profiling timers, and checkpoint utilities."""
+
+import json
+import logging
+
+from tsspark_tpu.utils.logging import get_logger, timed
+from tsspark_tpu.utils.profiling import Timers
+
+
+def _last_json_line(err: str) -> dict:
+    # Other libraries (jax, absl) also write to stderr; take our JSON line.
+    lines = [l for l in err.strip().splitlines() if l.startswith("{")]
+    return json.loads(lines[-1])
+
+
+def test_structured_logger_json_lines(capsys):
+    log = get_logger("tsspark.test")
+    log.info("fit_done", n_series=42, seconds=1.25)
+    payload = _last_json_line(capsys.readouterr().err)
+    assert payload["event"] == "fit_done"
+    assert payload["n_series"] == 42
+    assert payload["level"] == "info"
+
+
+def test_timed_context(capsys):
+    log = get_logger("tsspark.test2")
+    with timed(log, "block", tag="x"):
+        pass
+    payload = _last_json_line(capsys.readouterr().err)
+    assert payload["event"] == "block"
+    assert payload["tag"] == "x"
+    assert payload["seconds"] >= 0
+
+
+def test_timers_accumulate():
+    t = Timers()
+    for _ in range(3):
+        with t.section("fit"):
+            pass
+    s = t.summary()
+    assert s["fit"]["count"] == 3
+    assert s["fit"]["total_s"] >= 0

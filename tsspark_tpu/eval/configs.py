@@ -1,0 +1,210 @@
+"""Runners for the five driver evaluation configs (BASELINE.json:6-12).
+
+Each runner builds its dataset (synthetic stand-ins — zero-egress machine,
+see data/datasets.py), fits through the requested backend, and returns
+headline metrics.  ``scale`` shrinks datasets for smoke runs; 1.0 is the
+full driver-defined size.
+
+Usage:  python -m tsspark_tpu.eval.configs [config_number|all] [--scale S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import (
+    ProphetConfig,
+    RegressorConfig,
+    SeasonalityConfig,
+    SolverConfig,
+)
+from tsspark_tpu.data import datasets
+from tsspark_tpu.eval import metrics
+from tsspark_tpu.streaming.driver import StreamingForecaster
+from tsspark_tpu.streaming.source import InMemorySource
+
+
+def _fit_and_score(cfg, batch, backend, solver, holdout_frac=0.1, **fit_kw):
+    """Fit on the head of each series, sMAPE on (a) train and (b) holdout."""
+    t_len = batch.y.shape[1]
+    split = int(t_len * (1 - holdout_frac))
+    sl = lambda a: None if a is None else jnp.asarray(a[:, :split])
+    bk = get_backend(backend, cfg, solver)
+
+    t0 = time.time()
+    state = bk.fit(
+        jnp.asarray(batch.ds[:split]),
+        jnp.asarray(np.nan_to_num(batch.y[:, :split])),
+        mask=jnp.asarray(batch.mask[:, :split]),
+        cap=sl(batch.cap),
+        regressors=None if batch.regressors is None
+        else jnp.asarray(batch.regressors[:, :split]),
+        **fit_kw,
+    )
+    jax.block_until_ready(state.theta)
+    fit_s = time.time() - t0
+
+    fc = bk.predict(
+        state,
+        jnp.asarray(batch.ds),
+        cap=None if batch.cap is None else jnp.asarray(batch.cap),
+        regressors=None if batch.regressors is None
+        else jnp.asarray(batch.regressors),
+        num_samples=0,
+    )
+    y = jnp.asarray(np.nan_to_num(batch.y))
+    m_train = jnp.asarray(batch.mask).at[:, split:].set(0.0)
+    m_hold = jnp.asarray(batch.mask).at[:, :split].set(0.0)
+    return {
+        "fit_seconds": round(fit_s, 3),
+        "n_series": int(batch.y.shape[0]),
+        "n_timesteps": int(split),
+        "smape_train": round(float(metrics.smape(y, fc["yhat"], m_train).mean()), 3),
+        "smape_holdout": round(float(metrics.smape(y, fc["yhat"], m_hold).mean()), 3),
+        "converged_frac": round(float(np.asarray(state.converged).mean()), 3),
+    }
+
+
+def config1_peyton(backend="tpu", scale=1.0) -> Dict:
+    """Additive fit, single daily series (CPU-backend reference config)."""
+    batch = datasets.peyton_manning_like(n_days=max(200, int(2905 * scale)))
+    cfg = ProphetConfig(
+        seasonalities=(
+            SeasonalityConfig("yearly", 365.25, 10),
+            SeasonalityConfig("weekly", 7.0, 3),
+        ),
+        n_changepoints=25,
+    )
+    return _fit_and_score(cfg, batch, backend, SolverConfig(max_iters=200))
+
+
+def config2_m4_hourly(backend="tpu", scale=1.0) -> Dict:
+    """Batched additive fit, weekly+daily seasonality, 414 hourly series."""
+    batch = datasets.m4_hourly_like(n_series=max(4, int(414 * scale)))
+    cfg = ProphetConfig(
+        seasonalities=(
+            SeasonalityConfig("daily", 1.0, 4),
+            SeasonalityConfig("weekly", 7.0, 3),
+        ),
+        n_changepoints=10,
+    )
+    return _fit_and_score(cfg, batch, backend, SolverConfig(max_iters=150))
+
+
+def config3_m5(backend="tpu", scale=1.0) -> Dict:
+    """M5 retail with holiday + external regressors (the headline config)."""
+    batch = datasets.m5_like(n_series=max(8, int(30490 * scale)))
+    cfg = ProphetConfig(
+        seasonalities=(
+            SeasonalityConfig("yearly", 365.25, 8),
+            SeasonalityConfig("weekly", 7.0, 3),
+        ),
+        regressors=(
+            RegressorConfig("holiday", standardize=False),
+            RegressorConfig("price"),
+            RegressorConfig("promo", standardize=False),
+        ),
+        n_changepoints=25,
+    )
+    return _fit_and_score(cfg, batch, backend, SolverConfig(max_iters=120))
+
+
+def config4_wiki_logistic(backend="tpu", scale=1.0) -> Dict:
+    """Logistic growth with capacity, multiplicative seasonality."""
+    batch = datasets.wiki_logistic_like(n_series=max(2, int(8 * scale)))
+    cfg = ProphetConfig(
+        growth="logistic",
+        seasonalities=(
+            SeasonalityConfig("weekly", 7.0, 3, mode="multiplicative"),
+        ),
+        n_changepoints=15,
+    )
+    return _fit_and_score(cfg, batch, backend, SolverConfig(max_iters=200))
+
+
+def config5_streaming(backend="tpu", scale=1.0) -> Dict:
+    """Kafka-style micro-batch incremental refit with warm starts."""
+    import pandas as pd
+
+    n_days = max(150, int(730 * scale))
+    n_series = max(2, int(50 * scale))
+    rng = np.random.default_rng(11)
+    frames = []
+    for i in range(n_series):
+        t = np.arange(n_days, dtype=float)
+        y = (
+            20 * (i + 1)
+            + 0.05 * t
+            + 3 * np.sin(2 * np.pi * t / 7)
+            + rng.normal(0, 0.5, n_days)
+        )
+        frames.append(pd.DataFrame({"series_id": f"s{i}", "ds": t, "y": y}))
+    df = pd.concat(frames)
+    warm_len = int(n_days * 0.7)
+    micro = int(n_days * 0.1)
+    batches = [df[df.ds < warm_len]] + [
+        df[(df.ds >= warm_len + k * micro) & (df.ds < warm_len + (k + 1) * micro)]
+        for k in range(3)
+    ]
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 3),), n_changepoints=10
+    )
+    sf = StreamingForecaster(cfg, SolverConfig(max_iters=60), backend=backend)
+    t0 = time.time()
+    stats = sf.run(InMemorySource([b for b in batches if len(b)]))
+    total_s = time.time() - t0
+    fc = sf.forecast([f"s{i}" for i in range(n_series)], horizon=14,
+                     num_samples=0)
+    t = fc.ds.to_numpy().reshape(n_series, 14)
+    sid = np.arange(n_series)[:, None] + 1
+    want = 20 * sid + 0.05 * t + 3 * np.sin(2 * np.pi * t / 7)
+    smape_fc = float(
+        np.mean(np.asarray(metrics.smape(
+            jnp.asarray(want), jnp.asarray(fc.yhat.to_numpy().reshape(n_series, 14))
+        )))
+    )
+    return {
+        "micro_batches": stats.micro_batches,
+        "warm_starts": stats.warm_starts,
+        "cold_starts": stats.cold_starts,
+        "total_seconds": round(total_s, 3),
+        "smape_forecast": round(smape_fc, 3),
+        "n_series": n_series,
+    }
+
+
+RUNNERS = {
+    "1": config1_peyton,
+    "2": config2_m4_hourly,
+    "3": config3_m5,
+    "4": config4_wiki_logistic,
+    "5": config5_streaming,
+}
+
+
+def main():
+    from tsspark_tpu.utils.platform import honor_env_platforms
+
+    honor_env_platforms()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("which", nargs="?", default="all")
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--backend", default="tpu")
+    args = ap.parse_args()
+    keys = list(RUNNERS) if args.which == "all" else [args.which]
+    out = {}
+    for k in keys:
+        out[f"config{k}"] = RUNNERS[k](backend=args.backend, scale=args.scale)
+        print(json.dumps({f"config{k}": out[f"config{k}"]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ import numpy as np
 import pandas as pd
 import jax.numpy as jnp
 
+from tsspark_tpu import native
 from tsspark_tpu.backends.registry import ForecastBackend, get_backend
 from tsspark_tpu.config import ProphetConfig, SolverConfig
 from tsspark_tpu.models.prophet.model import FitState
@@ -59,21 +60,26 @@ def pivot_long(
     floor_col: Optional[str] = None,
     regressor_cols: Sequence[str] = (),
 ) -> PivotedBatch:
-    """Collect: long frame -> padded (B, T) arrays on the union calendar grid."""
+    """Collect: long frame -> padded (B, T) arrays on the union calendar grid.
+
+    The scatter runs through the native threaded pivot engine
+    (tsspark_tpu.native) when the compiled library is available; semantics
+    (last row wins on duplicate (series, ds)) are identical either way.
+    """
     days = _ds_to_days(df[ds_col])
-    work = df.assign(__days=days)
-    grid = np.unique(days)
-    t_index = {d: i for i, d in enumerate(grid)}
-    ids = work[id_col].unique()
-    id_index = {s: i for i, s in enumerate(ids)}
+    grid, cols = np.unique(days, return_inverse=True)
+    rows, ids = pd.factorize(df[id_col], sort=False)
+    if (rows < 0).any():  # factorize marks null ids with -1
+        raise ValueError(f"null values in id column {id_col!r}")
+    ids = np.asarray(ids)
     b, t_len = len(ids), len(grid)
 
-    rows = work[id_col].map(id_index).to_numpy()
-    cols = work["__days"].map(t_index).to_numpy()
-
     def scatter(col, fill=np.nan):
-        out = np.full((b, t_len), fill)
-        out[rows, cols] = work[col].to_numpy(np.float64)
+        out = native.bulk_pivot(
+            rows, cols, df[col].to_numpy(np.float64), b, t_len
+        )
+        if not np.isnan(fill):
+            out = np.where(np.isnan(out), fill, out)
         return out
 
     y = scatter(y_col)

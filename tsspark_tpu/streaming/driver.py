@@ -1,0 +1,179 @@
+"""Streaming micro-batch refit driver.
+
+The TPU-native version of eval config 5 (BASELINE.json:11): consume
+micro-batches from a source, maintain per-series history windows, and refit
+touched series in one batched solve per micro-batch, warm-started from the
+parameter store through the warm-start space transfer (warmstart.py).
+
+Per-series history lives in the native ingest engine
+(tsspark_tpu.native.HistoryStore, C++ via ctypes): bounded sorted
+dedup-append on ingest and threaded padded materialization on refit — the
+host-side hot path of the loop.
+
+Flow per micro-batch:
+  1. absorb new rows into the native history store (sorted, dedup, bounded)
+  2. materialize touched series onto their union grid (collect)
+  3. look up stored params -> transfer into the new scaling space -> init
+     (cold data-driven init for unseen series)
+  4. batched fit with a small iteration budget (fit)
+  5. write refreshed params back to the store (scatter)
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+
+from tsspark_tpu import native
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.frame import _days_to_ts, _ds_to_days
+from tsspark_tpu.models.prophet.design import prepare_fit_data
+from tsspark_tpu.models.prophet.params import init_theta
+from tsspark_tpu.streaming.source import MicroBatchSource
+from tsspark_tpu.streaming.state import ParamStore
+from tsspark_tpu.streaming.warmstart import transfer_theta
+
+
+@dataclass
+class RefitStats:
+    micro_batches: int = 0
+    rows_ingested: int = 0
+    series_refit: int = 0
+    warm_starts: int = 0
+    cold_starts: int = 0
+    fit_seconds: float = 0.0
+    last_batch_seconds: float = 0.0
+
+
+class StreamingForecaster:
+    """Incremental refitter over a micro-batch source."""
+
+    def __init__(
+        self,
+        config: ProphetConfig = ProphetConfig(),
+        solver_config: SolverConfig = SolverConfig(max_iters=40),
+        backend: str = "tpu",
+        max_history: int = 4096,
+        id_col: str = "series_id",
+        ds_col: str = "ds",
+        y_col: str = "y",
+        store: Optional[ParamStore] = None,
+        **backend_kwargs,
+    ):
+        self.config = config
+        self.backend = get_backend(backend, config, solver_config,
+                                   **backend_kwargs)
+        self.store = store if store is not None else ParamStore(config)
+        self.max_history = max_history
+        self.id_col, self.ds_col, self.y_col = id_col, ds_col, y_col
+        self._hist = native.HistoryStore(max_history)
+        self._code_of: Dict[str, int] = {}
+        self._ds_was_datetime = False
+        self.stats = RefitStats()
+
+    # -- ingestion -------------------------------------------------------------
+
+    def _codes(self, sids) -> np.ndarray:
+        out = np.empty(len(sids), np.int64)
+        for i, sid in enumerate(sids):
+            out[i] = self._code_of.setdefault(str(sid), len(self._code_of))
+        return out
+
+    def _absorb(self, batch: pd.DataFrame) -> List[str]:
+        if not np.issubdtype(batch[self.ds_col].dtype, np.number):
+            self._ds_was_datetime = True
+        days = _ds_to_days(batch[self.ds_col])
+        sids = batch[self.id_col].astype(str).to_numpy()
+        self._hist.append(
+            self._codes(sids), days, batch[self.y_col].to_numpy(np.float64)
+        )
+        self.stats.rows_ingested += len(batch)
+        return list(dict.fromkeys(sids))  # unique, input order
+
+    # -- refit -----------------------------------------------------------------
+
+    def process(self, batch: pd.DataFrame) -> None:
+        """Ingest one micro-batch and refit every touched series."""
+        t0 = time.time()
+        touched = self._absorb(batch)
+        codes = self._codes(touched)
+        grid = self._hist.union_grid(codes)
+        y = self._hist.materialize(codes, grid)  # (B, T), NaN holes
+
+        data, meta = prepare_fit_data(
+            jnp.asarray(grid), jnp.asarray(y), self.config
+        )
+        theta0 = init_theta(self.config, data.y, data.mask, data.t)
+        old_theta, old_meta, found = self.store.lookup(touched)
+        if old_theta is not None:
+            warm = transfer_theta(old_theta, old_meta, meta, self.config)
+            theta0 = jnp.where(jnp.asarray(found)[:, None], warm, theta0)
+        state = self.backend.fit(
+            jnp.asarray(grid), jnp.asarray(y), init=theta0
+        )
+        self.store.update(touched, state)
+
+        dt = time.time() - t0
+        self.stats.micro_batches += 1
+        self.stats.series_refit += len(touched)
+        self.stats.warm_starts += int(found.sum())
+        self.stats.cold_starts += int((~found).sum())
+        self.stats.fit_seconds += dt
+        self.stats.last_batch_seconds = dt
+
+    def run(self, source: MicroBatchSource,
+            max_batches: Optional[int] = None) -> RefitStats:
+        """Drain the source (or up to ``max_batches``)."""
+        n = 0
+        for batch in source:
+            self.process(batch)
+            n += 1
+            if max_batches is not None and n >= max_batches:
+                break
+        return self.stats
+
+    # -- forecasting out of the store ------------------------------------------
+
+    def forecast(self, series_ids: Sequence, horizon: int,
+                 num_samples: Optional[int] = None) -> pd.DataFrame:
+        """Forecast from the latest stored parameters (no refit)."""
+        ids = [str(s) for s in series_ids]
+        missing = [s for s in ids if s not in self.store]
+        if missing:
+            raise KeyError(f"no fitted params for series: {missing[:5]}")
+        theta, meta, _ = self.store.lookup(ids)
+        from tsspark_tpu.models.prophet.model import FitState
+
+        state = FitState(
+            theta=theta, meta=meta,
+            loss=jnp.zeros(len(ids)), grad_norm=jnp.zeros(len(ids)),
+            converged=jnp.ones(len(ids), bool),
+            n_iters=jnp.zeros(len(ids), jnp.int32),
+        )
+        # Continue each series' own calendar at its observed cadence.
+        last = np.asarray(meta.ds_start + meta.ds_span)
+        step = np.empty(len(ids))
+        for i, sid in enumerate(ids):
+            code = self._code_of.get(sid)
+            days = (self._hist.union_grid(np.asarray([code], np.int64))
+                    if code is not None else np.empty(0))
+            step[i] = float(np.median(np.diff(days))) if len(days) > 1 else 1.0
+        grid = last[:, None] + step[:, None] * np.arange(1, horizon + 1)
+        fc = self.backend.predict(state, jnp.asarray(grid),
+                                  num_samples=num_samples)
+        ds_out = grid.reshape(-1)
+        if self._ds_was_datetime:
+            ds_out = _days_to_ts(ds_out)
+        rows = {
+            self.id_col: np.repeat(ids, horizon),
+            self.ds_col: ds_out,
+        }
+        for k, v in fc.items():
+            rows[k] = np.asarray(v).reshape(-1)
+        return pd.DataFrame(rows)

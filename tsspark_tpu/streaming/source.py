@@ -1,0 +1,79 @@
+"""Micro-batch sources for streaming incremental refit.
+
+The reference consumes Kafka micro-batches (eval config 5, BASELINE.json:11).
+This machine has no broker and no kafka client, so the source is an
+interface: ``InMemorySource`` drives tests and simulations; ``KafkaSource``
+is a dependency-gated adapter with the same contract that activates when a
+``kafka-python``-compatible client is importable.
+
+Contract: ``poll()`` returns a long-format DataFrame of NEW observations
+(series_id, ds, y [, regressor columns]) or None when nothing is pending.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, List, Optional
+
+import pandas as pd
+
+
+class MicroBatchSource(abc.ABC):
+    """A stream of long-format observation micro-batches."""
+
+    @abc.abstractmethod
+    def poll(self) -> Optional[pd.DataFrame]:
+        """Next micro-batch, or None if the stream is (currently) dry."""
+
+    def __iter__(self):
+        while (batch := self.poll()) is not None:
+            yield batch
+
+
+class InMemorySource(MicroBatchSource):
+    """Replays a pre-built list of micro-batch frames (tests/simulation)."""
+
+    def __init__(self, batches: Iterable[pd.DataFrame]):
+        self._batches: List[pd.DataFrame] = list(batches)
+        self._pos = 0
+
+    def poll(self) -> Optional[pd.DataFrame]:
+        if self._pos >= len(self._batches):
+            return None
+        out = self._batches[self._pos]
+        self._pos += 1
+        return out
+
+
+class KafkaSource(MicroBatchSource):
+    """Kafka consumer adapter (requires a kafka client at runtime).
+
+    Messages are expected to be JSON rows {series_id, ds, y, ...}; each
+    ``poll`` drains up to ``max_records`` into one micro-batch frame.
+    """
+
+    def __init__(self, topic: str, max_records: int = 10000, **consumer_kwargs):
+        try:
+            from kafka import KafkaConsumer  # type: ignore
+        except ImportError as e:  # pragma: no cover - no broker/client locally
+            raise ImportError(
+                "KafkaSource needs the 'kafka-python' package, which is not "
+                "installed on this machine; use InMemorySource or implement "
+                "MicroBatchSource over your transport"
+            ) from e
+        import json as _json
+
+        self._consumer = KafkaConsumer(
+            topic,
+            value_deserializer=lambda b: _json.loads(b.decode()),
+            **consumer_kwargs,
+        )
+        self._max_records = max_records
+
+    def poll(self) -> Optional[pd.DataFrame]:  # pragma: no cover
+        records = self._consumer.poll(timeout_ms=1000,
+                                      max_records=self._max_records)
+        rows = [msg.value for part in records.values() for msg in part]
+        if not rows:
+            return None
+        return pd.DataFrame(rows)

@@ -1,0 +1,98 @@
+"""Parameter store: fitted params + scalings keyed by series id.
+
+Backs the streaming incremental-refit path (eval config 5, BASELINE.json:11):
+each micro-batch looks up prior parameters for the series it touches,
+warm-starts the solver, and writes the refreshed parameters back.  In-memory
+dict with npz persistence via utils.checkpoint; new series simply miss and
+fall back to data-driven init.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig
+from tsspark_tpu.models.prophet.design import ScalingMeta
+from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.utils import checkpoint as ckpt
+
+
+class ParamStore:
+    """Per-series (theta row, scaling meta row) storage."""
+
+    def __init__(self, config: ProphetConfig):
+        self.config = config
+        self._theta: Dict[str, np.ndarray] = {}
+        self._meta: Dict[str, tuple] = {}
+
+    def __len__(self) -> int:
+        return len(self._theta)
+
+    def __contains__(self, series_id: str) -> bool:
+        return str(series_id) in self._theta
+
+    def update(self, series_ids: Sequence, state: FitState) -> None:
+        theta = np.asarray(state.theta)
+        meta_rows = list(zip(*[np.asarray(v) for v in state.meta]))
+        for i, sid in enumerate(series_ids):
+            self._theta[str(sid)] = theta[i]
+            self._meta[str(sid)] = meta_rows[i]
+
+    def lookup(
+        self, series_ids: Sequence
+    ) -> Tuple[Optional[jnp.ndarray], Optional[ScalingMeta], np.ndarray]:
+        """Fetch stored rows for the requested series.
+
+        Returns (theta (B, P), meta, found-mask (B,)).  Rows for unknown
+        series are zero-filled and flagged False in the mask; callers blend
+        them with a cold init.  Returns (None, None, all-False) when no
+        requested series is known.
+        """
+        ids = [str(s) for s in series_ids]
+        found = np.asarray([s in self._theta for s in ids])
+        if not found.any():
+            return None, None, found
+        p = self.config.num_params
+        theta = np.zeros((len(ids), p), np.float32)
+        n_meta = len(ScalingMeta._fields)
+        meta_cols = [[] for _ in range(n_meta)]
+        some_meta = next(iter(self._meta.values()))
+        for i, sid in enumerate(ids):
+            row_meta = self._meta.get(sid)
+            if row_meta is None:
+                row_meta = tuple(np.zeros_like(m) for m in some_meta)
+            else:
+                theta[i] = self._theta[sid]
+            for j in range(n_meta):
+                meta_cols[j].append(row_meta[j])
+        meta = ScalingMeta(*[jnp.asarray(np.stack(c)) for c in meta_cols])
+        return jnp.asarray(theta), meta, found
+
+    # -- persistence -----------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        ids = np.asarray(sorted(self._theta))
+        theta = jnp.asarray(np.stack([self._theta[s] for s in ids]))
+        meta = ScalingMeta(*[
+            jnp.asarray(np.stack([self._meta[s][j] for s in ids]))
+            for j in range(len(ScalingMeta._fields))
+        ])
+        state = FitState(
+            theta=theta, meta=meta,
+            loss=jnp.zeros(len(ids)), grad_norm=jnp.zeros(len(ids)),
+            converged=jnp.ones(len(ids), bool),
+            n_iters=jnp.zeros(len(ids), jnp.int32),
+        )
+        ckpt.save_state(path, state, self.config, series_ids=ids)
+
+    @classmethod
+    def load(cls, path: str, config: ProphetConfig, strict: bool = True
+             ) -> "ParamStore":
+        state, ids = ckpt.load_state(path, config, strict=strict)
+        store = cls(config)
+        if ids is not None:
+            store.update(ids, state)
+        return store

@@ -1,0 +1,109 @@
+"""Warm-start parameter transfer between refits.
+
+A refit on extended data changes every per-series scaling (y_scale grows with
+new extremes, ds_span grows with new timestamps, changepoint grids move), so
+yesterday's fitted theta lives in a DIFFERENT parameter space than today's
+solver.  Feeding it in raw makes warm starts *worse* than cold init.  This
+module maps old parameters into the new space analytically:
+
+  time map:   t_old = a * t_new + b  with a = span_new/span_old,
+              b = (start_new - start_old)/span_old
+  scale map:  r = y_scale_old / y_scale_new  (+ floor shift for logistic)
+
+  k', delta'  — the piecewise slope curve is resampled: slope_new(s'_j) =
+                a*r*slope_old(t_old(s'_j)), delta' = successive differences.
+  m'          — r * g_old(b)   (trend value at new t=0, rescaled)
+  beta'       — r * beta for additive features; unchanged for multiplicative
+                (those are relative to trend and unitless).
+  log_sigma'  — log_sigma + log r.
+
+This is exact for the trend between changepoints and for all linear
+components; the only approximation is quantizing old changepoints onto the
+new grid.  (The reference's warm-start path, BASELINE.json:11, solves the
+same problem for its Spark micro-batch refits.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig
+from tsspark_tpu.models.prophet import trend as trend_mod
+from tsspark_tpu.models.prophet.design import ScalingMeta
+from tsspark_tpu.models.prophet.params import ProphetParams, pack, unpack
+
+
+def transfer_theta(
+    theta_old: jnp.ndarray,
+    meta_old: ScalingMeta,
+    meta_new: ScalingMeta,
+    config: ProphetConfig,
+) -> jnp.ndarray:
+    """Map (B, P) fitted params from meta_old's space into meta_new's space."""
+    p = unpack(theta_old, config)
+    a = (meta_new.ds_span / meta_old.ds_span)[:, None]          # (B, 1)
+    b = ((meta_new.ds_start - meta_old.ds_start) / meta_old.ds_span)[:, None]
+    r = (meta_old.y_scale / meta_new.y_scale)[:, None]
+
+    n_cp = config.n_changepoints
+    batch = theta_old.shape[0]
+    dtype = theta_old.dtype
+    s_new = trend_mod.uniform_changepoints(
+        jnp.zeros((batch,), dtype), jnp.ones((batch,), dtype),
+        n_cp, config.changepoint_range,
+    )
+    s_old = s_new  # changepoint fractions are identical in each scaled space
+
+    # Old cumulative slope evaluated at new-grid points mapped to old time.
+    # slope_old(t) = k + sum_{j: s_old_j <= t} delta_j.  New time t_new maps
+    # to old time a*t_new + b, so the new-window origin evaluates at b (NOT
+    # at old t=0 — when the history window slides, changepoints in (0, b)
+    # must fold into the new base slope).
+    eval_pts = jnp.concatenate(
+        [b, a * s_new + b], axis=-1
+    )  # (B, n_cp+1): new t=0 and each new changepoint, in old time
+    idx = trend_mod.changepoint_index(eval_pts, s_old)
+    csum = jnp.concatenate(
+        [jnp.zeros((batch, 1), dtype), jnp.cumsum(p.delta, axis=-1)], axis=-1
+    )
+    slope_old_at = p.k[:, None] + jnp.take_along_axis(csum, idx, axis=-1)
+    # Linear trend lives in y-scaled units -> rates pick up r; the logistic
+    # rate sits inside sigmoid(k*(t-m)), which is invariant to y rescaling
+    # (the cap rescales separately), so only the time scale applies there.
+    rate_scale = a if config.growth == "logistic" else a * r
+    slope_new_at = rate_scale * slope_old_at  # (B, n_cp+1)
+
+    k_new = slope_new_at[:, 0]
+    delta_new = jnp.diff(slope_new_at, axis=-1)
+
+    # Trend value at new t=0 (old time b), rescaled; for logistic the offset
+    # parameter m is a time location, which maps affinely instead.
+    if config.growth == "logistic":
+        # m is the sigmoid midpoint in scaled time: t_old = a t_new + b.
+        m_new = (p.m - b[:, 0]) / a[:, 0]
+        # Floor shift is absorbed by cap/y rescaling at data-prep time.
+    else:
+        gsum = jnp.concatenate(
+            [jnp.zeros((batch, 1), dtype),
+             jnp.cumsum(-s_old * p.delta, axis=-1)], axis=-1
+        )
+        off_old_at0 = p.m + jnp.take_along_axis(gsum, idx[:, :1], axis=-1)[:, 0]
+        g_old_at0 = slope_old_at[:, 0] * b[:, 0] + off_old_at0
+        shift = ((meta_old.floor - meta_new.floor) / meta_new.y_scale)
+        m_new = r[:, 0] * g_old_at0 + shift
+
+    mult_mask = jnp.asarray(
+        [1.0 if m else 0.0 for m in config.feature_modes()], dtype
+    )
+    beta_new = p.beta * jnp.where(mult_mask > 0, 1.0, r)
+    log_sigma_new = p.log_sigma + jnp.log(jnp.maximum(r[:, 0], 1e-30))
+
+    return pack(
+        ProphetParams(
+            k=k_new,
+            m=m_new,
+            log_sigma=log_sigma_new,
+            delta=delta_new,
+            beta=beta_new,
+        )
+    )

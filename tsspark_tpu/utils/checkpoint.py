@@ -1,0 +1,93 @@
+"""Checkpoint/resume for fitted states and the streaming parameter store.
+
+The reference's streaming eval config warm-starts refits "from prior params"
+(BASELINE.json:11), which requires durable fitted-parameter storage.  Format:
+one ``.npz`` with the array leaves + one sidecar ``.json`` with the config
+fingerprint and series ids, so a resume can verify it is warm-starting into
+a compatible model (same param layout) and map rows by series id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig
+from tsspark_tpu.models.prophet.design import ScalingMeta
+from tsspark_tpu.models.prophet.model import FitState
+
+
+def config_fingerprint(config: ProphetConfig) -> str:
+    """Stable hash of everything that determines the parameter layout."""
+    payload = json.dumps(dataclasses.asdict(config), sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def _base(path: str) -> str:
+    return path[:-4] if path.endswith(".npz") else path
+
+
+def save_state(
+    path: str,
+    state: FitState,
+    config: ProphetConfig,
+    series_ids: Optional[np.ndarray] = None,
+) -> None:
+    """Write a FitState to ``<base>.npz`` + ``<base>.json`` sidecar."""
+    path = _base(path)
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    arrays = {
+        "theta": state.theta,
+        "loss": state.loss,
+        "grad_norm": state.grad_norm,
+        "converged": state.converged,
+        "n_iters": state.n_iters,
+    }
+    arrays.update(
+        {f"meta_{k}": v for k, v in state.meta._asdict().items()}
+    )
+    np.savez(path + ".npz", **{k: np.asarray(v) for k, v in arrays.items()})
+    sidecar = {
+        "fingerprint": config_fingerprint(config),
+        "n_series": int(state.theta.shape[0]),
+        "series_ids": None if series_ids is None else [str(s) for s in series_ids],
+        "format": 1,
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f)
+
+
+def load_state(
+    path: str, config: ProphetConfig, strict: bool = True
+) -> Tuple[FitState, Optional[np.ndarray]]:
+    """Load a FitState; verifies the config fingerprint when ``strict``."""
+    path = _base(path)
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    if strict and sidecar["fingerprint"] != config_fingerprint(config):
+        raise ValueError(
+            "checkpoint was written with a different model config "
+            f"(fingerprint {sidecar['fingerprint']}); pass strict=False to "
+            "force-load"
+        )
+    z = np.load(path + ".npz")
+    meta = ScalingMeta(**{
+        k[len("meta_"):]: jnp.asarray(z[k])
+        for k in z.files if k.startswith("meta_")
+    })
+    state = FitState(
+        theta=jnp.asarray(z["theta"]),
+        meta=meta,
+        loss=jnp.asarray(z["loss"]),
+        grad_norm=jnp.asarray(z["grad_norm"]),
+        converged=jnp.asarray(z["converged"]),
+        n_iters=jnp.asarray(z["n_iters"]),
+    )
+    ids = sidecar.get("series_ids")
+    return state, None if ids is None else np.asarray(ids)

@@ -1,0 +1,19 @@
+"""Platform selection helper.
+
+This image's sitecustomize force-registers the axon TPU plugin and overrides
+the JAX_PLATFORMS environment variable at interpreter start; any CLI that
+should honor an explicit ``JAX_PLATFORMS=...`` (e.g. CPU smoke runs while the
+TPU is held by another process) must re-assert it at the config level before
+the first backend lookup.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def honor_env_platforms() -> None:
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -1,0 +1,61 @@
+"""Profiling hooks: XLA/TPU trace capture + lightweight wall-clock timers.
+
+``trace(logdir)`` wraps ``jax.profiler`` so a fit can be captured and viewed
+in TensorBoard's profile plugin (installed on this image) — the TPU-native
+replacement for the reference's Spark UI stage timeline.  Timers aggregate
+named wall-clock sections (host-side view; device work is in the trace).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str] = None) -> Iterator[None]:
+    """Capture an XLA profiler trace into ``logdir`` (no-op when None)."""
+    if logdir is None:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timers:
+    """Accumulating named wall-clock timers (host side)."""
+
+    def __init__(self) -> None:
+        self._total: Dict[str, float] = defaultdict(float)
+        self._count: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self._total[name] += time.time() - t0
+            self._count[name] += 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {
+            k: {
+                "total_s": round(self._total[k], 4),
+                "count": self._count[k],
+                "mean_s": round(self._total[k] / max(self._count[k], 1), 4),
+            }
+            for k in sorted(self._total)
+        }
