@@ -1,0 +1,118 @@
+"""Holiday calendars, window expansion, and end-to-end effect recovery."""
+
+import datetime as dt
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.frame import Forecaster
+from tsspark_tpu.models import holidays as hol
+
+
+def _days(*dates):
+    return hol.to_days(dates)
+
+
+def test_computus_easter_known_years():
+    assert hol._easter(2024) == dt.date(2024, 3, 31)
+    assert hol._easter(2025) == dt.date(2025, 4, 20)
+    assert hol._easter(2016) == dt.date(2016, 3, 27)
+
+
+def test_us_calendar_known_dates():
+    hs = {h.name: h for h in hol.country_holidays("US", [2023])}
+    assert _days("2023-11-23")[0] in hs["Thanksgiving"].dates  # 4th Thu Nov
+    assert _days("2023-05-29")[0] in hs["Memorial Day"].dates  # last Mon May
+    assert _days("2023-01-16")[0] in hs["Martin Luther King Jr. Day"].dates
+    assert "Juneteenth" in hs  # post-2021 only
+    assert "Juneteenth" not in {
+        h.name for h in hol.country_holidays("US", [2019])
+    }
+
+
+def test_ca_victoria_day():
+    hs = {h.name: h for h in hol.country_holidays("CA", [2023, 2021])}
+    assert _days("2023-05-22")[0] in hs["Victoria Day"].dates
+    assert _days("2021-05-24")[0] in hs["Victoria Day"].dates  # May 24 is a Monday
+
+
+def test_unknown_country_raises():
+    with pytest.raises(ValueError, match="unknown country"):
+        hol.country_holidays("ZZ", [2023])
+
+
+def test_window_expansion_columns_and_features():
+    h = hol.Holiday.from_dates(
+        "xmas", ["2023-12-25"], lower_window=-1, upper_window=1
+    )
+    cols = hol.holiday_column_configs([h])
+    assert [c.name for c in cols] == ["xmas_-1", "xmas", "xmas_+1"]
+    assert all(not c.standardize for c in cols)
+
+    grid = _days("2023-12-23", "2023-12-24", "2023-12-25", "2023-12-26")
+    x = hol.holiday_features(grid, [h])
+    assert x.shape == (4, 3)
+    np.testing.assert_array_equal(x[:, 0], [0, 1, 0, 0])  # eve column
+    np.testing.assert_array_equal(x[:, 1], [0, 0, 1, 0])  # day column
+    np.testing.assert_array_equal(x[:, 2], [0, 0, 0, 1])  # day-after column
+
+
+def test_holidays_from_df_groups_and_windows():
+    df = pd.DataFrame(
+        {
+            "holiday": ["a", "a", "b"],
+            "ds": ["2023-01-01", "2024-01-01", "2023-06-01"],
+            "lower_window": [0, 0, -1],
+            "upper_window": [1, 1, 0],
+        }
+    )
+    specs = hol.holidays_from_df(df)
+    assert [h.name for h in specs] == ["a", "b"]
+    assert len(specs[0].dates) == 2
+    assert specs[1].offsets == (-1, 0)
+
+
+def test_add_holidays_extends_config():
+    cfg = ProphetConfig(seasonalities=())
+    h = hol.Holiday.from_dates("d", ["2023-07-04"], prior_scale=3.0)
+    cfg2 = hol.add_holidays(cfg, [h])
+    assert cfg2.num_regressors == 1
+    assert cfg2.regressors[0].prior_scale == 3.0
+    assert cfg.num_regressors == 0  # original untouched
+
+
+def test_forecaster_recovers_holiday_effect():
+    """A known additive spike on one recurring date is attributed to the
+    holiday coefficient and reproduced in future forecasts of that date."""
+    rng = np.random.default_rng(0)
+    dates = pd.date_range("2021-01-01", periods=3 * 365, freq="D")
+    effect = 5.0
+    july4 = (dates.month == 7) & (dates.day == 4)
+    frames = []
+    for i in range(3):
+        y = 10.0 + i + rng.normal(0, 0.15, len(dates)) + effect * july4
+        frames.append(pd.DataFrame({"series_id": f"s{i}", "ds": dates, "y": y}))
+    df = pd.concat(frames, ignore_index=True)
+
+    h = hol.Holiday.from_dates(
+        "july4", ["2021-07-04", "2022-07-04", "2023-07-04", "2024-07-04"]
+    )
+    fc = Forecaster(
+        ProphetConfig(
+            seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+            n_changepoints=5,
+        ),
+        SolverConfig(max_iters=120),
+        backend="tpu",
+        holidays=[h],
+    )
+    fc.fit(df)
+    # Horizon crossing 2024-07-04 (predict path computes the indicator
+    # itself — no future_df needed for holiday-only models).
+    out = fc.predict(horizon=250)
+    s0 = out[out.series_id == "s0"].set_index("ds")
+    on = s0.loc[pd.Timestamp("2024-07-04"), "yhat"]
+    off = s0.loc[pd.Timestamp("2024-07-10"), "yhat"]
+    assert on - off == pytest.approx(effect, abs=0.75)

@@ -35,6 +35,12 @@ from tsspark_tpu.backends.registry import (
     register_backend,
 )
 from tsspark_tpu.frame import Forecaster
+from tsspark_tpu.models.holidays import (
+    Holiday,
+    add_holidays,
+    country_holidays,
+    holidays_from_df,
+)
 from tsspark_tpu.models.prophet.model import FitState, ProphetModel
 
 __version__ = "0.1.0"
@@ -44,6 +50,10 @@ __all__ = [
     "Forecaster",
     "ForecastBackend",
     "FitState",
+    "Holiday",
+    "add_holidays",
+    "country_holidays",
+    "holidays_from_df",
     "ProphetConfig",
     "ProphetModel",
     "RegressorConfig",

@@ -13,6 +13,7 @@ datetime64 resolution or plain numeric "days" column works.
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict, NamedTuple, Optional, Sequence
 
 import numpy as np
@@ -22,6 +23,7 @@ import jax.numpy as jnp
 from tsspark_tpu import native
 from tsspark_tpu.backends.registry import ForecastBackend, get_backend
 from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.models import holidays as holidays_mod
 from tsspark_tpu.models.prophet.model import FitState
 
 _SECONDS_PER_DAY = 86400.0
@@ -120,8 +122,16 @@ class Forecaster:
         cap_col: Optional[str] = None,
         floor_col: Optional[str] = None,
         regressor_cols: Sequence[str] = (),
+        holidays: Sequence[holidays_mod.Holiday] = (),
         **backend_kwargs,
     ):
+        # Holidays are sugar over the regressor path: each (holiday, offset)
+        # appends an unstandardized indicator column after the user's
+        # regressor columns; the indicator values are computed from the
+        # calendar grid at fit/predict time (no future_df needed for them).
+        self.holidays = tuple(holidays)
+        if self.holidays:
+            config = holidays_mod.add_holidays(config, self.holidays)
         self.config = config
         self.backend: ForecastBackend = get_backend(
             backend, config, solver_config, **backend_kwargs
@@ -134,6 +144,31 @@ class Forecaster:
         self.series_ids: Optional[np.ndarray] = None
         self._train_ds: Optional[np.ndarray] = None
         self._freq_days: Optional[float] = None
+
+    def _combined_regressors(
+        self, grid: np.ndarray, reg: Optional[np.ndarray], b: int
+    ) -> Optional[np.ndarray]:
+        """User regressor columns ++ holiday indicator columns, (B, T, R+H)."""
+        if not self.holidays:
+            return reg
+        # A holiday whose enumerated dates stop before the forecast grid ends
+        # would silently contribute zero effect exactly where the user expects
+        # it most — warn so they extend the calendar (country_holidays(years=…)).
+        stale = [
+            h.name
+            for h in self.holidays
+            if h.dates and max(h.dates) + h.upper_window < np.max(grid)
+        ]
+        if stale:
+            warnings.warn(
+                f"forecast grid extends past the last enumerated date of "
+                f"holiday(s) {stale}; their effect will be zero there — "
+                f"extend the holiday dates to cover the horizon",
+                stacklevel=3,
+            )
+        hol = holidays_mod.holiday_features(grid, self.holidays)  # (T, H)
+        hol_b = np.broadcast_to(hol, (b,) + hol.shape)
+        return hol_b if reg is None else np.concatenate([reg, hol_b], axis=-1)
 
     # -- fit -------------------------------------------------------------------
 
@@ -148,13 +183,15 @@ class Forecaster:
         self._train_ds = batch.ds
         diffs = np.diff(batch.ds)
         self._freq_days = float(np.median(diffs)) if len(diffs) else 1.0
+        reg = self._combined_regressors(
+            batch.ds, batch.regressors, len(batch.series_ids)
+        )
         self.state = self.backend.fit(
             jnp.asarray(batch.ds),
             jnp.asarray(batch.y),
             cap=None if batch.cap is None else jnp.asarray(np.nan_to_num(batch.cap)),
             floor=None if batch.floor is None else jnp.asarray(batch.floor),
-            regressors=None if batch.regressors is None
-            else jnp.asarray(batch.regressors),
+            regressors=None if reg is None else jnp.asarray(reg),
             init=init,
         )
         return self
@@ -212,6 +249,7 @@ class Forecaster:
             if self.cap_col is not None:
                 raise ValueError("logistic models need future_df with cap")
 
+        reg = self._combined_regressors(grid, reg, len(self.series_ids))
         fc = self.backend.predict(
             self.state, jnp.asarray(grid),
             cap=None if cap is None else jnp.asarray(np.nan_to_num(cap)),

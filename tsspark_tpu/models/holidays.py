@@ -1,0 +1,277 @@
+"""Holiday calendars and holiday-indicator feature expansion.
+
+The reference supports "holiday / external regressors" in its Prophet fit
+(BASELINE.json:5).  In this framework a holiday is sugar over the external
+regressor path: every (holiday, day-offset) pair expands to one 0/1 indicator
+column appended to the regressor block, with ``standardize=False`` and the
+holiday's own prior scale — exactly how upstream Prophet lowers its
+``holidays`` frame into the design matrix.  The expansion happens *outside*
+jit (plain numpy on the calendar grid), so holiday sets of any size never
+change the compiled program beyond the static regressor count.
+
+Country calendars are computed arithmetically (nth-weekday rules + the
+Gregorian Easter computus) — this machine has zero egress, so nothing is
+looked up.  Supported: US, CA, GB, DE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+from typing import Iterable, Sequence, Tuple
+
+import numpy as np
+
+from tsspark_tpu.config import ProphetConfig, RegressorConfig
+
+_EPOCH = _dt.date(1970, 1, 1)
+
+
+def _date_to_days(d: _dt.date) -> float:
+    return float((d - _EPOCH).days)
+
+
+def to_days(dates: Iterable) -> np.ndarray:
+    """Absolute float days since the epoch from dates/strings/numbers."""
+    out = []
+    for d in dates:
+        if isinstance(d, (int, float, np.integer, np.floating)):
+            out.append(float(d))
+        elif isinstance(d, _dt.datetime):
+            out.append(_date_to_days(d.date()))
+        elif isinstance(d, _dt.date):
+            out.append(_date_to_days(d))
+        else:  # ISO string / numpy datetime64 / pandas Timestamp
+            d64 = np.datetime64(str(d), "D")
+            out.append(float(d64.astype("datetime64[D]").astype(np.int64)))
+    return np.asarray(out, np.float64)
+
+
+@dataclasses.dataclass(frozen=True)
+class Holiday:
+    """One named holiday: its occurrence dates plus an effect window.
+
+    ``lower_window``/``upper_window`` extend the effect to days before/after
+    each occurrence (Prophet convention: lower_window=-1 covers the eve).
+    Each distinct offset gets its own indicator column and coefficient.
+    """
+
+    name: str
+    dates: Tuple[float, ...]  # absolute days since epoch
+    lower_window: int = 0
+    upper_window: int = 0
+    prior_scale: float = 10.0
+    mode: str = "additive"
+
+    def __post_init__(self):
+        if self.lower_window > 0:
+            raise ValueError("lower_window must be <= 0 (days before)")
+        if self.upper_window < 0:
+            raise ValueError("upper_window must be >= 0 (days after)")
+        if self.mode not in ("additive", "multiplicative"):
+            raise ValueError(f"mode must be additive|multiplicative, got {self.mode}")
+
+    @staticmethod
+    def from_dates(name: str, dates: Iterable, **kwargs) -> "Holiday":
+        return Holiday(name=name, dates=tuple(to_days(dates)), **kwargs)
+
+    @property
+    def offsets(self) -> Tuple[int, ...]:
+        return tuple(range(self.lower_window, self.upper_window + 1))
+
+
+def holidays_from_df(df, prior_scale: float = 10.0) -> Tuple[Holiday, ...]:
+    """Prophet-style holidays frame -> Holiday specs.
+
+    Expects columns ``holiday`` and ``ds``; optional ``lower_window``,
+    ``upper_window``, ``prior_scale`` (constant per holiday name).
+    """
+    specs = []
+    for name, grp in df.groupby("holiday", sort=True):
+        lw = int(grp["lower_window"].iloc[0]) if "lower_window" in grp else 0
+        uw = int(grp["upper_window"].iloc[0]) if "upper_window" in grp else 0
+        ps = float(grp["prior_scale"].iloc[0]) if "prior_scale" in grp else prior_scale
+        specs.append(
+            Holiday.from_dates(
+                str(name), grp["ds"], lower_window=lw, upper_window=uw,
+                prior_scale=ps,
+            )
+        )
+    return tuple(specs)
+
+
+# ---------------------------------------------------------------------------
+# Computed country calendars
+# ---------------------------------------------------------------------------
+
+
+def _nth_weekday(year: int, month: int, weekday: int, n: int) -> _dt.date:
+    """n-th (1-based) given weekday (Mon=0) of a month."""
+    d = _dt.date(year, month, 1)
+    shift = (weekday - d.weekday()) % 7 + 7 * (n - 1)
+    return d + _dt.timedelta(days=shift)
+
+
+def _last_weekday(year: int, month: int, weekday: int) -> _dt.date:
+    d = (
+        _dt.date(year + 1, 1, 1)
+        if month == 12
+        else _dt.date(year, month + 1, 1)
+    ) - _dt.timedelta(days=1)
+    return d - _dt.timedelta(days=(d.weekday() - weekday) % 7)
+
+
+def _easter(year: int) -> _dt.date:
+    """Gregorian Easter Sunday (anonymous computus)."""
+    a = year % 19
+    b, c = divmod(year, 100)
+    d, e = divmod(b, 4)
+    g = (8 * b + 13) // 25
+    h = (19 * a + b - d - g + 15) % 30
+    i, k = divmod(c, 4)
+    l = (32 + 2 * e + 2 * i - h - k) % 7
+    m = (a + 11 * h + 22 * l) // 451
+    month = (h + l - 7 * m + 114) // 31
+    day = (h + l - 7 * m + 114) % 31 + 1
+    return _dt.date(year, month, day)
+
+
+def _us(year: int):
+    yield "New Year's Day", _dt.date(year, 1, 1)
+    yield "Martin Luther King Jr. Day", _nth_weekday(year, 1, 0, 3)
+    yield "Washington's Birthday", _nth_weekday(year, 2, 0, 3)
+    yield "Memorial Day", _last_weekday(year, 5, 0)
+    if year >= 2021:
+        yield "Juneteenth", _dt.date(year, 6, 19)
+    yield "Independence Day", _dt.date(year, 7, 4)
+    yield "Labor Day", _nth_weekday(year, 9, 0, 1)
+    yield "Columbus Day", _nth_weekday(year, 10, 0, 2)
+    yield "Veterans Day", _dt.date(year, 11, 11)
+    yield "Thanksgiving", _nth_weekday(year, 11, 3, 4)
+    yield "Christmas Day", _dt.date(year, 12, 25)
+
+
+def _ca(year: int):
+    easter = _easter(year)
+    yield "New Year's Day", _dt.date(year, 1, 1)
+    yield "Good Friday", easter - _dt.timedelta(days=2)
+    # Victoria Day: the Monday on or before May 24.
+    may24 = _dt.date(year, 5, 24)
+    yield "Victoria Day", may24 - _dt.timedelta(days=may24.weekday() % 7)
+    yield "Canada Day", _dt.date(year, 7, 1)
+    yield "Labour Day", _nth_weekday(year, 9, 0, 1)
+    yield "Thanksgiving", _nth_weekday(year, 10, 0, 2)
+    yield "Christmas Day", _dt.date(year, 12, 25)
+    yield "Boxing Day", _dt.date(year, 12, 26)
+
+
+def _gb(year: int):
+    easter = _easter(year)
+    yield "New Year's Day", _dt.date(year, 1, 1)
+    yield "Good Friday", easter - _dt.timedelta(days=2)
+    yield "Easter Monday", easter + _dt.timedelta(days=1)
+    yield "Early May Bank Holiday", _nth_weekday(year, 5, 0, 1)
+    yield "Spring Bank Holiday", _last_weekday(year, 5, 0)
+    yield "Summer Bank Holiday", _last_weekday(year, 8, 0)
+    yield "Christmas Day", _dt.date(year, 12, 25)
+    yield "Boxing Day", _dt.date(year, 12, 26)
+
+
+def _de(year: int):
+    easter = _easter(year)
+    yield "Neujahr", _dt.date(year, 1, 1)
+    yield "Karfreitag", easter - _dt.timedelta(days=2)
+    yield "Ostermontag", easter + _dt.timedelta(days=1)
+    yield "Tag der Arbeit", _dt.date(year, 5, 1)
+    yield "Christi Himmelfahrt", easter + _dt.timedelta(days=39)
+    yield "Pfingstmontag", easter + _dt.timedelta(days=50)
+    yield "Tag der Deutschen Einheit", _dt.date(year, 10, 3)
+    yield "Erster Weihnachtstag", _dt.date(year, 12, 25)
+    yield "Zweiter Weihnachtstag", _dt.date(year, 12, 26)
+
+
+_COUNTRIES = {"US": _us, "CA": _ca, "GB": _gb, "UK": _gb, "DE": _de}
+
+
+def country_holidays(
+    country: str,
+    years: Sequence[int],
+    lower_window: int = 0,
+    upper_window: int = 0,
+    prior_scale: float = 10.0,
+    mode: str = "additive",
+) -> Tuple[Holiday, ...]:
+    """Computed holiday calendar for a country over the given years."""
+    gen = _COUNTRIES.get(country.upper())
+    if gen is None:
+        raise ValueError(
+            f"unknown country {country!r}; available: {sorted(set(_COUNTRIES))}"
+        )
+    by_name: dict = {}
+    for year in years:
+        for name, date in gen(year):
+            by_name.setdefault(name, []).append(_date_to_days(date))
+    return tuple(
+        Holiday(
+            name=name,
+            dates=tuple(days),
+            lower_window=lower_window,
+            upper_window=upper_window,
+            prior_scale=prior_scale,
+            mode=mode,
+        )
+        for name, days in sorted(by_name.items())
+    )
+
+
+# ---------------------------------------------------------------------------
+# Feature expansion
+# ---------------------------------------------------------------------------
+
+
+def holiday_column_configs(
+    holidays: Sequence[Holiday],
+) -> Tuple[RegressorConfig, ...]:
+    """One RegressorConfig per (holiday, offset) indicator column."""
+    cols = []
+    for h in holidays:
+        for off in h.offsets:
+            suffix = "" if off == 0 else f"_{off:+d}"
+            cols.append(
+                RegressorConfig(
+                    name=f"{h.name}{suffix}",
+                    prior_scale=h.prior_scale,
+                    standardize=False,
+                    mode=h.mode,
+                )
+            )
+    return tuple(cols)
+
+
+def holiday_features(
+    ds_days: np.ndarray, holidays: Sequence[Holiday]
+) -> np.ndarray:
+    """0/1 indicator matrix (T, H) on a calendar grid (absolute days).
+
+    Grid timestamps match a holiday occurrence when they fall on the same
+    calendar day (floor of the fractional day — so every hour of a sub-daily
+    grid on Dec 25 matches Christmas), shifted by each window offset.
+    """
+    grid = np.floor(np.asarray(ds_days, np.float64)).astype(np.int64)
+    cols = []
+    for h in holidays:
+        days = np.floor(np.asarray(h.dates, np.float64)).astype(np.int64)
+        for off in h.offsets:
+            cols.append(np.isin(grid, days + off).astype(np.float32))
+    if not cols:
+        return np.zeros((len(grid), 0), np.float32)
+    return np.stack(cols, axis=-1)
+
+
+def add_holidays(
+    config: ProphetConfig, holidays: Sequence[Holiday]
+) -> ProphetConfig:
+    """Config with the holiday indicator columns appended as regressors."""
+    return dataclasses.replace(
+        config, regressors=config.regressors + holiday_column_configs(holidays)
+    )
