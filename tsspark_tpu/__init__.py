@@ -20,6 +20,7 @@ Quick start::
 
 from tsspark_tpu.config import (
     DAILY,
+    McmcConfig,
     ProphetConfig,
     RegressorConfig,
     SeasonalityConfig,
@@ -41,7 +42,7 @@ from tsspark_tpu.models.holidays import (
     country_holidays,
     holidays_from_df,
 )
-from tsspark_tpu.models.prophet.model import FitState, ProphetModel
+from tsspark_tpu.models.prophet.model import FitState, McmcState, ProphetModel
 
 __version__ = "0.1.0"
 
@@ -51,6 +52,8 @@ __all__ = [
     "ForecastBackend",
     "FitState",
     "Holiday",
+    "McmcConfig",
+    "McmcState",
     "add_holidays",
     "country_holidays",
     "holidays_from_df",

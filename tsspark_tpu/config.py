@@ -155,6 +155,36 @@ class SolverConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class McmcConfig:
+    """Batched HMC full-posterior sampling settings (see ops/hmc.py).
+
+    The TPU analog of upstream Prophet's ``mcmc_samples=N`` Stan/NUTS path:
+    one chain per series, all chains advanced in lockstep.
+    """
+
+    num_samples: int = 300
+    num_warmup: int = 300
+    num_leapfrog: int = 24
+    # 0.9 (vs Stan's 0.8 default): the observation-noise tail has funnel-like
+    # curvature, and with thousands of lockstep chains the frozen post-warmup
+    # step must leave headroom or a few chains land stuck in divergence
+    # regions.  The smaller step is cheap for these low-dim posteriors.
+    target_accept: float = 0.9
+    init_step_size: float = 0.1
+    step_jitter: float = 0.2       # multiplicative leapfrog step-size jitter
+    init_jitter: float = 0.01      # N(0, .) jitter on the MAP init per chain
+    divergence_threshold: float = 1000.0  # energy error treated as divergent
+
+    def __post_init__(self):
+        if self.num_samples < 1 or self.num_warmup < 2:
+            raise ValueError("num_samples >= 1 and num_warmup >= 2 required")
+        if not 0.0 < self.target_accept < 1.0:
+            raise ValueError("target_accept must be in (0, 1)")
+        if self.num_leapfrog < 1:
+            raise ValueError("num_leapfrog must be >= 1")
+
+
+@dataclasses.dataclass(frozen=True)
 class ShardingConfig:
     """How a fit batch is laid out over a jax.sharding.Mesh.
 

@@ -153,12 +153,24 @@ class Forecaster:
             return reg
         # A holiday whose enumerated dates stop before the forecast grid ends
         # would silently contribute zero effect exactly where the user expects
-        # it most — warn so they extend the calendar (country_holidays(years=…)).
-        stale = [
-            h.name
-            for h in self.holidays
-            if h.dates and max(h.dates) + h.upper_window < np.max(grid)
-        ]
+        # it most — warn so they extend the calendar
+        # (country_holidays(years=…)).  "Stops before" must respect the
+        # holiday's own recurrence: warn only when at least one *expected*
+        # occurrence (last date + observed recurrence spacing) falls inside
+        # the grid uncovered.  This keeps e.g. Thanksgiving quiet on a fit
+        # through Dec 31 while still flagging a calendar that genuinely runs
+        # out mid-horizon.  Single-date holidays have no observed spacing and
+        # warn as soon as the grid passes them.
+        grid_end = np.max(grid)
+
+        def _runs_out(h) -> bool:
+            if not h.dates:
+                return False
+            dates = np.sort(np.asarray(h.dates, dtype=np.float64))
+            spacing = float(np.median(np.diff(dates))) if dates.size > 1 else 0.0
+            return dates[-1] + h.upper_window + spacing < grid_end
+
+        stale = [h.name for h in self.holidays if _runs_out(h)]
         if stale:
             warnings.warn(
                 f"forecast grid extends past the last enumerated date of "

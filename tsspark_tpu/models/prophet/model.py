@@ -16,7 +16,7 @@ from typing import Dict, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
-from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.config import McmcConfig, ProphetConfig, SolverConfig
 from tsspark_tpu.models.prophet import predict as predict_mod
 from tsspark_tpu.models.prophet.design import (
     FitData,
@@ -25,7 +25,7 @@ from tsspark_tpu.models.prophet.design import (
 )
 from tsspark_tpu.models.prophet.loss import value_and_grad_batch
 from tsspark_tpu.models.prophet.params import init_theta
-from tsspark_tpu.ops import lbfgs
+from tsspark_tpu.ops import hmc, lbfgs
 
 
 class FitState(NamedTuple):
@@ -49,6 +49,49 @@ def fit_core(
     """The jitted batched MAP solve: the whole fit is one XLA program."""
     fun = lambda th: value_and_grad_batch(th, data, config)
     return lbfgs.minimize(fun, theta0, solver_config)
+
+
+class McmcState(NamedTuple):
+    """Full-posterior fit: (S, B, P) draws + scaling metadata + diagnostics."""
+
+    samples: jnp.ndarray
+    meta: ScalingMeta
+    accept_rate: jnp.ndarray
+    step_size: jnp.ndarray
+    divergences: jnp.ndarray
+
+
+@functools.partial(jax.jit, static_argnames=("config", "mcmc_config"))
+def mcmc_core(
+    data: FitData,
+    theta0: jnp.ndarray,
+    key: jax.Array,
+    config: ProphetConfig,
+    mcmc_config: McmcConfig,
+) -> hmc.HmcResult:
+    """The jitted batched posterior sample: one HMC chain per series.
+
+    The log density is the negative MAP loss plus the log-Jacobian of the
+    unconstraining sigma transform — the same model/parameterization split
+    upstream Prophet gets from Stan (``optimize`` omits the Jacobian,
+    ``mcmc_samples`` includes it).
+    """
+
+    def logdensity(th):
+        # Sampling needs the log-Jacobian of the sigma = exp(log_sigma)
+        # transform (+log_sigma, d/dlog_sigma = 1), which MAP optimization
+        # legitimately omits (Stan's optimize vs. sample make the same
+        # distinction); without it sigma draws are biased low.
+        f, g = value_and_grad_batch(th, data, config)
+        lp = -f + th[..., 2]
+        grad = (-g).at[..., 2].add(1.0)
+        return lp, grad
+
+    k_jit, k_run = jax.random.split(key)
+    jitter = mcmc_config.init_jitter * jax.random.normal(
+        k_jit, theta0.shape, theta0.dtype
+    )
+    return hmc.sample(logdensity, theta0 + jitter, k_run, mcmc_config)
 
 
 class ProphetModel:
@@ -92,6 +135,11 @@ class ProphetModel:
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
             regressors=regressors,
         )
+        return self._fit_prepared(data, meta, init)
+
+    def _fit_prepared(
+        self, data: FitData, meta: ScalingMeta, init: Optional[jnp.ndarray]
+    ) -> FitState:
         theta0 = init if init is not None else init_theta(
             self.config, data.y, data.mask, data.t
         )
@@ -103,6 +151,40 @@ class ProphetModel:
             grad_norm=res.grad_norm,
             converged=res.converged,
             n_iters=res.n_iters,
+        )
+
+    def fit_mcmc(
+        self,
+        ds: jnp.ndarray,
+        y: jnp.ndarray,
+        mask: Optional[jnp.ndarray] = None,
+        cap: Optional[jnp.ndarray] = None,
+        floor: Optional[jnp.ndarray] = None,
+        regressors: Optional[jnp.ndarray] = None,
+        mcmc_config: McmcConfig = McmcConfig(),
+        seed: int = 0,
+    ) -> McmcState:
+        """Full-posterior fit: MAP solve, then one HMC chain per series.
+
+        The TPU analog of upstream Prophet's ``mcmc_samples=N`` (Stan NUTS):
+        intervals from :meth:`predict_mcmc` carry seasonality and regressor
+        uncertainty, which the MAP path's trend-only simulation cannot.
+        """
+        data, meta = prepare_fit_data(
+            ds, y, self.config, mask=mask, cap=cap, floor=floor,
+            regressors=regressors,
+        )
+        map_state = self._fit_prepared(data, meta, None)
+        res = mcmc_core(
+            data, map_state.theta, jax.random.PRNGKey(seed), self.config,
+            mcmc_config,
+        )
+        return McmcState(
+            samples=res.samples,
+            meta=meta,
+            accept_rate=res.accept_rate,
+            step_size=res.step_size,
+            divergences=res.divergences,
         )
 
     # -- prediction ------------------------------------------------------------
@@ -124,6 +206,27 @@ class ProphetModel:
         return predict_mod.forecast(
             state.theta, data, state.meta, self.config,
             key=key, num_samples=num_samples,
+        )
+
+    def predict_mcmc(
+        self,
+        state: McmcState,
+        ds: jnp.ndarray,
+        cap: Optional[jnp.ndarray] = None,
+        regressors: Optional[jnp.ndarray] = None,
+        seed: int = 0,
+        max_draws: Optional[int] = None,
+    ) -> Dict[str, jnp.ndarray]:
+        """Posterior-predictive forecast from the MCMC draws."""
+        data = predict_mod.prepare_predict_data(
+            ds, state.meta, self.config, cap=cap, regressors=regressors
+        )
+        samples = state.samples
+        if max_draws is not None and samples.shape[0] > max_draws:
+            idx = jnp.linspace(0, samples.shape[0] - 1, max_draws).astype(int)
+            samples = samples[idx]
+        return predict_mod.forecast_from_draws(
+            samples, data, state.meta, self.config, jax.random.PRNGKey(seed)
         )
 
     def components(self, state: FitState, ds, cap=None, regressors=None):

@@ -118,6 +118,7 @@ def _simulate_trends(
     data: FitData,
     config: ProphetConfig,
     num_samples: int,
+    det: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """(S, B, T) scaled trend sample paths with simulated future changepoints."""
     p = unpack(theta, config)
@@ -145,7 +146,8 @@ def _simulate_trends(
     lap = jax.random.laplace(k_lap, (num_samples, b, t_len)) * lam[None, :, None]
     new_delta = ind * lap  # (S, B, T)
 
-    det = trend_fn(p, data, config)  # (B, T) deterministic trend
+    if det is None:
+        det = trend_fn(p, data, config)  # (B, T) deterministic trend
 
     if config.growth == "linear":
         # Slope change delta_j at future grid point t_j adds
@@ -175,6 +177,65 @@ def _simulate_trends(
         )(d_ext, s_ext)
         return sim
     return jnp.broadcast_to(det[None], (num_samples,) + det.shape)
+
+
+def forecast_from_draws(
+    samples: jnp.ndarray,
+    data: FitData,
+    meta: ScalingMeta,
+    config: ProphetConfig,
+    key: jax.Array,
+    interval_width: Optional[float] = None,
+) -> Dict[str, jnp.ndarray]:
+    """Posterior-predictive forecast from (S, B, P) MCMC draws.
+
+    Unlike the MAP path (:func:`forecast`), every component — trend,
+    seasonality, regressors, observation noise — carries posterior
+    uncertainty: each draw contributes one full trajectory (with its own
+    simulated future changepoints), and intervals are quantiles across draws.
+    ``yhat`` is the posterior-predictive mean.
+    """
+    s_draws = samples.shape[0]
+    keys = jax.random.split(key, s_draws + 1)
+
+    def one_draw(theta_s, k):
+        k_tr, k_noise = jax.random.split(k)
+        p = unpack(theta_s, config)
+        add, mult = seasonal_split(theta_s, data, config)
+        # Deterministic trajectory for the point forecast; simulated future
+        # changepoints + observation noise only feed the quantile draws, so
+        # yhat stays seed-independent posterior structure, not MC noise.
+        det_tr = trend_fn(p, data, config)
+        det_yhat = det_tr * (1.0 + mult) + add
+        tr = _simulate_trends(
+            k_tr, theta_s, data, config, num_samples=1, det=det_tr
+        )[0]
+        sigma = jnp.exp(p.log_sigma)[:, None]
+        noise = jax.random.normal(k_noise, tr.shape) * sigma
+        yhat = tr * (1.0 + mult) + add + noise
+        return yhat, tr, det_yhat, det_tr, add, mult
+
+    yhat_s, trend_s, det_yhat_s, det_trend_s, add_s, mult_s = jax.vmap(one_draw)(
+        samples, keys[:s_draws]
+    )
+
+    scale = meta.y_scale[:, None]
+    floor = meta.floor[:, None]
+    width = config.interval_width if interval_width is None else interval_width
+    lo_q = (1.0 - width) / 2.0
+    hi_q = 1.0 - lo_q
+    qs = jnp.quantile(yhat_s, jnp.asarray([lo_q, hi_q]), axis=0)
+    t_qs = jnp.quantile(trend_s, jnp.asarray([lo_q, hi_q]), axis=0)
+    return {
+        "yhat": det_yhat_s.mean(0) * scale + floor,
+        "trend": det_trend_s.mean(0) * scale + floor,
+        "additive": add_s.mean(0) * scale,
+        "multiplicative": mult_s.mean(0),
+        "yhat_lower": qs[0] * scale + floor,
+        "yhat_upper": qs[1] * scale + floor,
+        "trend_lower": t_qs[0] * scale + floor,
+        "trend_upper": t_qs[1] * scale + floor,
+    }
 
 
 def forecast(

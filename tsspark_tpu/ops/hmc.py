@@ -1,0 +1,259 @@
+"""Batched Hamiltonian Monte Carlo with Stan-style warmup adaptation.
+
+The reference's MAP loop (``tsspark.fit.prophet``, BASELINE.json:5) is a
+point estimate; upstream Prophet optionally runs full-posterior NUTS via Stan
+(``mcmc_samples=N``) to get seasonality uncertainty.  This module is the
+TPU-native equivalent: ONE chain PER SERIES, all chains advanced in lockstep
+as a single ``lax.scan`` program — a (B, P) leapfrog step is a handful of
+fused VPU ops, so 30k chains cost barely more than one.
+
+Adaptation follows Stan's scheme, simplified to two static-shape phases so it
+lives inside one scan with no data-dependent control flow:
+
+  phase A (first half of warmup): dual-averaging step size (Nesterov; per
+    chain) against a unit metric while a Welford accumulator estimates the
+    posterior variance;
+  phase B (second half): metric is set to the phase-A variance estimate,
+    dual averaging restarts, Welford restarts; at the end the metric is
+    updated again and the step size freezes at the averaged iterate.
+
+Momenta are sampled per chain with the diagonal metric M^-1 = var(theta), the
+standard choice that rescales ill-conditioned Prophet posteriors (trend rates
+vs. Fourier betas live on very different scales).  Trajectory length is a
+fixed number of leapfrog steps with multiplicative step-size jitter to avoid
+periodic-orbit resonance.  Divergences (non-finite Hamiltonian) auto-reject
+for the affected chain only.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tsspark_tpu.config import McmcConfig
+
+# Dual-averaging constants (Hoffman & Gelman 2014, as used by Stan).
+_DA_GAMMA = 0.05
+_DA_T0 = 10.0
+_DA_KAPPA = 0.75
+
+
+class _ChainState(NamedTuple):
+    theta: jnp.ndarray        # (B, P) current positions
+    logp: jnp.ndarray         # (B,)   cached log density
+    grad: jnp.ndarray         # (B, P) cached gradient of log density
+    inv_mass: jnp.ndarray     # (B, P) diagonal metric M^-1 (~ posterior var)
+    # dual averaging (per chain)
+    log_step: jnp.ndarray     # (B,)
+    log_step_avg: jnp.ndarray # (B,)
+    da_stat: jnp.ndarray      # (B,)   running H_t statistic
+    da_mu: jnp.ndarray        # (B,)   shrinkage target
+    # Welford variance accumulator
+    w_count: jnp.ndarray      # ()
+    w_mean: jnp.ndarray       # (B, P)
+    w_m2: jnp.ndarray         # (B, P)
+
+
+class HmcResult(NamedTuple):
+    samples: jnp.ndarray      # (S, B, P) post-warmup draws
+    accept_rate: jnp.ndarray  # (B,) mean acceptance prob over sampling
+    step_size: jnp.ndarray    # (B,) adapted step size
+    inv_mass: jnp.ndarray     # (B, P) adapted diagonal metric
+    divergences: jnp.ndarray  # (B,) divergent-transition count over sampling
+
+
+def _leapfrog(logdensity_and_grad, theta, r, grad, eps, inv_mass, n_steps):
+    """n_steps of leapfrog; eps is per-chain (B, 1)."""
+
+    def step(carry, _):
+        th, mom, g = carry
+        mom_half = mom + 0.5 * eps * g
+        th_new = th + eps * inv_mass * mom_half
+        logp_new, g_new = logdensity_and_grad(th_new)
+        mom_new = mom_half + 0.5 * eps * g_new
+        return (th_new, mom_new, g_new), logp_new
+
+    (theta_f, r_f, grad_f), logps = jax.lax.scan(
+        step, (theta, r, grad), None, length=n_steps
+    )
+    return theta_f, r_f, grad_f, logps[-1]
+
+
+def _hmc_transition(key, state: _ChainState, logdensity_and_grad, config: McmcConfig):
+    """One batched HMC proposal + per-chain Metropolis accept.
+
+    Returns (new_state, accept_prob (B,), divergent (B,)).
+    """
+    b, p = state.theta.shape
+    k_mom, k_jit, k_acc = jax.random.split(key, 3)
+
+    # r ~ N(0, M): std = 1/sqrt(inv_mass).
+    z = jax.random.normal(k_mom, (b, p), state.theta.dtype)
+    r0 = z / jnp.sqrt(jnp.maximum(state.inv_mass, 1e-12))
+
+    eps = jnp.exp(state.log_step)
+    if config.step_jitter > 0:
+        jit = jax.random.uniform(
+            k_jit, (b,), minval=1.0 - config.step_jitter,
+            maxval=1.0 + config.step_jitter,
+        )
+        eps = eps * jit
+    eps = eps[:, None]
+
+    theta1, r1, grad1, logp1 = _leapfrog(
+        logdensity_and_grad, state.theta, r0, state.grad, eps,
+        state.inv_mass, config.num_leapfrog,
+    )
+
+    kin0 = 0.5 * jnp.sum(r0 * r0 * state.inv_mass, axis=-1)
+    kin1 = 0.5 * jnp.sum(r1 * r1 * state.inv_mass, axis=-1)
+    h0 = -state.logp + kin0
+    h1 = -logp1 + kin1
+    log_alpha = jnp.minimum(0.0, h0 - h1)
+    divergent = ~jnp.isfinite(h1) | ((h1 - h0) > config.divergence_threshold)
+    accept_prob = jnp.where(divergent, 0.0, jnp.exp(log_alpha))
+
+    u = jax.random.uniform(k_acc, (b,))
+    accept = (u < accept_prob) & ~divergent
+    acc = accept[:, None]
+    new_state = state._replace(
+        theta=jnp.where(acc, theta1, state.theta),
+        logp=jnp.where(accept, logp1, state.logp),
+        grad=jnp.where(acc, grad1, state.grad),
+    )
+    return new_state, accept_prob, divergent
+
+
+def _da_update(state: _ChainState, accept_prob, i, config: McmcConfig):
+    """Per-chain Nesterov dual averaging toward target acceptance."""
+    t = i + _DA_T0
+    eta = 1.0 / t
+    stat = (1.0 - eta) * state.da_stat + eta * (config.target_accept - accept_prob)
+    log_step = state.da_mu - jnp.sqrt(t) / _DA_GAMMA * stat
+    w = t ** (-_DA_KAPPA)
+    log_step_avg = w * log_step + (1.0 - w) * state.log_step_avg
+    return state._replace(
+        da_stat=stat, log_step=log_step, log_step_avg=log_step_avg
+    )
+
+
+def _welford_update(state: _ChainState, theta):
+    c = state.w_count + 1.0
+    d = theta - state.w_mean
+    mean = state.w_mean + d / c
+    m2 = state.w_m2 + d * (theta - mean)
+    return state._replace(w_count=c, w_mean=mean, w_m2=m2)
+
+
+def _welford_var(state: _ChainState, regularize: bool = True):
+    n = jnp.maximum(state.w_count - 1.0, 1.0)
+    var = state.w_m2 / n
+    if regularize:
+        # Stan's shrinkage toward unit metric for short windows.
+        w = state.w_count / (state.w_count + 5.0)
+        var = w * var + (1.0 - w) * 1e-3
+    return jnp.maximum(var, 1e-10)
+
+
+def sample(
+    logdensity_fn: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    theta0: jnp.ndarray,
+    key: jax.Array,
+    config: McmcConfig,
+) -> HmcResult:
+    """Run B parallel HMC chains from theta0 (B, P).
+
+    Args:
+      logdensity_fn: (B, P) -> ((B,) log densities, (B, P) gradients).  The
+        whole batch in one call — callers use the same one-backward-pass vjp
+        trick as the MAP loss.
+      theta0: per-chain initial positions (typically the MAP fit, jittered).
+      key: PRNG key.
+      config: sampler settings.
+
+    Returns:
+      HmcResult with (num_samples, B, P) draws.
+    """
+    theta0 = jnp.asarray(theta0)
+    b, p = theta0.shape
+    logp0, grad0 = logdensity_fn(theta0)
+
+    init_log_step = jnp.full((b,), jnp.log(config.init_step_size), theta0.dtype)
+    state = _ChainState(
+        theta=theta0,
+        logp=logp0,
+        grad=grad0,
+        inv_mass=jnp.ones((b, p), theta0.dtype),
+        log_step=init_log_step,
+        log_step_avg=init_log_step,
+        da_stat=jnp.zeros((b,), theta0.dtype),
+        da_mu=jnp.log(10.0) + init_log_step,
+        w_count=jnp.zeros((), theta0.dtype),
+        w_mean=jnp.zeros((b, p), theta0.dtype),
+        w_m2=jnp.zeros((b, p), theta0.dtype),
+    )
+
+    warmup = config.num_warmup
+    phase_a = warmup // 2
+
+    def warmup_step(carry, inp):
+        state, da_i = carry
+        i, k = inp
+        state, accept_prob, _ = _hmc_transition(k, state, logdensity_fn, config)
+        state = _da_update(state, accept_prob, da_i, config)
+        state = _welford_update(state, state.theta)
+
+        # Phase switch: install the estimated metric, restart adaptation.
+        def switch(s: _ChainState) -> _ChainState:
+            var = _welford_var(s)
+            ls = s.log_step_avg  # keep the adapted scale as the new start
+            return s._replace(
+                inv_mass=var,
+                log_step=ls,
+                log_step_avg=ls,
+                da_stat=jnp.zeros_like(s.da_stat),
+                da_mu=jnp.log(10.0) + ls,
+                w_count=jnp.zeros_like(s.w_count),
+                w_mean=jnp.zeros_like(s.w_mean),
+                w_m2=jnp.zeros_like(s.w_m2),
+            )
+
+        at_switch = i == (phase_a - 1)
+        state = jax.tree.map(
+            lambda a, b_: jnp.where(at_switch, a, b_), switch(state), state
+        )
+        da_i = jnp.where(at_switch, 0.0, da_i + 1.0)
+        return (state, da_i), None
+
+    keys = jax.random.split(key, warmup + config.num_samples + 1)
+    (state, _), _ = jax.lax.scan(
+        warmup_step,
+        (state, jnp.ones((), theta0.dtype)),
+        (jnp.arange(warmup), keys[:warmup]),
+    )
+
+    # Freeze: final metric from phase-B stats, step size = averaged iterate.
+    state = state._replace(
+        inv_mass=_welford_var(state),
+        log_step=state.log_step_avg,
+    )
+
+    def sample_step(state, k):
+        state, accept_prob, divergent = _hmc_transition(
+            k, state, logdensity_fn, config
+        )
+        return state, (state.theta, accept_prob, divergent)
+
+    state, (draws, accepts, divs) = jax.lax.scan(
+        sample_step, state, keys[warmup : warmup + config.num_samples]
+    )
+
+    return HmcResult(
+        samples=draws,
+        accept_rate=accepts.mean(axis=0),
+        step_size=jnp.exp(state.log_step),
+        inv_mass=state.inv_mass,
+        divergences=divs.sum(axis=0).astype(jnp.int32),
+    )
