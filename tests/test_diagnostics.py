@@ -1,0 +1,100 @@
+"""Pandas-level cross_validation / performance_metrics diagnostics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tsspark_tpu import Forecaster, ProphetConfig, SeasonalityConfig
+from tsspark_tpu.eval import diagnostics
+
+
+@pytest.fixture(scope="module")
+def cv_df():
+    rng = np.random.default_rng(3)
+    n = 240
+    ds = pd.date_range("2023-01-01", periods=n, freq="D")
+    t = np.arange(n)
+    frames = []
+    for i in range(3):
+        y = 10 + 0.03 * t + 2 * np.sin(2 * np.pi * t / 7) + rng.normal(0, 0.3, n)
+        frames.append(pd.DataFrame({"series_id": f"s{i}", "ds": ds, "y": y}))
+    df = pd.concat(frames, ignore_index=True)
+
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(SeasonalityConfig("weekly", 7.0, 3),),
+                      n_changepoints=5),
+        backend="tpu",
+    )
+    return diagnostics.cross_validation(
+        fc, df, horizon="14D", period="30D", initial="90D"
+    )
+
+
+def test_cross_validation_frame_shape(cv_df):
+    assert set(cv_df.columns) == {
+        "series_id", "ds", "cutoff", "y", "yhat", "yhat_lower", "yhat_upper"
+    }
+    # Every row is within (cutoff, cutoff + horizon].
+    gap = (cv_df["ds"] - cv_df["cutoff"]) / pd.Timedelta(days=1)
+    assert (gap > 0).all() and (gap <= 14).all()
+    # All series, several cutoffs.
+    assert set(cv_df["series_id"]) == {"s0", "s1", "s2"}
+    assert cv_df["cutoff"].nunique() >= 3
+    # Forecast quality: this synthetic signal is easy.
+    mae = (cv_df["y"] - cv_df["yhat"]).abs().mean()
+    assert mae < 1.0
+    assert (cv_df["yhat_lower"] <= cv_df["yhat_upper"]).all()
+
+
+def test_performance_metrics_table(cv_df):
+    pm = diagnostics.performance_metrics(cv_df, rolling_window=0.1)
+    assert {"horizon", "mse", "rmse", "mae", "mape", "mdape", "smape",
+            "coverage"} <= set(pm.columns)
+    assert pm["horizon"].is_monotonic_increasing
+    assert (pm["rmse"] >= pm["mae"] * 0.99).all()  # rmse >= mae always
+    assert pm["smape"].between(0, 2).all()
+    assert pm["coverage"].between(0, 1).all()
+    # Horizon column stays a timedelta for datetime inputs.
+    assert pd.api.types.is_timedelta64_dtype(pm["horizon"])
+
+
+def test_performance_metrics_no_smoothing(cv_df):
+    pm = diagnostics.performance_metrics(cv_df, rolling_window=0)
+    # One row per distinct horizon step.
+    assert pm["horizon"].is_unique
+    assert len(pm) == 14
+    # Exact per-horizon average, not a single sample: recompute by hand.
+    h1 = cv_df[(cv_df["ds"] - cv_df["cutoff"]) == pd.Timedelta(days=1)]
+    expect_mae = (h1["y"] - h1["yhat"]).abs().mean()
+    got = pm.loc[pm["horizon"] == pd.Timedelta(days=1), "mae"].iloc[0]
+    assert got == pytest.approx(expect_mae, rel=1e-9)
+
+
+def test_cross_validation_rejects_nonpositive_horizon(cv_df):
+    fc = Forecaster(ProphetConfig(seasonalities=(), n_changepoints=2))
+    df = pd.DataFrame({"series_id": "a", "ds": np.arange(50.0),
+                       "y": np.arange(50.0)})
+    for bad in (0, -14, "-14D"):
+        with pytest.raises(ValueError, match="positive"):
+            diagnostics.cross_validation(fc, df, horizon=bad)
+
+
+def test_performance_metrics_rejects_unknown_metric(cv_df):
+    with pytest.raises(ValueError, match="unknown metrics"):
+        diagnostics.performance_metrics(cv_df, metrics=("mae", "nope"))
+
+
+def test_cross_validation_numeric_ds():
+    rng = np.random.default_rng(5)
+    n = 200
+    t = np.arange(n, dtype=float)
+    df = pd.DataFrame({
+        "series_id": "a",
+        "ds": t,
+        "y": 5 + 0.1 * t + rng.normal(0, 0.2, n),
+    })
+    fc = Forecaster(ProphetConfig(seasonalities=(), n_changepoints=3))
+    cv = diagnostics.cross_validation(fc, df, horizon=10, period=40,
+                                      initial=100)
+    assert np.issubdtype(cv["ds"].dtype, np.floating)
+    assert ((cv["ds"] - cv["cutoff"]) <= 10).all()

@@ -1,0 +1,152 @@
+"""Spark adapter (duck-typed fake session) + CLI + forecaster checkpoints."""
+
+import json
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tsspark_tpu import Forecaster, ProphetConfig, SeasonalityConfig
+from tsspark_tpu.spark import SparkForecaster, forecast_spark
+from tsspark_tpu.utils import checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _long_df(b=2, n=150, seed=0):
+    rng = np.random.default_rng(seed)
+    ds = pd.date_range("2024-01-01", periods=n, freq="D")
+    t = np.arange(n)
+    frames = [
+        pd.DataFrame({
+            "series_id": f"s{i}",
+            "ds": ds,
+            "y": 8 + 0.05 * t + 2 * np.sin(2 * np.pi * t / 7)
+                 + rng.normal(0, 0.3, n),
+        })
+        for i in range(b)
+    ]
+    return pd.concat(frames, ignore_index=True)
+
+
+_CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 3),), n_changepoints=5
+)
+
+
+# -- fake Spark surface ------------------------------------------------------
+
+class FakeSession:
+    def createDataFrame(self, pdf):
+        return FakeSparkFrame(pdf, self)
+
+
+class FakeSparkFrame:
+    def __init__(self, pdf, session=None):
+        self._pdf = pdf
+        self.sparkSession = session or FakeSession()
+
+    def toPandas(self):
+        return self._pdf.copy()
+
+
+def test_spark_adapter_round_trip():
+    sdf = FakeSparkFrame(_long_df())
+    out = forecast_spark(sdf, Forecaster(_CFG), horizon=14)
+    assert isinstance(out, FakeSparkFrame)
+    pdf = out.toPandas()
+    assert {"series_id", "ds", "yhat", "yhat_lower", "yhat_upper"} <= set(
+        pdf.columns
+    )
+    assert len(pdf) == 2 * 14
+    assert np.isfinite(pdf["yhat"]).all()
+
+
+def test_spark_adapter_rejects_non_spark_input():
+    with pytest.raises(TypeError, match="toPandas"):
+        SparkForecaster(Forecaster(_CFG)).fit(_long_df())
+
+
+def test_spark_adapter_predict_before_fit():
+    with pytest.raises(RuntimeError, match="before fit"):
+        SparkForecaster(Forecaster(_CFG)).predict(horizon=3)
+
+
+# -- forecaster checkpoint round trip ---------------------------------------
+
+def test_save_load_forecaster(tmp_path):
+    df = _long_df()
+    fc = Forecaster(_CFG)
+    fc.fit(df)
+    expected = fc.predict(horizon=7)
+
+    path = str(tmp_path / "model.npz")
+    checkpoint.save_forecaster(path, fc)
+    fc2 = checkpoint.load_forecaster(path)
+    got = fc2.predict(horizon=7)
+
+    pd.testing.assert_frame_equal(
+        expected.reset_index(drop=True), got.reset_index(drop=True)
+    )
+
+
+def test_save_forecaster_requires_fitted(tmp_path):
+    with pytest.raises(ValueError, match="fitted"):
+        checkpoint.save_forecaster(str(tmp_path / "m.npz"), Forecaster(_CFG))
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _run_cli(args, tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    return subprocess.run(
+        [sys.executable, "-m", "tsspark_tpu", *args],
+        capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        timeout=300,
+    )
+
+
+@pytest.mark.slow
+def test_cli_forecast_and_backtest(tmp_path):
+    _long_df().to_csv(tmp_path / "input.csv", index=False)
+
+    r = _run_cli([
+        "forecast", "--input", "input.csv", "--horizon", "7",
+        "--output", "fc.csv", "--seasonality", "weekly",
+        "--n-changepoints", "5", "--max-iters", "80",
+    ], tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    fc = pd.read_csv(tmp_path / "fc.csv")
+    assert {"series_id", "ds", "yhat"} <= set(fc.columns)
+    assert len(fc) == 2 * 7
+
+    r = _run_cli([
+        "fit", "--input", "input.csv", "--model", "model.npz",
+        "--seasonality", "weekly", "--n-changepoints", "5",
+        "--max-iters", "80",
+    ], tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    meta = json.loads(r.stdout.strip().splitlines()[-1])
+    assert meta["n_series"] == 2
+
+    r = _run_cli([
+        "predict", "--model", "model.npz", "--horizon", "5",
+        "--output", "pred.csv",
+    ], tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert len(pd.read_csv(tmp_path / "pred.csv")) == 2 * 5
+
+    r = _run_cli([
+        "backtest", "--input", "input.csv", "--horizon", "7",
+        "--period", "30", "--initial", "90", "--output", "pm.csv",
+        "--seasonality", "weekly", "--n-changepoints", "5",
+        "--max-iters", "80",
+    ], tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    pm = pd.read_csv(tmp_path / "pm.csv")
+    assert {"horizon", "smape", "rmse"} <= set(pm.columns)

@@ -1,0 +1,97 @@
+"""Optional Spark adapter: drive the TPU backend from a Spark DataFrame.
+
+The reference runs per-series fits *inside* Spark executors (a
+``mapPartitions`` UDF per partition, BASELINE.json:5).  On TPU the economics
+invert: one chip fits tens of thousands of series per second, so shipping
+model code to executors buys nothing — the adapter instead implements the
+driver-side collapse the north star prescribes (collect -> shard -> fit ->
+scatter):
+
+  1. collect the long DataFrame to the driver (toPandas, Arrow-backed),
+  2. run the batched fit/predict through the normal Forecaster,
+  3. hand the forecast frame back as a Spark DataFrame (createDataFrame).
+
+PySpark is NOT installed in this image; the adapter is import-gated and the
+test suite exercises it with a duck-typed fake (tests/test_spark_adapter.py).
+Anything exposing ``toPandas()`` and a ``sparkSession.createDataFrame(pdf)``
+works — real pyspark included.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import pandas as pd
+
+from tsspark_tpu.frame import Forecaster
+
+
+def _require_to_pandas(sdf: Any) -> pd.DataFrame:
+    to_pandas = getattr(sdf, "toPandas", None)
+    if to_pandas is None:
+        raise TypeError(
+            f"expected a Spark DataFrame (needs .toPandas()), got {type(sdf)!r}"
+        )
+    return to_pandas()
+
+
+def _spark_session(sdf: Any):
+    session = getattr(sdf, "sparkSession", None) or getattr(sdf, "sql_ctx", None)
+    if session is None:
+        raise TypeError(
+            "cannot locate a SparkSession on the input DataFrame "
+            "(.sparkSession / .sql_ctx)"
+        )
+    return session
+
+
+class SparkForecaster:
+    """Fit/predict over Spark DataFrames with a TPU-batched driver-side core.
+
+    Example (on a real cluster)::
+
+        sfc = SparkForecaster(Forecaster(cfg, backend="tpu"))
+        sfc.fit(spark_df)                      # long: series_id, ds, y
+        out = sfc.predict(horizon=28)          # Spark DataFrame back
+    """
+
+    def __init__(self, forecaster: Forecaster):
+        self.forecaster = forecaster
+        self._session = None
+
+    def fit(self, sdf: Any) -> "SparkForecaster":
+        pdf = _require_to_pandas(sdf)
+        self._session = _spark_session(sdf)
+        self.forecaster.fit(pdf)
+        return self
+
+    def predict(
+        self,
+        horizon: Optional[int] = None,
+        future_sdf: Optional[Any] = None,
+        include_history: bool = False,
+    ) -> Any:
+        if self._session is None:
+            raise RuntimeError("predict before fit")
+        future_pdf = (
+            _require_to_pandas(future_sdf) if future_sdf is not None else None
+        )
+        out = self.forecaster.predict(
+            horizon=horizon, future_df=future_pdf,
+            include_history=include_history,
+        )
+        return self._session.createDataFrame(out)
+
+
+def forecast_spark(
+    sdf: Any,
+    forecaster: Forecaster,
+    horizon: Optional[int] = None,
+    include_history: bool = False,
+) -> Any:
+    """One-shot convenience: fit on ``sdf`` and return the forecast frame."""
+    return (
+        SparkForecaster(forecaster)
+        .fit(sdf)
+        .predict(horizon, include_history=include_history)
+    )

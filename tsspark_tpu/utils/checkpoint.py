@@ -63,6 +63,96 @@ def save_state(
         json.dump(sidecar, f)
 
 
+def save_forecaster(path: str, fc) -> None:
+    """Persist a fitted Forecaster (state + config + frame context).
+
+    Everything needed for ``load_forecaster(path).predict(...)`` in a fresh
+    process: the FitState arrays, the model config, holiday calendars, and
+    the pandas-front-end context (column names, training grid, datetime
+    flag).  The CLI's ``fit`` -> ``predict`` round trip rides on this.
+    """
+    from tsspark_tpu.frame import Forecaster  # local: avoid import cycle
+
+    if not isinstance(fc, Forecaster) or fc.state is None:
+        raise ValueError("save_forecaster needs a fitted Forecaster")
+    path = _base(path)
+    save_state(path, fc.state, fc.config, series_ids=fc.series_ids)
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    # The model config is stored without holidays' auto-added regressor
+    # columns duplicated: fc.config already includes them, and the holiday
+    # calendars themselves are stored to rebuild indicator features.
+    sidecar["forecaster"] = {
+        "config": dataclasses.asdict(fc.config),
+        "backend": fc.backend.name,
+        "id_col": fc.id_col, "ds_col": fc.ds_col, "y_col": fc.y_col,
+        "cap_col": fc.cap_col, "floor_col": fc.floor_col,
+        "regressor_cols": list(fc.regressor_cols),
+        "holidays": [dataclasses.asdict(h) for h in fc.holidays],
+        "was_datetime": fc._was_datetime,
+        "train_ds": None if fc._train_ds is None else
+            [float(v) for v in fc._train_ds],
+        "freq_days": fc._freq_days,
+        "solver_config": dataclasses.asdict(fc.backend.solver_config),
+    }
+    with open(path + ".json", "w") as f:
+        json.dump(sidecar, f)
+
+
+def _config_from_dict(d: Dict) -> ProphetConfig:
+    from tsspark_tpu.config import RegressorConfig, SeasonalityConfig
+
+    d = dict(d)
+    d["seasonalities"] = tuple(
+        SeasonalityConfig(**s) for s in d.get("seasonalities", ())
+    )
+    d["regressors"] = tuple(
+        RegressorConfig(**r) for r in d.get("regressors", ())
+    )
+    return ProphetConfig(**d)
+
+
+def load_forecaster(path: str):
+    """Rebuild a fitted Forecaster saved by :func:`save_forecaster`."""
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.frame import Forecaster
+    from tsspark_tpu.models.holidays import Holiday
+
+    path = _base(path)
+    with open(path + ".json") as f:
+        sidecar = json.load(f)
+    ctx = sidecar.get("forecaster")
+    if ctx is None:
+        raise ValueError(
+            f"{path}.json has no forecaster context (state-only checkpoint; "
+            "use load_state)"
+        )
+    config = _config_from_dict(ctx["config"])
+    holidays = tuple(
+        Holiday(**{**h, "dates": tuple(h["dates"])}) for h in ctx["holidays"]
+    )
+    # Holiday regressor columns are already part of the stored config;
+    # constructing with holidays would re-append them, so attach afterwards.
+    fc = Forecaster(
+        config,
+        solver_config=SolverConfig(**ctx["solver_config"]),
+        backend=ctx["backend"],
+        id_col=ctx["id_col"], ds_col=ctx["ds_col"], y_col=ctx["y_col"],
+        cap_col=ctx["cap_col"], floor_col=ctx["floor_col"],
+        regressor_cols=tuple(ctx["regressor_cols"]),
+    )
+    fc.holidays = holidays
+    state, ids = load_state(path, config)
+    fc.state = state
+    fc.series_ids = ids
+    fc._was_datetime = ctx["was_datetime"]
+    fc._train_ds = None if ctx["train_ds"] is None else np.asarray(
+        ctx["train_ds"], np.float64
+    )
+    fc._freq_days = ctx["freq_days"]
+    return fc
+
+
 def load_state(
     path: str, config: ProphetConfig, strict: bool = True
 ) -> Tuple[FitState, Optional[np.ndarray]]:
