@@ -104,7 +104,7 @@ def fit_worker(args) -> int:
 
     backend = get_backend(
         "tpu", _model_config(), SolverConfig(max_iters=args.max_iters),
-        chunk_size=args.chunk,
+        chunk_size=args.chunk, iter_segment=args.segment or None,
     )
     ds_j = jnp.asarray(ds)
 
@@ -252,6 +252,9 @@ def main() -> None:
     ap.add_argument("--days", type=int, default=1941)
     ap.add_argument("--chunk", type=int, default=2048)
     ap.add_argument("--max-iters", type=int, default=120)
+    ap.add_argument("--segment", type=int, default=24,
+                    help="solver iterations per XLA dispatch (0 = one "
+                         "program for the full solve)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for a quick pipeline check")
     ap.add_argument("--keep", action="store_true",
@@ -304,6 +307,7 @@ def main() -> None:
         rc = _spawn("--_fit", args, [
             "--lo", str(missing[0][0]), "--hi", str(missing[-1][1]),
             "--chunk", str(chunk), "--max-iters", str(args.max_iters),
+            "--segment", str(args.segment),
         ], timeout=budget)
         if rc == 0:
             continue  # re-scan; loop exits when nothing is missing
@@ -374,6 +378,7 @@ if __name__ == "__main__":
         ap.add_argument("--hi", type=int, default=0)
         ap.add_argument("--chunk", type=int, default=2048)
         ap.add_argument("--max-iters", type=int, default=120)
+        ap.add_argument("--segment", type=int, default=24)
         ap.add_argument("--n-eval", type=int, default=512)
         a = ap.parse_args()
         sys.exit(fit_worker(a) if mode == "--_fit" else eval_worker(a))

@@ -90,3 +90,56 @@ def test_tpu_chunked_fit_matches_unchunked(small_batch):
     np.testing.assert_allclose(
         np.asarray(chunked.loss), np.asarray(whole.loss), rtol=1e-3, atol=1e-3
     )
+
+
+def test_tpu_backend_iter_segment_matches_full_solve():
+    """Segmented dispatches (iter_segment) reach the same optimum quality."""
+    import numpy as np
+    import jax.numpy as jnp
+    from tsspark_tpu.backends.registry import get_backend
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=4
+    )
+    rng = np.random.default_rng(7)
+    n = 200
+    ds = jnp.arange(n, dtype=jnp.float32)
+    t = np.arange(n)
+    y = jnp.asarray(
+        (4 + 0.02 * t + np.sin(2 * np.pi * t / 7)
+         + rng.normal(0, 0.2, (3, n))).astype(np.float32)
+    )
+    solver = SolverConfig(max_iters=120)
+    full = get_backend("tpu", cfg, solver).fit(ds, y)
+    seg = get_backend("tpu", cfg, solver, iter_segment=16).fit(ds, y)
+    # Same posterior optimum to within solver noise.
+    assert np.allclose(np.asarray(seg.loss), np.asarray(full.loss),
+                       rtol=1e-3, atol=1e-2)
+    assert bool(seg.converged.all())
+    # Accumulated iteration counts are reported across segments.
+    assert int(np.asarray(seg.n_iters).max()) >= 16
+
+
+def test_cpu_backend_components():
+    """components is part of the backend interface (base-class default)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from tsspark_tpu.backends.registry import get_backend
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig
+
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+    )
+    rng = np.random.default_rng(11)
+    n = 120
+    ds = jnp.arange(n, dtype=jnp.float32)
+    y = jnp.asarray(
+        (3 + np.sin(2 * np.pi * np.arange(n) / 7)
+         + rng.normal(0, 0.2, (2, n))).astype(np.float32)
+    )
+    bk = get_backend("cpu", cfg)
+    state = bk.fit(ds, y)
+    comps = bk.components(state, ds)
+    assert set(comps) == {"trend", "weekly"}
+    assert np.asarray(comps["weekly"]).shape == (2, n)

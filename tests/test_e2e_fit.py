@@ -54,7 +54,7 @@ def test_holdout_forecast(peyton_fit):
 def test_components_decompose(peyton_fit):
     batch, holdout, model, state = peyton_fit
     comps = model.components(state, batch.ds[:-holdout])
-    assert set(comps) == {"yearly", "weekly"}
+    assert set(comps) == {"trend", "yearly", "weekly"}
     # Weekly component must actually oscillate with period 7.
     wk = np.asarray(comps["weekly"][0])
     assert wk.std() > 0.05

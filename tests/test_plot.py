@@ -1,0 +1,67 @@
+"""Plotting module: figures render with the right artists (Agg backend)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tsspark_tpu import Forecaster, ProphetConfig, SeasonalityConfig
+from tsspark_tpu import plot as plot_mod
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(1)
+    n = 180
+    ds = pd.date_range("2024-01-01", periods=n, freq="D")
+    t = np.arange(n)
+    df = pd.concat([
+        pd.DataFrame({"series_id": f"s{i}", "ds": ds,
+                      "y": 6 + 0.03 * t + 2 * np.sin(2 * np.pi * t / 7)
+                           + rng.normal(0, 0.3, n)})
+        for i in range(2)
+    ], ignore_index=True)
+    fc = Forecaster(ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 3),),
+        n_changepoints=4,
+    ))
+    fc.fit(df)
+    return fc, df
+
+
+def test_plot_forecast(fitted):
+    fc, df = fitted
+    out = fc.predict(horizon=21, include_history=True)
+    ax = plot_mod.plot_forecast(out, history_df=df, series_id="s1")
+    assert ax.get_title() == "s1"
+    # forecast line + interval band + observed points all present
+    assert len(ax.lines) >= 2
+    assert len(ax.collections) >= 1
+    ax.figure.canvas.draw()  # renders without error
+    import matplotlib.pyplot as plt
+
+    plt.close(ax.figure)
+
+
+def test_plot_forecast_unknown_series(fitted):
+    fc, _ = fitted
+    out = fc.predict(horizon=7)
+    with pytest.raises(ValueError, match="not present"):
+        plot_mod.plot_forecast(out, series_id="nope")
+
+
+def test_plot_components(fitted):
+    fc, _ = fitted
+    ds, comps = fc.components(horizon=14)
+    assert "weekly" in comps
+    assert comps["weekly"].shape[0] == 2
+    fig = plot_mod.plot_components(comps, ds, series_index=0)
+    labels = [ax.get_ylabel() for ax in fig.axes]
+    assert "weekly" in labels
+    fig.canvas.draw()
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)

@@ -43,6 +43,19 @@ class ForecastBackend(abc.ABC):
                 num_samples=None):
         """Forecast a fitted state on a time grid; returns dict of arrays."""
 
+    def components(self, state, ds, cap=None, regressors=None):
+        """Per-block component arrays for a fitted state.
+
+        Decomposition is pure model math on the fitted parameters — identical
+        for every backend — so the base class provides it; backends override
+        only if they carry a differently-shaped state.
+        """
+        from tsspark_tpu.models.prophet.model import ProphetModel
+
+        return ProphetModel(self.config, self.solver_config).components(
+            state, ds, cap=cap, regressors=regressors
+        )
+
 
 _REGISTRY: Dict[str, Type[ForecastBackend]] = {}
 

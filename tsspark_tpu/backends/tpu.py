@@ -42,9 +42,21 @@ def _concat_states(states) -> FitState:
 class TpuBackend(ForecastBackend):
     name = "tpu"
 
-    def __init__(self, *args, chunk_size: int = 8192, **kwargs):
+    def __init__(self, *args, chunk_size: int = 8192,
+                 iter_segment: Optional[int] = None, **kwargs):
+        """chunk_size bounds series per program; iter_segment bounds solver
+        iterations per program.
+
+        ``iter_segment`` splits one long L-BFGS solve into several short
+        XLA executions with the full solver state carried across, so the
+        trajectory is identical to one long program.  Buys bounded
+        per-dispatch execution time — needed on runtimes that kill
+        long-running programs (the tunneled dev chip here), and useful for
+        checkpoint/preemption granularity generally.
+        """
         super().__init__(*args, **kwargs)
         self.chunk_size = chunk_size
+        self.iter_segment = iter_segment
         self._model = ProphetModel(self.config, self.solver_config)
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
@@ -92,7 +104,7 @@ class TpuBackend(ForecastBackend):
             init = _pad_batch(init, c) if init is not None else None
         state = self._model.fit(
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
-            init=init,
+            init=init, iter_segment=self.iter_segment,
         )
         return _slice_state(state, 0, b)
 

@@ -235,18 +235,7 @@ class Forecaster:
         if self.state is None:
             raise RuntimeError("fit before predict")
         if future_df is not None:
-            batch = pivot_long(
-                future_df, self.id_col, self.ds_col,
-                y_col=self.ds_col,  # y unused at predict; reuse ds column
-                cap_col=self.cap_col, floor_col=self.floor_col,
-                regressor_cols=self.regressor_cols,
-            )
-            # Align series order with training order.
-            order = {s: i for i, s in enumerate(batch.series_ids)}
-            perm = np.asarray([order[s] for s in self.series_ids])
-            grid = batch.ds
-            cap = None if batch.cap is None else batch.cap[perm]
-            reg = None if batch.regressors is None else batch.regressors[perm]
+            grid, cap, reg = self._align_future(future_df)
         else:
             if horizon is None:
                 raise ValueError("give horizon or future_df")
@@ -269,6 +258,60 @@ class Forecaster:
             seed=seed, num_samples=num_samples,
         )
         return self._to_long(grid, fc)
+
+    def _align_future(self, future_df: pd.DataFrame):
+        """Pivot a future frame and align its series order with training."""
+        batch = pivot_long(
+            future_df, self.id_col, self.ds_col,
+            y_col=self.ds_col,  # y unused at predict; reuse ds column
+            cap_col=self.cap_col, floor_col=self.floor_col,
+            regressor_cols=self.regressor_cols,
+        )
+        order = {s: i for i, s in enumerate(batch.series_ids)}
+        perm = np.asarray([order[s] for s in self.series_ids])
+        cap = None if batch.cap is None else batch.cap[perm]
+        reg = None if batch.regressors is None else batch.regressors[perm]
+        return batch.ds, cap, reg
+
+    def components(
+        self,
+        horizon: Optional[int] = None,
+        future_df: Optional[pd.DataFrame] = None,
+        include_history: bool = True,
+    ):
+        """Per-block component arrays for plotting / inspection.
+
+        Returns (ds_grid, components) where components maps each seasonality
+        and regressor name to a (B, T) array in data units (multiplicative
+        blocks in relative units), matching the training series order.
+        """
+        if self.state is None:
+            raise RuntimeError("fit before components")
+        if future_df is not None:
+            grid, cap, reg = self._align_future(future_df)
+        else:
+            if self.regressor_cols or self.cap_col:
+                raise ValueError(
+                    "models with regressors or caps need future_df for "
+                    "components"
+                )
+            grid = self.make_future_grid(
+                horizon or 0, include_history=include_history
+            )
+            if grid.size == 0:
+                raise ValueError(
+                    "components with horizon=0 and include_history=False "
+                    "selects no timestamps"
+                )
+            cap = reg = None
+        reg = self._combined_regressors(grid, reg, len(self.series_ids))
+        comps = self.backend.components(
+            self.state, jnp.asarray(grid),
+            cap=None if cap is None else jnp.asarray(np.nan_to_num(cap)),
+            regressors=None if reg is None else jnp.asarray(reg),
+        )
+        ds_out = _days_to_ts(grid) if self._was_datetime else grid
+        return ds_out, {k: np.asarray(v) for k, v in comps.items()}
 
     def _to_long(self, grid: np.ndarray, fc: Dict[str, jnp.ndarray]
                  ) -> pd.DataFrame:

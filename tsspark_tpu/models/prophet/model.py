@@ -51,6 +51,37 @@ def fit_core(
     return lbfgs.minimize(fun, theta0, solver_config)
 
 
+@functools.partial(jax.jit, static_argnames=("config", "solver_config"))
+def fit_init_core(
+    data: FitData,
+    theta0: jnp.ndarray,
+    config: ProphetConfig,
+    solver_config: SolverConfig,
+) -> lbfgs.LbfgsState:
+    """Jitted solver-state construction (for the segmented fit path)."""
+    fun = lambda th: value_and_grad_batch(th, data, config)
+    return lbfgs.init_state(fun, theta0, solver_config)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "solver_config", "num_iters"),
+    donate_argnames=("state",),
+)
+def fit_segment_core(
+    data: FitData,
+    state: lbfgs.LbfgsState,
+    config: ProphetConfig,
+    solver_config: SolverConfig,
+    num_iters: int,
+) -> lbfgs.LbfgsState:
+    """Advance a batched solve by ``num_iters`` iterations in ONE short XLA
+    program.  Chaining these reproduces fit_core's trajectory exactly (the
+    full LbfgsState round-trips), while bounding per-dispatch execution time
+    — the knob TpuBackend(iter_segment=...) exposes."""
+    fun = lambda th: value_and_grad_batch(th, data, config)
+    return lbfgs.run_segment(fun, state, solver_config, num_iters)
+
+
 class McmcState(NamedTuple):
     """Full-posterior fit: (S, B, P) draws + scaling metadata + diagnostics."""
 
@@ -125,25 +156,51 @@ class ProphetModel:
         floor: Optional[jnp.ndarray] = None,
         regressors: Optional[jnp.ndarray] = None,
         init: Optional[jnp.ndarray] = None,
+        iter_segment: Optional[int] = None,
     ) -> FitState:
         """Fit every series in the (B, T) batch.
 
         ``init`` warm-starts the solver from previous parameters (the
         streaming incremental-refit path, BASELINE.json:11).
+
+        ``iter_segment`` splits the solve into several short XLA executions
+        of at most that many iterations each, with the full solver state
+        carried across — the trajectory is IDENTICAL to one long program;
+        only the dispatch granularity changes.  Use it to bound
+        per-dispatch execution time (fragile tunneled runtimes) or to create
+        preemption points for elastic schedulers.
         """
         data, meta = prepare_fit_data(
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
             regressors=regressors,
         )
-        return self._fit_prepared(data, meta, init)
+        return self._fit_prepared(data, meta, init, iter_segment)
 
     def _fit_prepared(
-        self, data: FitData, meta: ScalingMeta, init: Optional[jnp.ndarray]
+        self,
+        data: FitData,
+        meta: ScalingMeta,
+        init: Optional[jnp.ndarray],
+        iter_segment: Optional[int] = None,
     ) -> FitState:
         theta0 = init if init is not None else init_theta(
             self.config, data.y, data.mask, data.t
         )
-        res = fit_core(data, theta0, self.config, self.solver_config)
+        solver = self.solver_config
+        if iter_segment and iter_segment < solver.max_iters:
+            ls = fit_init_core(data, theta0, self.config, solver)
+            for _ in range(-(-solver.max_iters // iter_segment)):
+                ls = fit_segment_core(
+                    data, ls, self.config, solver, iter_segment
+                )
+                # Block per segment: keeps every dispatch short AND surfaces
+                # a dead runtime at the segment boundary, not downstream.
+                jax.block_until_ready(ls.theta)
+                if bool(ls.converged.all()):
+                    break
+            res = lbfgs.to_result(ls)
+        else:
+            res = fit_core(data, theta0, self.config, solver)
         return FitState(
             theta=res.theta,
             meta=meta,

@@ -97,6 +97,7 @@ def component_breakdown(
     out: Dict[str, jnp.ndarray] = {}
     offset = 0
     scale = meta.y_scale[:, None]
+    out["trend"] = trend_fn(p, data, config) * scale + meta.floor[:, None]
     for s_cfg in config.seasonalities:
         nf = s_cfg.num_features
         beta_blk = jnp.zeros_like(p.beta).at[..., offset : offset + nf].set(

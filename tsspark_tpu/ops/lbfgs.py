@@ -33,8 +33,7 @@ The objective callable must map (B, P) params -> ((B,) losses, (B, P) grads).
 
 from __future__ import annotations
 
-import functools
-from typing import Callable, NamedTuple, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -109,26 +108,16 @@ def _two_loop_direction(state: LbfgsState, history: int) -> jnp.ndarray:
     return -r
 
 
-def minimize(
+def init_state(
     fun: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
     theta0: jnp.ndarray,
     config: SolverConfig = SolverConfig(),
-) -> LbfgsResult:
-    """Minimize a batch of independent objectives with shared compute.
-
-    Args:
-      fun: (B, P) -> ((B,) per-series losses, (B, P) per-series grads).
-      theta0: (B, P) initial parameters.
-
-    Returns:
-      LbfgsResult with per-series optimum, loss, grad inf-norm, convergence
-      flag and iteration count.
-    """
+) -> LbfgsState:
+    """Fresh solver state at theta0 (one objective evaluation)."""
     b, p = theta0.shape
     m = config.history
     f0, g0 = fun(theta0)
-
-    init = LbfgsState(
+    return LbfgsState(
         theta=theta0,
         f=f0,
         grad=g0,
@@ -141,8 +130,42 @@ def minimize(
         prev_step=jnp.full((b,), config.init_step, theta0.dtype),
     )
 
+
+def to_result(state: LbfgsState) -> LbfgsResult:
+    return LbfgsResult(
+        theta=state.theta,
+        f=state.f,
+        grad_norm=jnp.max(jnp.abs(state.grad), axis=-1),
+        converged=state.converged,
+        n_iters=state.n_iters,
+    )
+
+
+def run_segment(
+    fun: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    state: LbfgsState,
+    config: SolverConfig,
+    num_iters: Optional[int] = None,
+) -> LbfgsState:
+    """Advance the solver by up to ``num_iters`` iterations (bounded by
+    ``config.max_iters`` overall).
+
+    Resumable: feeding the returned state back continues the EXACT same
+    trajectory as one long run — history ring, per-series convergence masks,
+    and line-search step memory all carry across segments.  This is what
+    lets a driver split one logical solve into several short XLA executions
+    (bounded per-dispatch time for fragile runtimes, preemption points for
+    elastic schedulers) without changing the mathematics.
+    """
+    b, p = state.theta.shape
+    m = config.history
+    stop_at = jnp.minimum(
+        state.iteration + (config.max_iters if num_iters is None else num_iters),
+        config.max_iters,
+    )
+
     def cond(state: LbfgsState):
-        return (state.iteration < config.max_iters) & ~jnp.all(state.converged)
+        return (state.iteration < stop_at) & ~jnp.all(state.converged)
 
     def body(state: LbfgsState) -> LbfgsState:
         direction = _two_loop_direction(state, m)
@@ -249,11 +272,22 @@ def minimize(
             prev_step=prev_step,
         )
 
-    final = jax.lax.while_loop(cond, body, init)
-    return LbfgsResult(
-        theta=final.theta,
-        f=final.f,
-        grad_norm=jnp.max(jnp.abs(final.grad), axis=-1),
-        converged=final.converged,
-        n_iters=final.n_iters,
-    )
+    return jax.lax.while_loop(cond, body, state)
+
+
+def minimize(
+    fun: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    theta0: jnp.ndarray,
+    config: SolverConfig = SolverConfig(),
+) -> LbfgsResult:
+    """Minimize a batch of independent objectives with shared compute.
+
+    Args:
+      fun: (B, P) -> ((B,) per-series losses, (B, P) per-series grads).
+      theta0: (B, P) initial parameters.
+
+    Returns:
+      LbfgsResult with per-series optimum, loss, grad inf-norm, convergence
+      flag and iteration count.
+    """
+    return to_result(run_segment(fun, init_state(fun, theta0, config), config))

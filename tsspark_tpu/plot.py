@@ -1,0 +1,121 @@
+"""Plotting: forecast and component figures (matplotlib, import-gated).
+
+Mirrors the Prophet-family plotting surface the reference's users expect:
+``plot_forecast`` (history + yhat + interval band per series) and
+``plot_components`` (trend with interval, one panel per seasonality /
+regressor block).  Works off the long forecast frame a
+:class:`~tsspark_tpu.frame.Forecaster` produces, or raw arrays via the
+``*_arrays`` variants — no refit needed to plot.
+
+matplotlib is present in this image but kept a soft dependency: importing
+this module without it raises only when a plot function is called.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+
+def _mpl():
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg", force=False)
+        import matplotlib.pyplot as plt
+
+        return plt
+    except ImportError as e:  # pragma: no cover - matplotlib is in the image
+        raise ImportError(
+            "plotting needs matplotlib; it is not installed"
+        ) from e
+
+
+def plot_forecast(
+    forecast_df: pd.DataFrame,
+    history_df: Optional[pd.DataFrame] = None,
+    series_id: Optional[str] = None,
+    id_col: str = "series_id",
+    ds_col: str = "ds",
+    y_col: str = "y",
+    ax=None,
+    figsize=(10, 4),
+):
+    """History dots + forecast line + uncertainty band for one series.
+
+    Args:
+      forecast_df: long frame with ds/yhat (+yhat_lower/yhat_upper).
+      history_df: optional long frame with the training observations.
+      series_id: which series to plot (default: the first in forecast_df).
+    """
+    plt = _mpl()
+    sid = series_id if series_id is not None else forecast_df[id_col].iloc[0]
+    fc = forecast_df[forecast_df[id_col] == sid]
+    if fc.empty:
+        raise ValueError(f"series {sid!r} not present in forecast frame")
+    if ax is None:
+        _, ax = plt.subplots(figsize=figsize)
+
+    if history_df is not None:
+        h = history_df[history_df[id_col] == sid]
+        ax.plot(h[ds_col], h[y_col], "k.", markersize=3, alpha=0.6,
+                label="observed")
+    ax.plot(fc[ds_col], fc["yhat"], color="#0072B2", label="forecast")
+    if {"yhat_lower", "yhat_upper"} <= set(fc.columns):
+        ax.fill_between(
+            fc[ds_col], fc["yhat_lower"], fc["yhat_upper"],
+            color="#0072B2", alpha=0.2, linewidth=0, label="interval",
+        )
+    ax.set_title(str(sid))
+    ax.set_xlabel(ds_col)
+    ax.set_ylabel(y_col)
+    ax.legend(loc="best", fontsize=8)
+    ax.figure.autofmt_xdate()
+    return ax
+
+
+def plot_components(
+    components: Dict[str, np.ndarray],
+    ds,
+    series_index: int = 0,
+    names: Optional[Sequence[str]] = None,
+    figsize=(10, 2.2),
+):
+    """One panel per component block for one series.
+
+    Args:
+      components: name -> (B, T) arrays, e.g. from ``Forecaster.components``
+        or ``ProphetModel.components`` (plus "trend"/interval keys from a
+        forecast dict — anything (B, T) works).
+      ds: (T,) x-axis values (days or datetimes).
+      series_index: row of the batch to plot.
+      names: subset/order of component names (default: all, trend first).
+    """
+    plt = _mpl()
+    keys = list(components)
+    if names is None:
+        names = sorted(
+            (k for k in keys if not k.endswith(("_lower", "_upper"))),
+            key=lambda k: (k != "trend", k),
+        )
+    fig, axes = plt.subplots(
+        len(names), 1, figsize=(figsize[0], figsize[1] * len(names)),
+        sharex=True, squeeze=False,
+    )
+    for ax, name in zip(axes[:, 0], names):
+        arr = np.asarray(components[name])
+        ax.plot(ds, arr[series_index], color="#0072B2")
+        lo, hi = f"{name}_lower", f"{name}_upper"
+        if lo in components and hi in components:
+            ax.fill_between(
+                ds, np.asarray(components[lo])[series_index],
+                np.asarray(components[hi])[series_index],
+                color="#0072B2", alpha=0.2, linewidth=0,
+            )
+        ax.set_ylabel(name, fontsize=9)
+    axes[-1, 0].set_xlabel("ds")
+    fig.autofmt_xdate()
+    fig.tight_layout()
+    return fig
