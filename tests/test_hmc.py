@@ -94,3 +94,40 @@ def test_prophet_mcmc_posterior_predictive():
     map_width = np.asarray(map_out["yhat_upper"] - map_out["yhat_lower"]).mean()
     mcmc_width = (hi - lo).mean()
     assert mcmc_width > 0.5 * map_width
+
+
+def test_forecaster_mcmc_samples_front_end():
+    """The mcmc_samples knob on the DataFrame front-end (Prophet parity)."""
+    import pandas as pd
+    from tsspark_tpu import Forecaster
+
+    rng = np.random.default_rng(4)
+    n = 150
+    ds = pd.date_range("2024-03-01", periods=n, freq="D")
+    t = np.arange(n)
+    df = pd.concat([
+        pd.DataFrame({"series_id": f"s{i}", "ds": ds,
+                      "y": 7 + 0.03 * t + 1.5 * np.sin(2 * np.pi * t / 7)
+                           + rng.normal(0, 0.3, n)})
+        for i in range(2)
+    ], ignore_index=True)
+
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(SeasonalityConfig("weekly", 7.0, 3),),
+                      n_changepoints=4),
+        mcmc_samples=120,
+        mcmc_config=McmcConfig(num_samples=120, num_warmup=150,
+                               num_leapfrog=10),
+    )
+    fc.fit(df)
+    assert fc.mcmc_state is not None
+    assert fc.mcmc_state.samples.shape[:2] == (120, 2)
+
+    out = fc.predict(horizon=14)
+    assert {"yhat", "yhat_lower", "yhat_upper"} <= set(out.columns)
+    assert (out["yhat_lower"] < out["yhat_upper"]).all()
+    truth = (7 + 0.03 * np.arange(n, n + 14)
+             + 1.5 * np.sin(2 * np.pi * np.arange(n, n + 14) / 7))
+    for sid in ("s0", "s1"):
+        sub = out[out.series_id == sid]
+        assert np.abs(sub["yhat"].to_numpy() - truth).mean() < 0.8

@@ -94,3 +94,45 @@ def test_python_fallback_parity(monkeypatch):
     out_p = hs_py.materialize(ids, grid_p)
     np.testing.assert_array_equal(np.isnan(out_n), np.isnan(out_p))
     np.testing.assert_allclose(np.nan_to_num(out_n), np.nan_to_num(out_p))
+
+
+def test_param_table_bulk_roundtrip():
+    from tsspark_tpu import native
+
+    t = native.ParamTable(row_dim=6)
+    rng = np.random.default_rng(0)
+    ids = np.arange(5000, dtype=np.int64)
+    rows = rng.normal(0, 1, (5000, 6)).astype(np.float32)
+    t.update(ids, rows)
+    assert len(t) == 5000
+
+    # overwrite a subset (upsert semantics)
+    t.update(ids[:10], np.zeros((10, 6), np.float32))
+
+    probe = np.asarray([3, 7, 9999, 4999, -1], np.int64)
+    got, found = t.lookup(probe)
+    assert found.tolist() == [True, True, False, True, False]
+    np.testing.assert_allclose(got[0], np.zeros(6))
+    np.testing.assert_allclose(got[1], np.zeros(6))
+    np.testing.assert_allclose(got[3], rows[4999])
+    np.testing.assert_allclose(got[2], np.zeros(6))  # miss -> zero-filled
+
+    ids_out, rows_out = t.export()
+    assert len(ids_out) == 5000
+    # export preserves the updated values
+    back = {int(i): r for i, r in zip(ids_out, rows_out)}
+    np.testing.assert_allclose(back[4999], rows[4999])
+    np.testing.assert_allclose(back[0], np.zeros(6))
+
+
+def test_param_table_large_threaded_lookup():
+    from tsspark_tpu import native
+
+    t = native.ParamTable(row_dim=8)
+    n = 20000  # crosses the threaded-gather threshold in the native path
+    ids = np.arange(n, dtype=np.int64)
+    rows = np.tile(np.arange(8, dtype=np.float32), (n, 1)) + ids[:, None]
+    t.update(ids, rows)
+    got, found = t.lookup(ids[::-1].copy())
+    assert found.all()
+    np.testing.assert_allclose(got, rows[::-1])

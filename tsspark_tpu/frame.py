@@ -22,9 +22,9 @@ import jax.numpy as jnp
 
 from tsspark_tpu import native
 from tsspark_tpu.backends.registry import ForecastBackend, get_backend
-from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.config import McmcConfig, ProphetConfig, SolverConfig
 from tsspark_tpu.models import holidays as holidays_mod
-from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.models.prophet.model import FitState, ProphetModel
 
 _SECONDS_PER_DAY = 86400.0
 
@@ -123,8 +123,15 @@ class Forecaster:
         floor_col: Optional[str] = None,
         regressor_cols: Sequence[str] = (),
         holidays: Sequence[holidays_mod.Holiday] = (),
+        mcmc_samples: int = 0,
+        mcmc_config: Optional[McmcConfig] = None,
         **backend_kwargs,
     ):
+        """``mcmc_samples > 0`` switches fitting to the full-posterior HMC
+        path (the upstream Prophet ``mcmc_samples`` knob): predict intervals
+        then carry seasonality/regressor uncertainty from the posterior
+        draws instead of the MAP trend simulation.  MCMC runs unchunked —
+        intended for batches that fit on one device."""
         # Holidays are sugar over the regressor path: each (holiday, offset)
         # appends an unstandardized indicator column after the user's
         # regressor columns; the indicator values are computed from the
@@ -144,6 +151,20 @@ class Forecaster:
         self.series_ids: Optional[np.ndarray] = None
         self._train_ds: Optional[np.ndarray] = None
         self._freq_days: Optional[float] = None
+        # An explicit mcmc_config enables MCMC by itself; mcmc_samples is
+        # shorthand for the default config.  Conflicting values would
+        # silently surprise either way, so they must agree.
+        if (mcmc_config is not None and mcmc_samples > 0
+                and mcmc_samples != mcmc_config.num_samples):
+            raise ValueError(
+                f"mcmc_samples={mcmc_samples} conflicts with "
+                f"mcmc_config.num_samples={mcmc_config.num_samples}; "
+                "give one or make them agree"
+            )
+        if mcmc_config is None and mcmc_samples > 0:
+            mcmc_config = McmcConfig(num_samples=mcmc_samples)
+        self.mcmc_config = mcmc_config
+        self.mcmc_state = None
 
     def _combined_regressors(
         self, grid: np.ndarray, reg: Optional[np.ndarray], b: int
@@ -198,14 +219,25 @@ class Forecaster:
         reg = self._combined_regressors(
             batch.ds, batch.regressors, len(batch.series_ids)
         )
-        self.state = self.backend.fit(
-            jnp.asarray(batch.ds),
-            jnp.asarray(batch.y),
+        fit_kw = dict(
             cap=None if batch.cap is None else jnp.asarray(np.nan_to_num(batch.cap)),
             floor=None if batch.floor is None else jnp.asarray(batch.floor),
             regressors=None if reg is None else jnp.asarray(reg),
-            init=init,
         )
+        if self.mcmc_config is not None:
+            # Full-posterior path: backend-independent model math (MAP init
+            # + lockstep HMC chains), unchunked.
+            model = ProphetModel(self.config, self.backend.solver_config)
+            self.mcmc_state = model.fit_mcmc(
+                jnp.asarray(batch.ds), jnp.asarray(batch.y),
+                mcmc_config=self.mcmc_config, init=init, **fit_kw,
+            )
+            self.state = self.mcmc_state.map_state
+        else:
+            self.state = self.backend.fit(
+                jnp.asarray(batch.ds), jnp.asarray(batch.y), init=init,
+                **fit_kw,
+            )
         return self
 
     # -- predict ---------------------------------------------------------------
@@ -251,12 +283,19 @@ class Forecaster:
                 raise ValueError("logistic models need future_df with cap")
 
         reg = self._combined_regressors(grid, reg, len(self.series_ids))
-        fc = self.backend.predict(
-            self.state, jnp.asarray(grid),
-            cap=None if cap is None else jnp.asarray(np.nan_to_num(cap)),
-            regressors=None if reg is None else jnp.asarray(reg),
-            seed=seed, num_samples=num_samples,
-        )
+        cap_j = None if cap is None else jnp.asarray(np.nan_to_num(cap))
+        reg_j = None if reg is None else jnp.asarray(reg)
+        if self.mcmc_state is not None:
+            model = ProphetModel(self.config, self.backend.solver_config)
+            fc = model.predict_mcmc(
+                self.mcmc_state, jnp.asarray(grid), cap=cap_j,
+                regressors=reg_j, seed=seed, max_draws=num_samples,
+            )
+        else:
+            fc = self.backend.predict(
+                self.state, jnp.asarray(grid), cap=cap_j, regressors=reg_j,
+                seed=seed, num_samples=num_samples,
+            )
         return self._to_long(grid, fc)
 
     def _align_future(self, future_df: pd.DataFrame):

@@ -83,13 +83,19 @@ def fit_segment_core(
 
 
 class McmcState(NamedTuple):
-    """Full-posterior fit: (S, B, P) draws + scaling metadata + diagnostics."""
+    """Full-posterior fit: (S, B, P) draws + scaling metadata + diagnostics.
+
+    ``map_state`` is the MAP fit the chains were initialized from — callers
+    get the point-estimate surface (components, deterministic predict) for
+    free alongside the posterior draws.
+    """
 
     samples: jnp.ndarray
     meta: ScalingMeta
     accept_rate: jnp.ndarray
     step_size: jnp.ndarray
     divergences: jnp.ndarray
+    map_state: "FitState"
 
 
 @functools.partial(jax.jit, static_argnames=("config", "mcmc_config"))
@@ -220,6 +226,7 @@ class ProphetModel:
         regressors: Optional[jnp.ndarray] = None,
         mcmc_config: McmcConfig = McmcConfig(),
         seed: int = 0,
+        init: Optional[jnp.ndarray] = None,
     ) -> McmcState:
         """Full-posterior fit: MAP solve, then one HMC chain per series.
 
@@ -231,7 +238,7 @@ class ProphetModel:
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
             regressors=regressors,
         )
-        map_state = self._fit_prepared(data, meta, None)
+        map_state = self._fit_prepared(data, meta, init)
         res = mcmc_core(
             data, map_state.theta, jax.random.PRNGKey(seed), self.config,
             mcmc_config,
@@ -242,6 +249,7 @@ class ProphetModel:
             accept_rate=res.accept_rate,
             step_size=res.step_size,
             divergences=res.divergences,
+            map_state=map_state,
         )
 
     # -- prediction ------------------------------------------------------------

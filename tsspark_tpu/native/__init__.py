@@ -26,6 +26,8 @@ _TRIED = False
 
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
 def _build() -> Optional[str]:
@@ -86,6 +88,21 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, _i64p, ctypes.c_int64, _f64p,
             ctypes.c_int64, _f64p,
         ]
+        lib.pstore_new.argtypes = [ctypes.c_int64]
+        lib.pstore_new.restype = ctypes.c_void_p
+        lib.pstore_free.argtypes = [ctypes.c_void_p]
+        lib.pstore_size.argtypes = [ctypes.c_void_p]
+        lib.pstore_size.restype = ctypes.c_int64
+        lib.pstore_row_dim.argtypes = [ctypes.c_void_p]
+        lib.pstore_row_dim.restype = ctypes.c_int64
+        lib.pstore_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _i64p, _f32p,
+        ]
+        lib.pstore_lookup.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, _i64p, _f32p, _u8p,
+        ]
+        lib.pstore_lookup.restype = ctypes.c_int64
+        lib.pstore_export.argtypes = [ctypes.c_void_p, _i64p, _f32p]
         _LIB = lib
         return _LIB
 
@@ -201,3 +218,81 @@ class HistoryStore:
             ok = (idx < t) & (grid[np.minimum(idx, t - 1)] == rec[0])
             out[i, idx[ok]] = rec[1][ok]
         return out
+
+
+class ParamTable:
+    """Fixed-width float32 rows keyed by int64 id (bulk upsert/gather).
+
+    The native backing store for the streaming warm-start ParamStore: one
+    micro-batch update/lookup is two memcpy-bound C calls instead of a
+    Python loop over series.  Falls back to a vectorized numpy/dict
+    implementation when no compiler is available.
+    """
+
+    def __init__(self, row_dim: int):
+        self.row_dim = int(row_dim)
+        self._lib = _load()
+        if self._lib is not None:
+            self._handle = ctypes.c_void_p(self._lib.pstore_new(self.row_dim))
+        else:
+            self._idx: dict = {}          # id -> row number
+            self._rows: list = []         # list of np.float32 rows
+
+    def __del__(self):
+        if getattr(self, "_lib", None) is not None and self._handle:
+            self._lib.pstore_free(self._handle)
+            self._handle = None
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.pstore_size(self._handle))
+        return len(self._idx)
+
+    def update(self, ids: np.ndarray, rows: np.ndarray) -> None:
+        ids = np.ascontiguousarray(ids, np.int64)
+        rows = np.ascontiguousarray(rows, np.float32)
+        if rows.shape != (len(ids), self.row_dim):
+            raise ValueError(
+                f"rows shape {rows.shape} != ({len(ids)}, {self.row_dim})"
+            )
+        if self._lib is not None:
+            self._lib.pstore_update(self._handle, len(ids), ids,
+                                    rows.reshape(-1))
+            return
+        for i, sid in enumerate(ids):
+            k = int(sid)
+            if k in self._idx:
+                self._rows[self._idx[k]] = rows[i].copy()
+            else:
+                self._idx[k] = len(self._rows)
+                self._rows.append(rows[i].copy())
+
+    def lookup(self, ids: np.ndarray):
+        """Returns (rows (n, row_dim) float32 zero-filled on miss, found (n,) bool)."""
+        ids = np.ascontiguousarray(ids, np.int64)
+        n = len(ids)
+        out = np.empty((n, self.row_dim), np.float32)
+        found = np.empty(n, np.uint8)
+        if self._lib is not None:
+            self._lib.pstore_lookup(self._handle, n, ids, out.reshape(-1),
+                                    found)
+            return out, found.astype(bool)
+        for i, sid in enumerate(ids):
+            row = self._idx.get(int(sid))
+            found[i] = row is not None
+            out[i] = self._rows[row] if row is not None else 0.0
+        return out, found.astype(bool)
+
+    def export(self):
+        """All (ids (N,), rows (N, row_dim)) pairs, insertion-ordered."""
+        n = len(self)
+        ids = np.empty(n, np.int64)
+        rows = np.empty((n, self.row_dim), np.float32)
+        if self._lib is not None:
+            if n:
+                self._lib.pstore_export(self._handle, ids, rows.reshape(-1))
+            return ids, rows
+        for sid, row in self._idx.items():
+            ids[row] = sid
+            rows[row] = self._rows[row]
+        return ids, rows

@@ -39,6 +39,14 @@ struct Store {
   std::unordered_map<int64_t, Series> series;
 };
 
+struct ParamTable {
+  // Fixed-width float32 rows in contiguous storage; id -> row index.
+  int64_t row_dim;
+  std::unordered_map<int64_t, int64_t> index;
+  std::vector<float> rows;
+  std::vector<int64_t> ids;  // row index -> id (for export)
+};
+
 int hardware_threads() {
   unsigned n = std::thread::hardware_concurrency();
   return n == 0 ? 4 : static_cast<int>(n);
@@ -201,6 +209,93 @@ void store_materialize(void* handle, const int64_t* sids, int64_t b,
     workers.emplace_back(work, lo, hi);
   }
   for (auto& w : workers) w.join();
+}
+
+// ------------------------------------------------------------- param table
+//
+// The streaming warm-start state (fitted theta + scaling rows keyed by
+// series) lives here so a 30k-series micro-batch update/lookup is two
+// memcpy-bound bulk calls instead of a Python loop over series.
+
+void* pstore_new(int64_t row_dim) {
+  auto* t = new ParamTable();
+  t->row_dim = row_dim;
+  return t;
+}
+
+void pstore_free(void* handle) { delete static_cast<ParamTable*>(handle); }
+
+int64_t pstore_size(void* handle) {
+  return static_cast<int64_t>(static_cast<ParamTable*>(handle)->index.size());
+}
+
+int64_t pstore_row_dim(void* handle) {
+  return static_cast<ParamTable*>(handle)->row_dim;
+}
+
+// Upsert n rows (each row_dim floats).  Last write wins on duplicate ids
+// within one call (matching the Python dict semantics it replaces).
+void pstore_update(void* handle, int64_t n, const int64_t* ids,
+                   const float* data) {
+  auto* t = static_cast<ParamTable*>(handle);
+  const int64_t d = t->row_dim;
+  for (int64_t i = 0; i < n; ++i) {
+    auto [it, inserted] =
+        t->index.try_emplace(ids[i], static_cast<int64_t>(t->ids.size()));
+    if (inserted) {
+      t->ids.push_back(ids[i]);
+      t->rows.resize(t->rows.size() + d);
+    }
+    std::memcpy(t->rows.data() + it->second * d, data + i * d,
+                d * sizeof(float));
+  }
+}
+
+// Gather n rows into out (n x row_dim, zero-filled on miss); found[i] gets
+// 1/0.  Returns the number found.  Threaded gather for large batches.
+int64_t pstore_lookup(void* handle, int64_t n, const int64_t* ids, float* out,
+                      uint8_t* found) {
+  auto* t = static_cast<ParamTable*>(handle);
+  const int64_t d = t->row_dim;
+  std::vector<int64_t> row_of(n);
+  int64_t n_found = 0;
+  for (int64_t i = 0; i < n; ++i) {  // map probes stay single-threaded
+    auto it = t->index.find(ids[i]);
+    row_of[i] = it == t->index.end() ? -1 : it->second;
+    found[i] = row_of[i] >= 0;
+    n_found += found[i];
+  }
+  auto gather = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      float* dst = out + i * d;
+      if (row_of[i] < 0) {
+        std::fill(dst, dst + d, 0.0f);
+      } else {
+        std::memcpy(dst, t->rows.data() + row_of[i] * d, d * sizeof(float));
+      }
+    }
+  };
+  int n_threads = hardware_threads();
+  if (n < 4096 || n_threads <= 1) {
+    gather(0, n);
+  } else {
+    std::vector<std::thread> workers;
+    int64_t chunk = (n + n_threads - 1) / n_threads;
+    for (int tid = 0; tid < n_threads; ++tid) {
+      int64_t lo = tid * chunk, hi = std::min<int64_t>(lo + chunk, n);
+      if (lo >= hi) break;
+      workers.emplace_back(gather, lo, hi);
+    }
+    for (auto& w : workers) w.join();
+  }
+  return n_found;
+}
+
+// Dump every (id, row) pair; buffers must hold pstore_size rows.
+void pstore_export(void* handle, int64_t* ids_out, float* rows_out) {
+  auto* t = static_cast<ParamTable*>(handle);
+  std::memcpy(ids_out, t->ids.data(), t->ids.size() * sizeof(int64_t));
+  std::memcpy(rows_out, t->rows.data(), t->rows.size() * sizeof(float));
 }
 
 }  // extern "C"

@@ -2,22 +2,57 @@
 
 Backs the streaming incremental-refit path (eval config 5, BASELINE.json:11):
 each micro-batch looks up prior parameters for the series it touches,
-warm-starts the solver, and writes the refreshed parameters back.  In-memory
-dict with npz persistence via utils.checkpoint; new series simply miss and
-fall back to data-driven init.
+warm-starts the solver, and writes the refreshed parameters back.
+
+Storage is the native ParamTable (tsspark_tpu.native, C++): one micro-batch
+update/lookup is two memcpy-bound bulk calls over contiguous float32 rows —
+the Python layer only interns string series ids to int64 codes.  Persistence
+stays npz via utils.checkpoint; new series simply miss and fall back to
+data-driven init.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax.numpy as jnp
 
+from tsspark_tpu import native
 from tsspark_tpu.config import ProphetConfig
 from tsspark_tpu.models.prophet.design import ScalingMeta
 from tsspark_tpu.models.prophet.model import FitState
 from tsspark_tpu.utils import checkpoint as ckpt
+
+
+def _meta_dim(config: ProphetConfig) -> int:
+    # y_scale, floor, ds_start, ds_span + reg_mean/reg_std (R each).
+    return 4 + 2 * config.num_regressors
+
+
+def _flatten_meta(meta: ScalingMeta) -> np.ndarray:
+    """(B, meta_dim) float32 row-block from a batched ScalingMeta."""
+    cols = [
+        np.asarray(meta.y_scale, np.float32)[:, None],
+        np.asarray(meta.floor, np.float32)[:, None],
+        np.asarray(meta.ds_start, np.float32)[:, None],
+        np.asarray(meta.ds_span, np.float32)[:, None],
+        np.asarray(meta.reg_mean, np.float32),
+        np.asarray(meta.reg_std, np.float32),
+    ]
+    return np.concatenate(cols, axis=1)
+
+
+def _unflatten_meta(rows: np.ndarray, config: ProphetConfig) -> ScalingMeta:
+    r = config.num_regressors
+    return ScalingMeta(
+        y_scale=jnp.asarray(rows[:, 0]),
+        floor=jnp.asarray(rows[:, 1]),
+        ds_start=jnp.asarray(rows[:, 2]),
+        ds_span=jnp.asarray(rows[:, 3]),
+        reg_mean=jnp.asarray(rows[:, 4 : 4 + r]),
+        reg_std=jnp.asarray(rows[:, 4 + r : 4 + 2 * r]),
+    )
 
 
 class ParamStore:
@@ -25,21 +60,37 @@ class ParamStore:
 
     def __init__(self, config: ProphetConfig):
         self.config = config
-        self._theta: Dict[str, np.ndarray] = {}
-        self._meta: Dict[str, tuple] = {}
+        self._table = native.ParamTable(config.num_params + _meta_dim(config))
+        self._code_of: Dict[str, int] = {}
+        self._id_of: List[str] = []
+
+    def _codes(self, series_ids: Sequence, intern: bool) -> np.ndarray:
+        codes = np.empty(len(series_ids), np.int64)
+        for i, sid in enumerate(series_ids):
+            s = str(sid)
+            c = self._code_of.get(s)
+            if c is None:
+                if not intern:
+                    c = -1  # never stored -> guaranteed miss
+                else:
+                    c = len(self._id_of)
+                    self._code_of[s] = c
+                    self._id_of.append(s)
+            codes[i] = c
+        return codes
 
     def __len__(self) -> int:
-        return len(self._theta)
+        return len(self._table)
 
     def __contains__(self, series_id: str) -> bool:
-        return str(series_id) in self._theta
+        return str(series_id) in self._code_of
 
     def update(self, series_ids: Sequence, state: FitState) -> None:
-        theta = np.asarray(state.theta)
-        meta_rows = list(zip(*[np.asarray(v) for v in state.meta]))
-        for i, sid in enumerate(series_ids):
-            self._theta[str(sid)] = theta[i]
-            self._meta[str(sid)] = meta_rows[i]
+        rows = np.concatenate(
+            [np.asarray(state.theta, np.float32), _flatten_meta(state.meta)],
+            axis=1,
+        )
+        self._table.update(self._codes(series_ids, intern=True), rows)
 
     def lookup(
         self, series_ids: Sequence
@@ -51,40 +102,31 @@ class ParamStore:
         them with a cold init.  Returns (None, None, all-False) when no
         requested series is known.
         """
-        ids = [str(s) for s in series_ids]
-        found = np.asarray([s in self._theta for s in ids])
+        rows, found = self._table.lookup(self._codes(series_ids, intern=False))
         if not found.any():
             return None, None, found
         p = self.config.num_params
-        theta = np.zeros((len(ids), p), np.float32)
-        n_meta = len(ScalingMeta._fields)
-        meta_cols = [[] for _ in range(n_meta)]
-        some_meta = next(iter(self._meta.values()))
-        for i, sid in enumerate(ids):
-            row_meta = self._meta.get(sid)
-            if row_meta is None:
-                row_meta = tuple(np.zeros_like(m) for m in some_meta)
-            else:
-                theta[i] = self._theta[sid]
-            for j in range(n_meta):
-                meta_cols[j].append(row_meta[j])
-        meta = ScalingMeta(*[jnp.asarray(np.stack(c)) for c in meta_cols])
-        return jnp.asarray(theta), meta, found
+        return (
+            jnp.asarray(rows[:, :p]),
+            _unflatten_meta(rows[:, p:], self.config),
+            found,
+        )
 
     # -- persistence -----------------------------------------------------------
 
     def save(self, path: str) -> None:
-        ids = np.asarray(sorted(self._theta))
-        theta = jnp.asarray(np.stack([self._theta[s] for s in ids]))
-        meta = ScalingMeta(*[
-            jnp.asarray(np.stack([self._meta[s][j] for s in ids]))
-            for j in range(len(ScalingMeta._fields))
-        ])
+        codes, rows = self._table.export()
+        ids = np.asarray([self._id_of[c] for c in codes])
+        order = np.argsort(ids)
+        ids, rows = ids[order], rows[order]
+        p = self.config.num_params
+        n = len(ids)
         state = FitState(
-            theta=theta, meta=meta,
-            loss=jnp.zeros(len(ids)), grad_norm=jnp.zeros(len(ids)),
-            converged=jnp.ones(len(ids), bool),
-            n_iters=jnp.zeros(len(ids), jnp.int32),
+            theta=jnp.asarray(rows[:, :p]),
+            meta=_unflatten_meta(rows[:, p:], self.config),
+            loss=jnp.zeros(n), grad_norm=jnp.zeros(n),
+            converged=jnp.ones(n, bool),
+            n_iters=jnp.zeros(n, jnp.int32),
         )
         ckpt.save_state(path, state, self.config, series_ids=ids)
 
