@@ -210,20 +210,46 @@ def eval_worker(args) -> int:
 # parent orchestrator (no JAX)
 # --------------------------------------------------------------------------
 
-def _spawn(mode: str, args, extra: list, timeout: Optional[float] = None) -> int:
+def _spawn(mode: str, args, extra: list, timeout: Optional[float] = None,
+           progress_timeout: Optional[float] = None) -> int:
+    """Run a worker; kill it on overall timeout OR when no new chunk result
+    has appeared for ``progress_timeout`` seconds (a wedged TPU tunnel blocks
+    client creation forever — stalling is indistinguishable from working
+    except by watching the output directory)."""
     cmd = [sys.executable, os.path.abspath(__file__), mode,
            "--data", args._data_dir, "--out", args._out_dir] + extra
     env = dict(os.environ)
     if mode == "--_eval":
         env["JAX_PLATFORMS"] = "cpu"
-    try:
-        proc = subprocess.run(cmd, stdout=sys.stderr, env=env, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        # A wedged TPU tunnel blocks client creation forever; reclaim and
-        # let the retry ladder have another go after the backoff.
-        print(f"[bench] worker timed out after {timeout}s", file=sys.stderr)
-        return -9
-    return proc.returncode
+    proc = subprocess.Popen(cmd, stdout=sys.stderr, env=env)
+    start = time.time()
+    last_progress = start
+    n_chunks = len(_completed_ranges(args._out_dir))
+    while True:
+        try:
+            return proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            pass
+        now = time.time()
+        n_now = len(_completed_ranges(args._out_dir))
+        if n_now > n_chunks:
+            n_chunks, last_progress = n_now, now
+        timed_out = timeout is not None and now - start > timeout
+        # Before the first chunk lands the worker may legitimately be cold-
+        # compiling (minutes, no files to show for it) — give it triple the
+        # steady-state allowance.
+        allowance = (progress_timeout if n_chunks > 0
+                     else None if progress_timeout is None
+                     else 3.0 * progress_timeout)
+        stalled = (allowance is not None
+                   and now - last_progress > allowance)
+        if timed_out or stalled:
+            why = "timed out" if timed_out else "stalled (no new chunk)"
+            print(f"[bench] worker {why} after {round(now - start)}s",
+                  file=sys.stderr)
+            proc.kill()
+            proc.wait()
+            return -9
 
 
 def _completed_ranges(out_dir: str):
@@ -308,7 +334,7 @@ def main() -> None:
             "--lo", str(missing[0][0]), "--hi", str(missing[-1][1]),
             "--chunk", str(chunk), "--max-iters", str(args.max_iters),
             "--segment", str(args.segment),
-        ], timeout=budget)
+        ], timeout=budget, progress_timeout=360.0)
         if rc == 0:
             continue  # re-scan; loop exits when nothing is missing
         retries += 1

@@ -36,6 +36,7 @@ from tsspark_tpu.backends.registry import (
     register_backend,
 )
 from tsspark_tpu.frame import Forecaster
+from tsspark_tpu.eval.diagnostics import cross_validation, performance_metrics
 from tsspark_tpu.models.holidays import (
     Holiday,
     add_holidays,
@@ -65,7 +66,9 @@ __all__ = [
     "SolverConfig",
     "WEEKLY",
     "YEARLY",
+    "cross_validation",
     "get_backend",
     "list_backends",
+    "performance_metrics",
     "register_backend",
 ]

@@ -77,12 +77,27 @@ def save_forecaster(path: str, fc) -> None:
         raise ValueError("save_forecaster needs a fitted Forecaster")
     path = _base(path)
     save_state(path, fc.state, fc.config, series_ids=fc.series_ids)
+    if fc.mcmc_state is not None:
+        # Full-posterior fits must survive the round trip, or a reloaded
+        # model silently downgrades to narrower MAP intervals.  The draws
+        # dominate the file size — that is the cost of the mcmc_samples
+        # choice, same as upstream Prophet's serialized Stan draws.
+        z = dict(np.load(path + ".npz"))
+        z.update(
+            mcmc_samples=np.asarray(fc.mcmc_state.samples),
+            mcmc_accept_rate=np.asarray(fc.mcmc_state.accept_rate),
+            mcmc_step_size=np.asarray(fc.mcmc_state.step_size),
+            mcmc_divergences=np.asarray(fc.mcmc_state.divergences),
+        )
+        np.savez(path + ".npz", **z)
     with open(path + ".json") as f:
         sidecar = json.load(f)
     # The model config is stored without holidays' auto-added regressor
     # columns duplicated: fc.config already includes them, and the holiday
     # calendars themselves are stored to rebuild indicator features.
     sidecar["forecaster"] = {
+        "mcmc_config": None if fc.mcmc_config is None
+            else dataclasses.asdict(fc.mcmc_config),
         "config": dataclasses.asdict(fc.config),
         "backend": fc.backend.name,
         "id_col": fc.id_col, "ds_col": fc.ds_col, "y_col": fc.y_col,
@@ -150,6 +165,21 @@ def load_forecaster(path: str):
         ctx["train_ds"], np.float64
     )
     fc._freq_days = ctx["freq_days"]
+    z = np.load(path + ".npz")
+    if "mcmc_samples" in z.files:
+        from tsspark_tpu.config import McmcConfig
+        from tsspark_tpu.models.prophet.model import McmcState
+
+        fc.mcmc_state = McmcState(
+            samples=jnp.asarray(z["mcmc_samples"]),
+            meta=state.meta,
+            accept_rate=jnp.asarray(z["mcmc_accept_rate"]),
+            step_size=jnp.asarray(z["mcmc_step_size"]),
+            divergences=jnp.asarray(z["mcmc_divergences"]),
+            map_state=state,
+        )
+        if ctx.get("mcmc_config"):
+            fc.mcmc_config = McmcConfig(**ctx["mcmc_config"])
     return fc
 
 
