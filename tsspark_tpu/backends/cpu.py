@@ -18,8 +18,8 @@ from tsspark_tpu.backends.registry import ForecastBackend, register_backend
 from tsspark_tpu.models.prophet import predict as predict_mod
 from tsspark_tpu.models.prophet.design import FitData, prepare_fit_data
 from tsspark_tpu.models.prophet.loss import neg_log_posterior
+from tsspark_tpu.models.prophet.init import initial_theta
 from tsspark_tpu.models.prophet.model import FitState
-from tsspark_tpu.models.prophet.params import init_theta
 
 
 @register_backend
@@ -46,8 +46,10 @@ class CpuBackend(ForecastBackend):
                 ds, y, self.config, mask=mask, cap=cap, floor=floor,
                 regressors=regressors,
             )
-            theta0 = init if init is not None else init_theta(
-                self.config, data.y, data.mask, data.t
+            # Same warm-start policy as the TPU path (SolverConfig.init),
+            # so parity runs compare solver behavior, not starting points.
+            theta0 = init if init is not None else initial_theta(
+                data, self.config, self.solver_config
             )
             theta0 = np.asarray(theta0, np.float64)
             b = theta0.shape[0]

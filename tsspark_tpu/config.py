@@ -152,6 +152,14 @@ class SolverConfig:
     ls_shrink: float = 0.5
     ls_armijo_c1: float = 1e-4
     init_step: float = 1.0
+    # Warm start: "ridge" solves the batched masked normal equations in
+    # closed form (models/prophet/init.py) so L-BFGS starts next to the
+    # optimum; "heuristic" is Prophet's endpoint initializer.
+    init: str = "ridge"
+
+    def __post_init__(self):
+        if self.init not in ("ridge", "heuristic"):
+            raise ValueError(f"init must be ridge|heuristic, got {self.init}")
 
 
 @dataclasses.dataclass(frozen=True)

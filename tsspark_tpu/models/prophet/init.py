@@ -1,0 +1,156 @@
+"""Solver initialization: closed-form ridge warm start for the MAP fit.
+
+The reference's per-series scipy L-BFGS (``tsspark.fit.prophet``,
+BASELINE.json:5) starts from Prophet's endpoint heuristic and pays ~10^2
+iterations per series; fanned out over Spark that cost hides inside the
+executor pool.  On TPU the iteration count is the wall-clock, so we spend a
+few MXU matmuls to start next to the optimum instead:
+
+For additive composition the Prophet mean is LINEAR in every parameter
+except the observation noise:
+
+    yhat = k*t + m + sum_j delta_j * relu(t - s_j) + X @ beta
+
+so the MAP problem with the Laplace changepoint prior replaced by its
+Gaussian moment-match is a batched masked ridge regression — one
+``(B, P, P)`` Gram build (a big batched matmul, ideal MXU shape) plus a
+batched Cholesky solve.  L-BFGS then only has to account for the
+Laplace-vs-Gaussian prior difference and the sigma coupling, which takes
+O(10) iterations instead of O(100).
+
+Non-additive cases degrade gracefully: multiplicative features are treated
+as additive for the init (exact at small seasonal amplitude), and non-linear
+growth (logistic/flat) keeps the endpoint heuristic for (k, m) and
+ridge-solves only the feature betas against the de-trended target.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.models.prophet.params import ProphetParams, init_theta, pack, unpack
+from tsspark_tpu.models.prophet import trend as trend_mod
+
+
+def _masked_sigma(resid: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Masked residual std, floored away from log(0)."""
+    n = jnp.maximum(mask.sum(axis=-1), 1.0)
+    var = jnp.sum(resid * resid * mask, axis=-1) / n
+    return jnp.sqrt(jnp.maximum(var, 1e-8))
+
+
+def _ridge_solve(
+    phi: jnp.ndarray,      # (B, T, Q) design columns
+    y: jnp.ndarray,        # (B, T) target
+    mask: jnp.ndarray,     # (B, T)
+    prior_prec: jnp.ndarray,  # (Q,) Gaussian prior precision per column
+    sigma2: jnp.ndarray,   # (B,) noise variance estimate
+) -> jnp.ndarray:
+    """Batched masked ridge: argmin ||mask*(y - phi w)||^2/sigma2 + w'Λw."""
+    phi_m = phi * mask[..., None]
+    # (B, Q, Q) Gram and (B, Q) moment — batched matmuls, MXU-friendly.
+    gram = jnp.einsum("btp,btq->bpq", phi_m, phi)
+    rhs = jnp.einsum("btp,bt->bp", phi_m, y)
+    q = phi.shape[-1]
+    lam = prior_prec[None, :] * sigma2[:, None] + 1e-6
+    a = gram + jnp.eye(q, dtype=phi.dtype)[None] * lam[:, :, None]
+    chol = jax.lax.linalg.cholesky(a)
+    return jax.lax.linalg.triangular_solve(
+        chol,
+        jax.lax.linalg.triangular_solve(
+            chol, rhs[..., None], left_side=True, lower=True
+        ),
+        left_side=True, lower=True, transpose_a=True,
+    )[..., 0]
+
+
+def _feature_matrix(data, b: int) -> jnp.ndarray:
+    """(B, T, F) stacked seasonal + regressor columns (broadcast shared grid)."""
+    xs = data.X_season
+    if xs.ndim == 2:
+        xs = jnp.broadcast_to(xs[None], (b,) + xs.shape)
+    return jnp.concatenate([xs, data.X_reg], axis=-1)
+
+
+def ridge_init(data, config: ProphetConfig) -> jnp.ndarray:
+    """Closed-form warm start (B, P) for the batched MAP solve.
+
+    ``data`` is a design.FitData.  Fully-masked padding rows come out as
+    all-zero parameters with floor sigma (their Gram is pure prior), which is
+    exactly the inert behavior the chunk-padding path needs.
+    """
+    y, mask, t = data.y, data.mask, data.t
+    b, t_len = y.shape
+    n_cp = config.n_changepoints
+    f = config.num_features
+    dtype = y.dtype
+
+    # Rough sigma estimate for the prior/likelihood balance: masked std of y.
+    n = jnp.maximum(mask.sum(axis=-1), 1.0)
+    mean = (y * mask).sum(axis=-1) / n
+    sigma2_0 = jnp.maximum(_masked_sigma(y - mean[:, None], mask) ** 2, 1e-4)
+
+    feats = [] if f == 0 else [_feature_matrix(data, b)]
+    feat_prec = (1.0 / jnp.asarray(config.feature_prior_scales(), dtype)) ** 2
+
+    if config.growth == "linear":
+        # Columns in theta packing order minus log_sigma:
+        #   [t (k), 1 (m), relu(t - s_j) (delta), features (beta)].
+        cols = [t[..., None], jnp.ones_like(t)[..., None]]
+        if n_cp:
+            cols.append(jnp.maximum(t[..., None] - data.s[:, None, :], 0.0))
+        cols += feats
+        phi = jnp.concatenate(cols, axis=-1)
+        # Laplace(0, b) moment-matched to Normal(0, sqrt(2) b).
+        cp_prec = jnp.full((n_cp,), 0.5 / (config.changepoint_prior_scale**2), dtype)
+        prior_prec = jnp.concatenate([
+            jnp.asarray(
+                [1.0 / config.k_prior_scale**2, 1.0 / config.m_prior_scale**2],
+                dtype,
+            ),
+            cp_prec,
+            feat_prec,
+        ])
+        w = _ridge_solve(phi, y, mask, prior_prec, sigma2_0)
+        k0, m0 = w[:, 0], w[:, 1]
+        delta0 = w[:, 2 : 2 + n_cp]
+        beta0 = w[:, 2 + n_cp :]
+        yhat = jnp.einsum("btq,bq->bt", phi, w)
+    else:
+        # Non-linear growth: endpoint heuristic for (k, m); ridge only
+        # for the feature betas against the de-trended target.
+        theta_h = init_theta(config, y, mask, t)
+        p_h = unpack(theta_h, config)
+        k0, m0 = p_h.k, p_h.m
+        delta0 = jnp.zeros((b, n_cp), dtype)
+        if config.growth == "logistic":
+            g0 = trend_mod.logistic(t, data.cap, k0, m0, delta0, data.s)
+        else:
+            g0 = trend_mod.flat(t, m0)
+        if f:
+            phi = feats[0]
+            w = _ridge_solve(phi, y - g0, mask, feat_prec, sigma2_0)
+            beta0 = w
+            yhat = g0 + jnp.einsum("btq,bq->bt", phi, w)
+        else:
+            beta0 = jnp.zeros((b, 0), dtype)
+            yhat = g0
+
+    sigma = _masked_sigma(y - yhat, mask)
+    log_sigma0 = jnp.log(jnp.maximum(sigma, 1e-3))
+    return pack(
+        ProphetParams(
+            k=k0, m=m0, log_sigma=log_sigma0, delta=delta0, beta=beta0
+        )
+    )
+
+
+def initial_theta(
+    data, config: ProphetConfig, solver_config: SolverConfig
+) -> jnp.ndarray:
+    """Dispatch on SolverConfig.init: "ridge" (default) or "heuristic"."""
+    if solver_config.init == "ridge":
+        return ridge_init(data, config)
+    return init_theta(config, data.y, data.mask, data.t)

@@ -73,6 +73,15 @@ def neg_log_posterior(
     return nll + prior
 
 
+def value_batch(theta: jnp.ndarray, data: FitData, config: ProphetConfig):
+    """Per-series losses (B,) only — no gradient.
+
+    The line search evaluates many trial points and discards everything but
+    the loss; skipping the vjp there roughly halves the cost of each trial.
+    """
+    return neg_log_posterior(theta, data, config)
+
+
 def value_and_grad_batch(theta: jnp.ndarray, data: FitData, config: ProphetConfig):
     """Per-series losses (B,) and gradients (B, P) in one backward pass.
 

@@ -23,8 +23,8 @@ from tsspark_tpu.models.prophet.design import (
     ScalingMeta,
     prepare_fit_data,
 )
-from tsspark_tpu.models.prophet.loss import value_and_grad_batch
-from tsspark_tpu.models.prophet.params import init_theta
+from tsspark_tpu.models.prophet.init import initial_theta
+from tsspark_tpu.models.prophet.loss import value_and_grad_batch, value_batch
 from tsspark_tpu.ops import hmc, lbfgs
 
 
@@ -42,23 +42,32 @@ class FitState(NamedTuple):
 @functools.partial(jax.jit, static_argnames=("config", "solver_config"))
 def fit_core(
     data: FitData,
-    theta0: jnp.ndarray,
+    theta0: Optional[jnp.ndarray],
     config: ProphetConfig,
     solver_config: SolverConfig,
 ) -> lbfgs.LbfgsResult:
-    """The jitted batched MAP solve: the whole fit is one XLA program."""
+    """The jitted batched MAP solve: the whole fit is one XLA program.
+
+    ``theta0=None`` computes the warm start (closed-form ridge by default,
+    init.py) inside the same program — no extra dispatch, no host round-trip.
+    """
+    if theta0 is None:
+        theta0 = initial_theta(data, config, solver_config)
     fun = lambda th: value_and_grad_batch(th, data, config)
-    return lbfgs.minimize(fun, theta0, solver_config)
+    fval = lambda th: value_batch(th, data, config)
+    return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "solver_config"))
 def fit_init_core(
     data: FitData,
-    theta0: jnp.ndarray,
+    theta0: Optional[jnp.ndarray],
     config: ProphetConfig,
     solver_config: SolverConfig,
 ) -> lbfgs.LbfgsState:
     """Jitted solver-state construction (for the segmented fit path)."""
+    if theta0 is None:
+        theta0 = initial_theta(data, config, solver_config)
     fun = lambda th: value_and_grad_batch(th, data, config)
     return lbfgs.init_state(fun, theta0, solver_config)
 
@@ -79,7 +88,8 @@ def fit_segment_core(
     full LbfgsState round-trips), while bounding per-dispatch execution time
     — the knob TpuBackend(iter_segment=...) exposes."""
     fun = lambda th: value_and_grad_batch(th, data, config)
-    return lbfgs.run_segment(fun, state, solver_config, num_iters)
+    fval = lambda th: value_batch(th, data, config)
+    return lbfgs.run_segment(fun, state, solver_config, num_iters, fun_value=fval)
 
 
 class McmcState(NamedTuple):
@@ -189,9 +199,8 @@ class ProphetModel:
         init: Optional[jnp.ndarray],
         iter_segment: Optional[int] = None,
     ) -> FitState:
-        theta0 = init if init is not None else init_theta(
-            self.config, data.y, data.mask, data.t
-        )
+        # None -> warm start computed inside the jitted program (init.py).
+        theta0 = init
         solver = self.solver_config
         if iter_segment and iter_segment < solver.max_iters:
             ls = fit_init_core(data, theta0, self.config, solver)

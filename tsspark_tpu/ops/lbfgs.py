@@ -146,6 +146,7 @@ def run_segment(
     state: LbfgsState,
     config: SolverConfig,
     num_iters: Optional[int] = None,
+    fun_value: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
 ) -> LbfgsState:
     """Advance the solver by up to ``num_iters`` iterations (bounded by
     ``config.max_iters`` overall).
@@ -157,6 +158,8 @@ def run_segment(
     (bounded per-dispatch time for fragile runtimes, preemption points for
     elastic schedulers) without changing the mathematics.
     """
+    if fun_value is None:
+        fun_value = lambda th: fun(th)[0]
     b, p = state.theta.shape
     m = config.history
     stop_at = jnp.minimum(
@@ -186,7 +189,7 @@ def run_segment(
         def ls_body(carry):
             step, accepted, best_theta, best_f, tries = carry
             trial = state.theta + step[:, None] * direction
-            f_t, _ = fun(trial)
+            f_t = fun_value(trial)  # value only: trials never need the grad
             ok = (
                 jnp.isfinite(f_t)
                 & (f_t <= state.f + config.ls_armijo_c1 * step * dg)
@@ -216,11 +219,16 @@ def run_segment(
         )
 
         # Line-search failure fallback: tiny gradient step (keeps making
-        # progress on pathological curvature instead of freezing).
+        # progress on pathological curvature instead of freezing).  Guarded
+        # by a scalar cond so the common all-accepted case skips the eval.
         gnorm = jnp.linalg.norm(state.grad, axis=-1)
         tiny = 1e-3 / jnp.maximum(gnorm, 1.0)
         fb_theta = state.theta - tiny[:, None] * state.grad
-        fb_f, _ = fun(fb_theta)
+        fb_f = jax.lax.cond(
+            jnp.all(accepted | state.converged),
+            lambda: jnp.full_like(state.f, jnp.inf),
+            lambda: fun_value(fb_theta),
+        )
         use_fb = ~accepted & jnp.isfinite(fb_f) & (fb_f < state.f)
         new_theta = jnp.where(use_fb[:, None], fb_theta, new_theta)
         new_f = jnp.where(use_fb, fb_f, new_f)
@@ -279,15 +287,22 @@ def minimize(
     fun: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
     theta0: jnp.ndarray,
     config: SolverConfig = SolverConfig(),
+    fun_value: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
 ) -> LbfgsResult:
     """Minimize a batch of independent objectives with shared compute.
 
     Args:
       fun: (B, P) -> ((B,) per-series losses, (B, P) per-series grads).
       theta0: (B, P) initial parameters.
+      fun_value: optional value-only objective for line-search trials
+        (defaults to ``fun(th)[0]``, which wastes the gradient).
 
     Returns:
       LbfgsResult with per-series optimum, loss, grad inf-norm, convergence
       flag and iteration count.
     """
-    return to_result(run_segment(fun, init_state(fun, theta0, config), config))
+    return to_result(
+        run_segment(
+            fun, init_state(fun, theta0, config), config, fun_value=fun_value
+        )
+    )

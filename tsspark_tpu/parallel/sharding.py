@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tsspark_tpu.config import ProphetConfig, ShardingConfig, SolverConfig
 from tsspark_tpu.models.prophet.design import FitData
-from tsspark_tpu.models.prophet.loss import value_and_grad_batch
+from tsspark_tpu.models.prophet.init import initial_theta
+from tsspark_tpu.models.prophet.loss import value_and_grad_batch, value_batch
 from tsspark_tpu.ops import lbfgs
 
 
@@ -68,16 +69,19 @@ def _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg):
         data, jax.tree.map(lambda sp: NamedSharding(mesh, sp), specs,
                            is_leaf=lambda x: isinstance(x, P))
     )
+    if theta0 is None:
+        theta0 = initial_theta(data, config, solver_config)
     theta0 = jax.lax.with_sharding_constraint(
         theta0, NamedSharding(mesh, P(s_ax, None))
     )
     fun = lambda th: value_and_grad_batch(th, data, config)
-    return lbfgs.minimize(fun, theta0, solver_config)
+    fval = lambda th: value_batch(th, data, config)
+    return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval)
 
 
 def fit_sharded(
     data: FitData,
-    theta0: jnp.ndarray,
+    theta0: Optional[jnp.ndarray],
     config: ProphetConfig,
     solver_config: SolverConfig,
     mesh: Mesh,
@@ -85,9 +89,10 @@ def fit_sharded(
 ) -> lbfgs.LbfgsResult:
     """Fit a batch across the mesh; pads B to the series-shard count.
 
+    ``theta0=None`` computes the warm start inside the sharded program.
     Returns per-series results for the ORIGINAL (unpadded) batch.
     """
-    b = theta0.shape[0]
+    b = data.y.shape[0]
     n_series_shards = mesh.shape[shard_cfg.series_axis]
     b_pad = pad_to_multiple(b, n_series_shards)
     if b_pad != b:
@@ -109,7 +114,8 @@ def fit_sharded(
             prior_scales=data.prior_scales,
             mult_mask=data.mult_mask,
         )
-        theta0 = pad_b(theta0)
+        if theta0 is not None:
+            theta0 = pad_b(theta0)
 
     res = _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg)
     if b_pad != b:

@@ -34,7 +34,7 @@ from tsspark_tpu.backends.registry import get_backend
 from tsspark_tpu.config import ProphetConfig, SolverConfig
 from tsspark_tpu.frame import _days_to_ts, _ds_to_days
 from tsspark_tpu.models.prophet.design import prepare_fit_data
-from tsspark_tpu.models.prophet.params import init_theta
+from tsspark_tpu.models.prophet.init import initial_theta
 from tsspark_tpu.streaming.source import MicroBatchSource
 from tsspark_tpu.streaming.state import ParamStore
 from tsspark_tpu.streaming.warmstart import transfer_theta
@@ -109,7 +109,9 @@ class StreamingForecaster:
         data, meta = prepare_fit_data(
             jnp.asarray(grid), jnp.asarray(y), self.config
         )
-        theta0 = init_theta(self.config, data.y, data.mask, data.t)
+        # Cold-start series get the same ridge warm start the batch path
+        # uses; warm series are overwritten by the transferred params below.
+        theta0 = initial_theta(data, self.config, self.backend.solver_config)
         old_theta, old_meta, found = self.store.lookup(touched)
         if old_theta is not None:
             warm = transfer_theta(old_theta, old_meta, meta, self.config)
