@@ -71,7 +71,11 @@ def test_cpu_tpu_smape_parity(small_batch):
     s_cpu = np.asarray(metrics.smape(y_clean, fc_cpu["yhat"], mask))
     s_tpu = np.asarray(metrics.smape(y_clean, fc_tpu["yhat"], mask))
     # Parity: batched solver must be as accurate as the scipy oracle.
-    np.testing.assert_allclose(s_tpu, s_cpu, atol=0.25)
+    # Thresholds track the committed audit (EVAL_r02.json): per-series
+    # worst |delta| there is ~0.1 on train configs; 0.1 here keeps margin
+    # without letting a real regression through.
+    np.testing.assert_allclose(s_tpu, s_cpu, atol=0.1)
+    assert abs(s_tpu.mean() - s_cpu.mean()) < 0.05
     # And both must actually fit well.
     assert s_cpu.max() < 6.0 and s_tpu.max() < 6.0
 

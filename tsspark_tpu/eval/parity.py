@@ -1,0 +1,169 @@
+"""CPU-vs-TPU parity audit over the driver evaluation configs.
+
+The driver criterion is "sMAPE parity vs CPU" (BASELINE.json:2): the batched
+TPU solver must reproduce the per-series scipy oracle's accuracy, not just
+run fast.  This module fits eval configs 1-4 (eval/configs.py) through BOTH
+backends on identical data and reports per-config in-sample/holdout sMAPE
+for each backend plus the per-series worst deviation — the artifact the
+round reviews (EVAL_r*.json) are built from.
+
+The CPU oracle is a per-series Python loop, so ``scale`` keeps its cost
+bounded; parity is a per-series property, so a representative subsample is
+as informative as the full batch.
+
+Usage:  python -m tsspark_tpu.eval.parity [--scale S] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import (
+    ProphetConfig,
+    RegressorConfig,
+    SeasonalityConfig,
+    SolverConfig,
+)
+from tsspark_tpu.data import datasets
+from tsspark_tpu.eval import metrics
+
+
+def _case_configs(scale: float):
+    """The four fit configs (5 is streaming; its parity is covered by the
+    warm-start tests) with datasets sized for a tractable scipy oracle."""
+    return {
+        "config1_peyton": (
+            datasets.peyton_manning_like(n_days=max(400, int(2905 * scale))),
+            ProphetConfig(
+                seasonalities=(
+                    SeasonalityConfig("yearly", 365.25, 10),
+                    SeasonalityConfig("weekly", 7.0, 3),
+                ),
+                n_changepoints=25,
+            ),
+            SolverConfig(max_iters=200),
+        ),
+        "config2_m4_hourly": (
+            datasets.m4_hourly_like(n_series=max(8, int(414 * scale))),
+            ProphetConfig(
+                seasonalities=(
+                    SeasonalityConfig("daily", 1.0, 4),
+                    SeasonalityConfig("weekly", 7.0, 3),
+                ),
+                n_changepoints=10,
+            ),
+            SolverConfig(max_iters=150),
+        ),
+        "config3_m5": (
+            datasets.m5_like(n_series=max(16, int(30490 * scale))),
+            ProphetConfig(
+                seasonalities=(
+                    SeasonalityConfig("yearly", 365.25, 8),
+                    SeasonalityConfig("weekly", 7.0, 3),
+                ),
+                regressors=(
+                    RegressorConfig("holiday", standardize=False),
+                    RegressorConfig("price"),
+                    RegressorConfig("promo", standardize=False),
+                ),
+                n_changepoints=25,
+            ),
+            SolverConfig(max_iters=120),
+        ),
+        "config4_wiki_logistic": (
+            datasets.wiki_logistic_like(n_series=max(4, int(8 * scale * 8))),
+            ProphetConfig(
+                growth="logistic",
+                seasonalities=(
+                    SeasonalityConfig("weekly", 7.0, 3, mode="multiplicative"),
+                ),
+                n_changepoints=15,
+            ),
+            SolverConfig(max_iters=200),
+        ),
+    }
+
+
+def _smape_per_series(cfg, solver, batch, backend: str, holdout_frac=0.1):
+    t_len = batch.y.shape[1]
+    split = int(t_len * (1 - holdout_frac))
+    bk = get_backend(backend, cfg, solver)
+    kw = {}
+    if batch.cap is not None:
+        kw["cap"] = jnp.asarray(batch.cap[:, :split])
+    if batch.regressors is not None:
+        kw["regressors"] = jnp.asarray(batch.regressors[:, :split])
+    t0 = time.time()
+    state = bk.fit(
+        jnp.asarray(batch.ds[:split]),
+        jnp.asarray(np.nan_to_num(batch.y[:, :split])),
+        mask=jnp.asarray(batch.mask[:, :split]),
+        **kw,
+    )
+    jax.block_until_ready(state.theta)
+    fit_s = time.time() - t0
+    pkw = {}
+    if batch.cap is not None:
+        pkw["cap"] = jnp.asarray(batch.cap)
+    if batch.regressors is not None:
+        pkw["regressors"] = jnp.asarray(batch.regressors)
+    fc = bk.predict(state, jnp.asarray(batch.ds), num_samples=0, **pkw)
+    y = jnp.asarray(np.nan_to_num(batch.y))
+    m_train = jnp.asarray(batch.mask).at[:, split:].set(0.0)
+    m_hold = jnp.asarray(batch.mask).at[:, :split].set(0.0)
+    return (
+        np.asarray(metrics.smape(y, fc["yhat"], m_train)),
+        np.asarray(metrics.smape(y, fc["yhat"], m_hold)),
+        fit_s,
+    )
+
+
+def run_parity(scale: float = 0.01) -> Dict:
+    out = {}
+    for name, (batch, cfg, solver) in _case_configs(scale).items():
+        tr_cpu, ho_cpu, s_cpu = _smape_per_series(cfg, solver, batch, "cpu")
+        tr_tpu, ho_tpu, s_tpu = _smape_per_series(cfg, solver, batch, "tpu")
+        out[name] = {
+            "n_series": int(batch.y.shape[0]),
+            "smape_train_cpu": round(float(tr_cpu.mean()), 4),
+            "smape_train_tpu": round(float(tr_tpu.mean()), 4),
+            "delta_train_mean": round(float((tr_tpu - tr_cpu).mean()), 4),
+            "delta_train_max_abs": round(float(np.abs(tr_tpu - tr_cpu).max()), 4),
+            "smape_holdout_cpu": round(float(ho_cpu.mean()), 4),
+            "smape_holdout_tpu": round(float(ho_tpu.mean()), 4),
+            "delta_holdout_mean": round(float((ho_tpu - ho_cpu).mean()), 4),
+            "delta_holdout_max_abs": round(
+                float(np.abs(ho_tpu - ho_cpu).max()), 4
+            ),
+            "fit_seconds_cpu": round(s_cpu, 2),
+            "fit_seconds_tpu": round(s_tpu, 2),
+        }
+    return out
+
+
+def main():
+    from tsspark_tpu.utils.platform import honor_env_platforms
+
+    honor_env_platforms()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.01)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    result = {"scale": args.scale, "configs": run_parity(args.scale)}
+    text = json.dumps(result, indent=2)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+
+
+if __name__ == "__main__":
+    main()
