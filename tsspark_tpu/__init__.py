@@ -45,7 +45,7 @@ from tsspark_tpu.models.holidays import (
 )
 from tsspark_tpu.models.prophet.model import FitState, McmcState, ProphetModel
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "DAILY",
