@@ -113,7 +113,6 @@ def fit_worker(args) -> int:
         "tpu", _model_config(), SolverConfig(max_iters=args.max_iters),
         chunk_size=args.chunk, iter_segment=args.segment or None,
     )
-    ds_j = jnp.asarray(ds)
 
     for lo in range(args.lo, args.hi, args.chunk):
         hi = min(lo + args.chunk, args.hi)
@@ -121,11 +120,13 @@ def fit_worker(args) -> int:
         if os.path.exists(out_path):
             continue
         t0 = time.time()
+        # Host arrays in: prepare_fit_data computes scalings host-side and
+        # ships only the final f32 design tensors over the tunnel once.
         state = backend.fit(
-            ds_j,
-            jnp.asarray(np.ascontiguousarray(y[lo:hi])),
-            mask=jnp.asarray(np.ascontiguousarray(mask[lo:hi])),
-            regressors=jnp.asarray(np.ascontiguousarray(reg[lo:hi])),
+            ds,
+            np.ascontiguousarray(y[lo:hi]),
+            mask=np.ascontiguousarray(mask[lo:hi]),
+            regressors=np.ascontiguousarray(reg[lo:hi]),
         )
         jax.block_until_ready(state.theta)
         fit_s = time.time() - t0
@@ -187,12 +188,14 @@ def eval_worker(args) -> int:
     cat = lambda k: jnp.asarray(
         np.concatenate([p[k] for p in parts], axis=0)[:n]
     )
+    # Meta stays host numpy float64 (ScalingMeta contract).
+    catn = lambda k: np.concatenate([p[k] for p in parts], axis=0)[:n]
     state = FitState(
         theta=cat("theta"),
         meta=ScalingMeta(
-            y_scale=cat("y_scale"), floor=cat("floor"),
-            ds_start=cat("ds_start"), ds_span=cat("ds_span"),
-            reg_mean=cat("reg_mean"), reg_std=cat("reg_std"),
+            y_scale=catn("y_scale"), floor=catn("floor"),
+            ds_start=catn("ds_start"), ds_span=catn("ds_span"),
+            reg_mean=catn("reg_mean"), reg_std=catn("reg_std"),
         ),
         loss=cat("loss"), grad_norm=cat("grad_norm"),
         converged=cat("converged"), n_iters=cat("n_iters"),
@@ -216,6 +219,11 @@ def eval_worker(args) -> int:
 # --------------------------------------------------------------------------
 # parent orchestrator (no JAX)
 # --------------------------------------------------------------------------
+
+# Live worker subprocesses: the SIGTERM handler must kill them or an orphan
+# fit child keeps holding the TPU tunnel after the parent is gone.
+_CHILDREN: set = set()
+
 
 def _tunnel_preflight(timeout: float = 90.0) -> bool:
     """Client-creation watchdog: a wedged TPU tunnel blocks ``jax.devices()``
@@ -251,34 +259,39 @@ def _spawn(mode: str, args, extra: list, timeout: Optional[float] = None,
     if mode == "--_eval":
         env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.Popen(cmd, stdout=sys.stderr, env=env)
+    _CHILDREN.add(proc)
     start = time.time()
     last_progress = start
-    n_chunks = len(_completed_ranges(args._out_dir))
-    while True:
-        try:
-            return proc.wait(timeout=10.0)
-        except subprocess.TimeoutExpired:
-            pass
-        now = time.time()
-        n_now = len(_completed_ranges(args._out_dir))
-        if n_now > n_chunks:
-            n_chunks, last_progress = n_now, now
-        timed_out = timeout is not None and now - start > timeout
-        # Before the first chunk lands the worker may legitimately be cold-
-        # compiling (minutes, no files to show for it) — give it triple the
-        # steady-state allowance.
-        allowance = (progress_timeout if n_chunks > 0
-                     else None if progress_timeout is None
-                     else 3.0 * progress_timeout)
-        stalled = (allowance is not None
-                   and now - last_progress > allowance)
-        if timed_out or stalled:
-            why = "timed out" if timed_out else "stalled (no new chunk)"
-            print(f"[bench] worker {why} after {round(now - start)}s",
-                  file=sys.stderr)
-            proc.kill()
-            proc.wait()
-            return -9
+    n_start = len(_completed_ranges(args._out_dir))
+    n_chunks = n_start
+    try:
+        while True:
+            try:
+                return proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass
+            now = time.time()
+            n_now = len(_completed_ranges(args._out_dir))
+            if n_now > n_chunks:
+                n_chunks, last_progress = n_now, now
+            timed_out = timeout is not None and now - start > timeout
+            # Until THIS worker lands its first chunk it may legitimately be
+            # cold-compiling (a halved chunk is a fresh XLA shape, minutes
+            # with nothing to show) — give it triple the steady allowance.
+            allowance = (progress_timeout if n_chunks > n_start
+                         else None if progress_timeout is None
+                         else 3.0 * progress_timeout)
+            stalled = (allowance is not None
+                       and now - last_progress > allowance)
+            if timed_out or stalled:
+                why = "timed out" if timed_out else "stalled (no new chunk)"
+                print(f"[bench] worker {why} after {round(now - start)}s",
+                      file=sys.stderr)
+                proc.kill()
+                proc.wait()
+                return -9
+    finally:
+        _CHILDREN.discard(proc)
 
 
 def _completed_ranges(out_dir: str):
@@ -409,8 +422,15 @@ def main() -> None:
     state = {"chunk": args.chunk, "retries": 0, "gen_s": 0.0}
 
     def _on_signal(signum, frame):
+        for proc in list(_CHILDREN):  # free the TPU tunnel before exiting
+            try:
+                proc.kill()
+            except OSError:
+                pass
         _emit(_build_summary(args, t_wall0, state["gen_s"], state["chunk"],
                              state["retries"], note=f"signal {signum}"))
+        if not args.keep:
+            shutil.rmtree(scratch, ignore_errors=True)
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_signal)

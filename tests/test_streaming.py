@@ -138,6 +138,88 @@ def test_kafka_source_gated():
         KafkaSource("topic")
 
 
+class _FakeMsg:
+    def __init__(self, value):
+        self.value = value
+
+
+class _FakeConsumer:
+    """Duck-typed KafkaConsumer: poll() drains pre-loaded record batches."""
+
+    def __init__(self, batches):
+        self._batches = list(batches)
+        self.poll_kwargs = []
+
+    def poll(self, timeout_ms=None, max_records=None):
+        self.poll_kwargs.append((timeout_ms, max_records))
+        if not self._batches:
+            return {}
+        rows = self._batches.pop(0)
+        return {("topic", 0): [_FakeMsg(r) for r in rows]}
+
+
+def test_kafka_source_fake_consumer_drives_streaming():
+    df = _series_df(240, "k0", seed=5)
+    rows = df.to_dict("records")
+    consumer = _FakeConsumer([rows[:200], rows[200:240], []])
+    src = KafkaSource(consumer=consumer, max_records=500)
+
+    b1 = src.poll()
+    assert isinstance(b1, pd.DataFrame) and len(b1) == 200
+    assert set(b1.columns) == {"series_id", "ds", "y"}
+    assert consumer.poll_kwargs[0] == (1000, 500)
+
+    # Remaining batches feed the refit loop; empty poll ends iteration.
+    sf = StreamingForecaster(CFG, SolverConfig(max_iters=40), backend="tpu")
+    sf.process(b1)
+    stats = sf.run(src)
+    assert stats.micro_batches == 2          # head batch + the 40-row tail
+    assert src.poll() is None                # drained
+    fc = sf.forecast(["k0"], horizon=7, num_samples=0)
+    assert np.isfinite(fc.yhat.to_numpy()).all()
+
+
+def test_param_store_meta_float64_hourly_precision():
+    """ds_start rides in absolute epoch days (~2e4); at hourly cadence a
+    float32 store quantizes it by ~5 minutes and biases the warm-start time
+    map.  The store must round-trip float64 meta exactly."""
+    ds_start = 20650.0 + 1.0 / 24.0          # not representable in float32
+    ds_span = 30.0 + 1.0 / 24.0
+    model = ProphetModel(CFG, SolverConfig(max_iters=5))
+    t = ds_start + np.arange(24 * 30, dtype=np.float64) / 24.0
+    y = 5 + np.sin(2 * np.pi * t)
+    state = model.fit(t, jnp.asarray(y[None, :], jnp.float32))
+    # Overwrite meta with exact float64 values (prepare_fit_data's f32
+    # pipeline already rounded them; the STORE must not add more).
+    state = state._replace(
+        meta=state.meta._replace(
+            ds_start=np.asarray([ds_start]), ds_span=np.asarray([ds_span])
+        )
+    )
+    store = ParamStore(CFG)
+    store.update(["h0"], state)
+    _, meta, found = store.lookup(["h0"])
+    assert found.all()
+    assert meta.ds_start.dtype == np.float64
+    assert float(meta.ds_start[0]) == ds_start          # exact
+    assert float(np.float32(ds_start)) != ds_start      # f32 would not be
+    # ...and through the DISK round trip (save -> load -> lookup): the
+    # checkpoint layer must not reintroduce a float32 hop.
+    import tempfile, os as _os
+    with tempfile.TemporaryDirectory() as d:
+        store.save(_os.path.join(d, "ps"))
+        restored = ParamStore.load(_os.path.join(d, "ps"), CFG)
+        _, meta2, found2 = restored.lookup(["h0"])
+        assert found2.all()
+        assert meta2.ds_start.dtype == np.float64
+        assert float(meta2.ds_start[0]) == ds_start
+    # The warm-start time offset between two windows 1h apart must come out
+    # to 1h with sub-second accuracy (float32 meta is ~5 min off here).
+    start_new = ds_start + 1.0 / 24.0
+    b = (start_new - float(meta.ds_start[0])) / float(meta.ds_span[0])
+    assert abs(b * ds_span - 1.0 / 24.0) < 1e-9
+
+
 def test_param_store_persistence(tmp_path):
     sf = StreamingForecaster(CFG, SolverConfig(max_iters=40), backend="tpu")
     sf.run(InMemorySource([_series_df(150, "x", 9)]))

@@ -35,7 +35,14 @@ def _slice_state(state: FitState, lo: int, hi: int) -> FitState:
 
 
 def _concat_states(states) -> FitState:
-    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *states)
+    # Host numpy leaves (ScalingMeta, float64) concatenate as numpy;
+    # jnp.concatenate would silently downcast them to f32.
+    def cat(*xs):
+        if isinstance(xs[0], np.ndarray):
+            return np.concatenate(xs, axis=0)
+        return jnp.concatenate(xs, axis=0)
+
+    return jax.tree.map(cat, *states)
 
 
 @register_backend

@@ -307,6 +307,13 @@ class Forecaster:
             regressor_cols=self.regressor_cols,
         )
         order = {s: i for i, s in enumerate(batch.series_ids)}
+        missing = [s for s in self.series_ids if s not in order]
+        if missing:
+            raise ValueError(
+                f"future frame is missing {len(missing)} training series "
+                f"(e.g. {missing[:5]}); every fitted series needs future "
+                f"rows, or pass horizon= to auto-extend the calendar"
+            )
         perm = np.asarray([order[s] for s in self.series_ids])
         cap = None if batch.cap is None else batch.cap[perm]
         reg = None if batch.regressors is None else batch.regressors[perm]

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import NamedTuple, Optional, Tuple
 
+import numpy as np
 import jax.numpy as jnp
 
 from tsspark_tpu.config import ProphetConfig
@@ -26,14 +27,20 @@ from tsspark_tpu.models.prophet.params import ProphetParams, unpack
 
 
 class ScalingMeta(NamedTuple):
-    """Per-series affine scalings needed to map predictions back to data units."""
+    """Per-series affine scalings needed to map predictions back to data units.
 
-    y_scale: jnp.ndarray        # (B,)
-    floor: jnp.ndarray          # (B,)
-    ds_start: jnp.ndarray       # (B,) absolute days of first observation
-    ds_span: jnp.ndarray        # (B,) observed span in days (>= 1 step)
-    reg_mean: jnp.ndarray       # (B, R) regressor standardization mean
-    reg_std: jnp.ndarray        # (B, R) regressor standardization std
+    Fields are HOST numpy float64: ``ds_start`` is absolute epoch days
+    (~2e4), where float32's ulp is ~5 minutes — computing the time maps
+    (fit-time ``t``, predict-time ``t``, warm-start transfer) demands the
+    subtraction happen in f64 *before* anything is cast to the device f32.
+    """
+
+    y_scale: np.ndarray        # (B,)
+    floor: np.ndarray          # (B,)
+    ds_start: np.ndarray       # (B,) absolute days of first observation
+    ds_span: np.ndarray        # (B,) observed span in days (>= 1 step)
+    reg_mean: np.ndarray       # (B, R) regressor standardization mean
+    reg_std: np.ndarray        # (B, R) regressor standardization std
 
 
 class FitData(NamedTuple):
@@ -128,53 +135,58 @@ def prepare_fit_data(
     Returns:
       (FitData, ScalingMeta).
     """
-    y = jnp.asarray(y, dtype)
-    if y.ndim != 2:
-        raise ValueError(f"y must be (B, T), got {y.shape}")
-    b, t_len = y.shape
-    ds = jnp.asarray(ds, dtype)
-    ds_b = jnp.broadcast_to(ds, (b, t_len)) if ds.ndim == 1 else ds
+    # All scaling statistics are computed HOST-SIDE in float64: ds carries
+    # absolute epoch days (~2e4) where float32 quantizes to ~5 minutes, so
+    # the (ds - ds_start) subtraction must happen before any f32 cast.  The
+    # resulting O(1) tensors are then shipped to the device as f32.
+    y_np = np.asarray(y, np.float64)
+    if y_np.ndim != 2:
+        raise ValueError(f"y must be (B, T), got {y_np.shape}")
+    b, t_len = y_np.shape
+    ds_np = np.asarray(ds, np.float64)
+    shared_grid = ds_np.ndim == 1
+    ds_b = np.broadcast_to(ds_np, (b, t_len)) if shared_grid else ds_np
 
-    finite = jnp.isfinite(y)
+    finite = np.isfinite(y_np)
     if mask is None:
-        mask = finite.astype(dtype)
+        mask_np = finite.astype(np.float64)
     else:
-        mask = jnp.asarray(mask, dtype) * finite.astype(dtype)
-    y = jnp.where(mask > 0, jnp.nan_to_num(y), 0.0)
+        mask_np = np.asarray(mask, np.float64) * finite
+    y_np = np.where(mask_np > 0, np.nan_to_num(y_np), 0.0)
 
     # Per-series observed span -> scaled time in [0, 1].  Fully-masked rows
     # (dummy padding series) fall back to the raw grid span so every
     # downstream quantity stays finite.
-    any_obs = mask.sum(axis=-1) > 0
-    big = jnp.where(mask > 0, ds_b, jnp.inf)
-    small = jnp.where(mask > 0, ds_b, -jnp.inf)
-    ds_start = jnp.where(any_obs, jnp.min(big, axis=-1), jnp.min(ds_b, axis=-1))
-    ds_end = jnp.where(any_obs, jnp.max(small, axis=-1), jnp.max(ds_b, axis=-1))
+    any_obs = mask_np.sum(axis=-1) > 0
+    big = np.where(mask_np > 0, ds_b, np.inf)
+    small = np.where(mask_np > 0, ds_b, -np.inf)
+    ds_start = np.where(any_obs, big.min(axis=-1), ds_b.min(axis=-1))
+    ds_end = np.where(any_obs, small.max(axis=-1), ds_b.max(axis=-1))
     # Span floor = one grid step, so degenerate (single-observation) series
     # keep future scaled times O(1) instead of exploding.
-    grid_span = jnp.max(ds_b, axis=-1) - jnp.min(ds_b, axis=-1)
-    step = grid_span / jnp.maximum(t_len - 1, 1)
-    ds_span = jnp.maximum(ds_end - ds_start, jnp.maximum(step, 1e-9))
+    grid_span = ds_b.max(axis=-1) - ds_b.min(axis=-1)
+    step = grid_span / max(t_len - 1, 1)
+    ds_span = np.maximum(ds_end - ds_start, np.maximum(step, 1e-9))
     t = (ds_b - ds_start[:, None]) / ds_span[:, None]
 
     # Per-series y scaling (Prophet absmax scaling; floor only for logistic).
     if floor is None:
-        floor_b = jnp.zeros((b,), dtype)
+        floor_b = np.zeros((b,))
     else:
-        floor_b = jnp.asarray(floor, dtype)
+        floor_b = np.asarray(floor, np.float64)
         if floor_b.ndim == 2:
             floor_b = floor_b[:, 0]
-    y_shift = y - floor_b[:, None]
-    y_scale = jnp.max(jnp.abs(y_shift) * mask, axis=-1)
-    y_scale = jnp.maximum(y_scale, 1e-10)
-    y_s = jnp.where(mask > 0, y_shift / y_scale[:, None], 0.0)
+    y_shift = y_np - floor_b[:, None]
+    y_scale = np.maximum(np.max(np.abs(y_shift) * mask_np, axis=-1), 1e-10)
+    y_s = np.where(mask_np > 0, y_shift / y_scale[:, None], 0.0)
 
     if config.growth == "logistic":
         if cap is None:
             raise ValueError("logistic growth requires cap")
-        cap_s = (jnp.asarray(cap, dtype) - floor_b[:, None]) / y_scale[:, None]
+        cap_s = (np.asarray(cap, np.float64) - floor_b[:, None]) \
+            / y_scale[:, None]
     else:
-        cap_s = jnp.ones((b, t_len), dtype)
+        cap_s = np.ones((b, t_len))
 
     # Changepoints: observed span maps to exactly [0, 1] in scaled time.
     s = trend.uniform_changepoints(
@@ -185,8 +197,9 @@ def prepare_fit_data(
     )
 
     # Seasonal features from absolute time; shared grid -> shared matrix.
+    # (f64 host input: the period fold inside keeps full phase precision.)
     x_season = seasonality.seasonal_feature_matrix(
-        ds if ds.ndim == 1 else ds_b, config.seasonalities
+        ds_np if shared_grid else ds_b, config.seasonalities
     ).astype(dtype)
 
     # External regressors: per-series standardization over observed window.
@@ -194,37 +207,37 @@ def prepare_fit_data(
     if r:
         if regressors is None:
             raise ValueError(f"config declares {r} regressors but none given")
-        reg = jnp.asarray(regressors, dtype)
+        reg = np.asarray(regressors, np.float64)
         if reg.shape != (b, t_len, r):
             raise ValueError(f"regressors shape {reg.shape} != {(b, t_len, r)}")
-        n = jnp.maximum(mask.sum(-1), 1.0)[:, None]
-        mean = (reg * mask[..., None]).sum(1) / n
-        var = (((reg - mean[:, None, :]) ** 2) * mask[..., None]).sum(1) / n
-        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        n = np.maximum(mask_np.sum(-1), 1.0)[:, None]
+        mean = (reg * mask_np[..., None]).sum(1) / n
+        var = (((reg - mean[:, None, :]) ** 2) * mask_np[..., None]).sum(1) / n
+        std = np.sqrt(np.maximum(var, 0.0))
         # Don't rescale columns the user opted out of, nor (near-)constant
         # or binary-indicator columns (Prophet's standardize='auto' rule).
-        opt_out = jnp.asarray(
+        opt_out = np.asarray(
             [not rc.standardize for rc in config.regressors], bool
         )[None, :]
-        skip = opt_out | jnp.all(
-            (mask[..., None] == 0) | (reg == 0) | (reg == 1), axis=1
+        skip = opt_out | np.all(
+            (mask_np[..., None] == 0) | (reg == 0) | (reg == 1), axis=1
         ) | (std < 1e-8)
-        std_eff = jnp.where(skip, 1.0, std)
-        mean_eff = jnp.where(skip, 0.0, mean)
+        std_eff = np.where(skip, 1.0, std)
+        mean_eff = np.where(skip, 0.0, mean)
         x_reg = (reg - mean_eff[:, None, :]) / std_eff[:, None, :]
     else:
-        x_reg = jnp.zeros((b, t_len, 0), dtype)
-        mean_eff = jnp.zeros((b, 0), dtype)
-        std_eff = jnp.ones((b, 0), dtype)
+        x_reg = np.zeros((b, t_len, 0))
+        mean_eff = np.zeros((b, 0))
+        std_eff = np.ones((b, 0))
 
     data = FitData(
-        t=t,
-        y=y_s,
-        mask=mask,
+        t=jnp.asarray(t, dtype),
+        y=jnp.asarray(y_s, dtype),
+        mask=jnp.asarray(mask_np, dtype),
         s=s,
-        cap=cap_s,
+        cap=jnp.asarray(cap_s, dtype),
         X_season=x_season,
-        X_reg=x_reg,
+        X_reg=jnp.asarray(x_reg, dtype),
         prior_scales=jnp.asarray(config.feature_prior_scales(), dtype),
         mult_mask=jnp.asarray(
             [1.0 if m else 0.0 for m in config.feature_modes()], dtype

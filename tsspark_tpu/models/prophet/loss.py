@@ -18,7 +18,8 @@ per-series gradients — one backward pass serves the whole batch.
 The Laplace prior's |delta| kink is smoothed with a tiny Huber radius so the
 fixed-iteration batched L-BFGS (ops/lbfgs.py) sees a C1 objective; the
 smoothing radius is far below the parameter noise floor and does not move the
-MAP point materially (validated against scipy in tests/test_parity.py).
+MAP point materially (validated against scipy in
+tests/test_backends.py::test_cpu_tpu_smape_parity and eval/parity.py).
 """
 
 from __future__ import annotations

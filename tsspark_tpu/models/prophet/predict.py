@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Tuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -40,31 +41,48 @@ def prepare_predict_data(
     """Assemble design tensors for a (future or in-sample) time grid.
 
     Scalings are the *training* scalings from ``meta`` — predictions must be
-    produced in the same parameter space the model was fit in.
+    produced in the same parameter space the model was fit in.  Time maps
+    are computed host-side in float64 (absolute epoch days vs. float32's
+    ~5-minute ulp; see ScalingMeta) before casting to the device dtype.
     """
-    ds = jnp.asarray(ds, dtype)
+    ds_np = np.asarray(ds, np.float64)
     b = meta.y_scale.shape[0]
-    ds_b = jnp.broadcast_to(ds, (b,) + ds.shape[-1:]) if ds.ndim == 1 else ds
+    shared_grid = ds_np.ndim == 1
+    ds_b = (np.broadcast_to(ds_np, (b,) + ds_np.shape[-1:])
+            if shared_grid else ds_np)
     t_len = ds_b.shape[-1]
-    t = (ds_b - meta.ds_start[:, None]) / meta.ds_span[:, None]
+    ds_start = np.asarray(meta.ds_start, np.float64)
+    ds_span = np.asarray(meta.ds_span, np.float64)
+    t = jnp.asarray(
+        (ds_b - ds_start[:, None]) / ds_span[:, None], dtype
+    )
 
+    y_scale = np.asarray(meta.y_scale, np.float64)
+    floor = np.asarray(meta.floor, np.float64)
     if config.growth == "logistic":
         if cap is None:
             raise ValueError("logistic growth requires cap at predict time")
-        cap_s = (jnp.asarray(cap, dtype) - meta.floor[:, None]) / meta.y_scale[:, None]
+        cap_s = jnp.asarray(
+            (np.asarray(cap, np.float64) - floor[:, None]) / y_scale[:, None],
+            dtype,
+        )
     else:
         cap_s = jnp.ones((b, t_len), dtype)
 
     x_season = seasonality.seasonal_feature_matrix(
-        ds if ds.ndim == 1 else ds_b, config.seasonalities
+        ds_np if shared_grid else ds_b, config.seasonalities
     ).astype(dtype)
 
     r = config.num_regressors
     if r:
         if regressors is None:
             raise ValueError(f"config declares {r} regressors but none given")
-        reg = jnp.asarray(regressors, dtype)
-        x_reg = (reg - meta.reg_mean[:, None, :]) / meta.reg_std[:, None, :]
+        reg = np.asarray(regressors, np.float64)
+        x_reg = jnp.asarray(
+            (reg - np.asarray(meta.reg_mean, np.float64)[:, None, :])
+            / np.asarray(meta.reg_std, np.float64)[:, None, :],
+            dtype,
+        )
     else:
         x_reg = jnp.zeros((b, t_len, 0), dtype)
 

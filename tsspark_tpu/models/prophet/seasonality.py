@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import numpy as np
 import jax.numpy as jnp
 
 from tsspark_tpu.config import ProphetConfig, SeasonalityConfig
@@ -31,10 +32,16 @@ def fourier_features(
       (..., T, 2K) features [sin(2pi*1*t/p), cos(2pi*1*t/p), ..., sin(2pi*K*t/p),
       cos(2pi*K*t/p)].
     """
-    n = jnp.arange(1, order + 1, dtype=t_days.dtype)
-    # (..., T, K) angles; fold t into [0, period) first so float32 keeps phase
-    # precision even for large day counts.
-    t_mod = jnp.mod(t_days, period)
+    # Fold t into [0, period) first so the trig arguments keep phase
+    # precision even for large absolute day counts.  Host arrays fold in
+    # float64 (epoch days ~2e4 quantize to ~5min in f32 — visible phase
+    # error for sub-daily periods); traced/device arrays fold in-graph.
+    if isinstance(t_days, np.ndarray):
+        t_mod = jnp.asarray(np.mod(t_days.astype(np.float64), period),
+                            jnp.float32)
+    else:
+        t_mod = jnp.mod(t_days, period)
+    n = jnp.arange(1, order + 1, dtype=t_mod.dtype)
     angles = 2.0 * jnp.pi * t_mod[..., None] * n / period
     feats = jnp.stack([jnp.sin(angles), jnp.cos(angles)], axis=-1)
     return feats.reshape(feats.shape[:-2] + (2 * order,))
@@ -45,7 +52,7 @@ def seasonal_feature_matrix(
 ) -> jnp.ndarray:
     """Concatenate all seasonality blocks into one (..., T, F_seasonal) matrix."""
     if not seasonalities:
-        return jnp.zeros(t_days.shape + (0,), t_days.dtype)
+        return jnp.zeros(t_days.shape + (0,), jnp.float32)
     blocks = [
         fourier_features(t_days, s.period, s.fourier_order) for s in seasonalities
     ]

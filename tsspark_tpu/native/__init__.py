@@ -96,13 +96,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.pstore_row_dim.argtypes = [ctypes.c_void_p]
         lib.pstore_row_dim.restype = ctypes.c_int64
         lib.pstore_update.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, _i64p, _f32p,
+            ctypes.c_void_p, ctypes.c_int64, _i64p, _f64p,
         ]
         lib.pstore_lookup.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, _i64p, _f32p, _u8p,
+            ctypes.c_void_p, ctypes.c_int64, _i64p, _f64p, _u8p,
         ]
         lib.pstore_lookup.restype = ctypes.c_int64
-        lib.pstore_export.argtypes = [ctypes.c_void_p, _i64p, _f32p]
+        lib.pstore_export.argtypes = [ctypes.c_void_p, _i64p, _f64p]
         _LIB = lib
         return _LIB
 
@@ -221,7 +221,11 @@ class HistoryStore:
 
 
 class ParamTable:
-    """Fixed-width float32 rows keyed by int64 id (bulk upsert/gather).
+    """Fixed-width float64 rows keyed by int64 id (bulk upsert/gather).
+
+    Double precision because rows carry absolute-time scaling meta
+    (``ds_start`` in epoch days ~2e4): float32 quantizes hourly/minute
+    warm-start alignment to ~5-minute granularity.
 
     The native backing store for the streaming warm-start ParamStore: one
     micro-batch update/lookup is two memcpy-bound C calls instead of a
@@ -236,7 +240,7 @@ class ParamTable:
             self._handle = ctypes.c_void_p(self._lib.pstore_new(self.row_dim))
         else:
             self._idx: dict = {}          # id -> row number
-            self._rows: list = []         # list of np.float32 rows
+            self._rows: list = []         # list of np.float64 rows
 
     def __del__(self):
         if getattr(self, "_lib", None) is not None and self._handle:
@@ -250,7 +254,7 @@ class ParamTable:
 
     def update(self, ids: np.ndarray, rows: np.ndarray) -> None:
         ids = np.ascontiguousarray(ids, np.int64)
-        rows = np.ascontiguousarray(rows, np.float32)
+        rows = np.ascontiguousarray(rows, np.float64)
         if rows.shape != (len(ids), self.row_dim):
             raise ValueError(
                 f"rows shape {rows.shape} != ({len(ids)}, {self.row_dim})"
@@ -268,10 +272,10 @@ class ParamTable:
                 self._rows.append(rows[i].copy())
 
     def lookup(self, ids: np.ndarray):
-        """Returns (rows (n, row_dim) float32 zero-filled on miss, found (n,) bool)."""
+        """Returns (rows (n, row_dim) float64 zero-filled on miss, found (n,) bool)."""
         ids = np.ascontiguousarray(ids, np.int64)
         n = len(ids)
-        out = np.empty((n, self.row_dim), np.float32)
+        out = np.empty((n, self.row_dim), np.float64)
         found = np.empty(n, np.uint8)
         if self._lib is not None:
             self._lib.pstore_lookup(self._handle, n, ids, out.reshape(-1),
@@ -287,7 +291,7 @@ class ParamTable:
         """All (ids (N,), rows (N, row_dim)) pairs, insertion-ordered."""
         n = len(self)
         ids = np.empty(n, np.int64)
-        rows = np.empty((n, self.row_dim), np.float32)
+        rows = np.empty((n, self.row_dim), np.float64)
         if self._lib is not None:
             if n:
                 self._lib.pstore_export(self._handle, ids, rows.reshape(-1))

@@ -40,10 +40,13 @@ struct Store {
 };
 
 struct ParamTable {
-  // Fixed-width float32 rows in contiguous storage; id -> row index.
+  // Fixed-width float64 rows in contiguous storage; id -> row index.
+  // Double because the rows carry absolute-time scaling meta (ds_start in
+  // epoch days ~2e4): float32 would quantize warm-start time alignment to
+  // ~5-minute granularity, a real bias at hourly/minute cadence.
   int64_t row_dim;
   std::unordered_map<int64_t, int64_t> index;
-  std::vector<float> rows;
+  std::vector<double> rows;
   std::vector<int64_t> ids;  // row index -> id (for export)
 };
 
@@ -233,10 +236,10 @@ int64_t pstore_row_dim(void* handle) {
   return static_cast<ParamTable*>(handle)->row_dim;
 }
 
-// Upsert n rows (each row_dim floats).  Last write wins on duplicate ids
+// Upsert n rows (each row_dim doubles).  Last write wins on duplicate ids
 // within one call (matching the Python dict semantics it replaces).
 void pstore_update(void* handle, int64_t n, const int64_t* ids,
-                   const float* data) {
+                   const double* data) {
   auto* t = static_cast<ParamTable*>(handle);
   const int64_t d = t->row_dim;
   for (int64_t i = 0; i < n; ++i) {
@@ -247,14 +250,14 @@ void pstore_update(void* handle, int64_t n, const int64_t* ids,
       t->rows.resize(t->rows.size() + d);
     }
     std::memcpy(t->rows.data() + it->second * d, data + i * d,
-                d * sizeof(float));
+                d * sizeof(double));
   }
 }
 
 // Gather n rows into out (n x row_dim, zero-filled on miss); found[i] gets
 // 1/0.  Returns the number found.  Threaded gather for large batches.
-int64_t pstore_lookup(void* handle, int64_t n, const int64_t* ids, float* out,
-                      uint8_t* found) {
+int64_t pstore_lookup(void* handle, int64_t n, const int64_t* ids,
+                      double* out, uint8_t* found) {
   auto* t = static_cast<ParamTable*>(handle);
   const int64_t d = t->row_dim;
   std::vector<int64_t> row_of(n);
@@ -267,11 +270,11 @@ int64_t pstore_lookup(void* handle, int64_t n, const int64_t* ids, float* out,
   }
   auto gather = [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) {
-      float* dst = out + i * d;
+      double* dst = out + i * d;
       if (row_of[i] < 0) {
-        std::fill(dst, dst + d, 0.0f);
+        std::fill(dst, dst + d, 0.0);
       } else {
-        std::memcpy(dst, t->rows.data() + row_of[i] * d, d * sizeof(float));
+        std::memcpy(dst, t->rows.data() + row_of[i] * d, d * sizeof(double));
       }
     }
   };
@@ -292,10 +295,10 @@ int64_t pstore_lookup(void* handle, int64_t n, const int64_t* ids, float* out,
 }
 
 // Dump every (id, row) pair; buffers must hold pstore_size rows.
-void pstore_export(void* handle, int64_t* ids_out, float* rows_out) {
+void pstore_export(void* handle, int64_t* ids_out, double* rows_out) {
   auto* t = static_cast<ParamTable*>(handle);
   std::memcpy(ids_out, t->ids.data(), t->ids.size() * sizeof(int64_t));
-  std::memcpy(rows_out, t->rows.data(), t->rows.size() * sizeof(float));
+  std::memcpy(rows_out, t->rows.data(), t->rows.size() * sizeof(double));
 }
 
 }  // extern "C"

@@ -12,7 +12,7 @@ scatter):
   3. hand the forecast frame back as a Spark DataFrame (createDataFrame).
 
 PySpark is NOT installed in this image; the adapter is import-gated and the
-test suite exercises it with a duck-typed fake (tests/test_spark_adapter.py).
+test suite exercises it with a duck-typed fake (tests/test_spark_cli.py).
 Anything exposing ``toPandas()`` and a ``sparkSession.createDataFrame(pdf)``
 works — real pyspark included.
 """

@@ -46,31 +46,42 @@ class InMemorySource(MicroBatchSource):
 
 
 class KafkaSource(MicroBatchSource):
-    """Kafka consumer adapter (requires a kafka client at runtime).
+    """Kafka consumer adapter.
 
     Messages are expected to be JSON rows {series_id, ds, y, ...}; each
     ``poll`` drains up to ``max_records`` into one micro-batch frame.
+
+    ``consumer`` injects any object with the KafkaConsumer ``poll``
+    contract (``poll(timeout_ms=..., max_records=...) ->
+    {partition: [records with .value]}``) — how the tests exercise this
+    path without a broker, and how alternative clients plug in.  Without
+    it, a ``kafka-python``-compatible package must be importable.
     """
 
-    def __init__(self, topic: str, max_records: int = 10000, **consumer_kwargs):
-        try:
-            from kafka import KafkaConsumer  # type: ignore
-        except ImportError as e:  # pragma: no cover - no broker/client locally
-            raise ImportError(
-                "KafkaSource needs the 'kafka-python' package, which is not "
-                "installed on this machine; use InMemorySource or implement "
-                "MicroBatchSource over your transport"
-            ) from e
-        import json as _json
+    def __init__(self, topic: Optional[str] = None, max_records: int = 10000,
+                 consumer=None, **consumer_kwargs):
+        if consumer is not None:
+            self._consumer = consumer
+        else:
+            try:
+                from kafka import KafkaConsumer  # type: ignore
+            except ImportError as e:  # pragma: no cover - no client locally
+                raise ImportError(
+                    "KafkaSource needs the 'kafka-python' package, which is "
+                    "not installed on this machine; pass consumer=, use "
+                    "InMemorySource, or implement MicroBatchSource over "
+                    "your transport"
+                ) from e
+            import json as _json
 
-        self._consumer = KafkaConsumer(
-            topic,
-            value_deserializer=lambda b: _json.loads(b.decode()),
-            **consumer_kwargs,
-        )
+            self._consumer = KafkaConsumer(  # pragma: no cover - no broker
+                topic,
+                value_deserializer=lambda b: _json.loads(b.decode()),
+                **consumer_kwargs,
+            )
         self._max_records = max_records
 
-    def poll(self) -> Optional[pd.DataFrame]:  # pragma: no cover
+    def poll(self) -> Optional[pd.DataFrame]:
         records = self._consumer.poll(timeout_ms=1000,
                                       max_records=self._max_records)
         rows = [msg.value for part in records.values() for msg in part]

@@ -5,7 +5,7 @@ each micro-batch looks up prior parameters for the series it touches,
 warm-starts the solver, and writes the refreshed parameters back.
 
 Storage is the native ParamTable (tsspark_tpu.native, C++): one micro-batch
-update/lookup is two memcpy-bound bulk calls over contiguous float32 rows —
+update/lookup is two memcpy-bound bulk calls over contiguous float64 rows —
 the Python layer only interns string series ids to int64 codes.  Persistence
 stays npz via utils.checkpoint; new series simply miss and fall back to
 data-driven init.
@@ -31,27 +31,37 @@ def _meta_dim(config: ProphetConfig) -> int:
 
 
 def _flatten_meta(meta: ScalingMeta) -> np.ndarray:
-    """(B, meta_dim) float32 row-block from a batched ScalingMeta."""
+    """(B, meta_dim) float64 row-block from a batched ScalingMeta.
+
+    Float64 end-to-end: ``ds_start`` is in absolute epoch days (~2e4), where
+    float32's ulp is ~5 minutes — enough to bias hourly-cadence warm-start
+    time alignment (the quantity that matters downstream is the *difference*
+    of two such starts, see warmstart.transfer_theta).
+    """
     cols = [
-        np.asarray(meta.y_scale, np.float32)[:, None],
-        np.asarray(meta.floor, np.float32)[:, None],
-        np.asarray(meta.ds_start, np.float32)[:, None],
-        np.asarray(meta.ds_span, np.float32)[:, None],
-        np.asarray(meta.reg_mean, np.float32),
-        np.asarray(meta.reg_std, np.float32),
+        np.asarray(meta.y_scale, np.float64)[:, None],
+        np.asarray(meta.floor, np.float64)[:, None],
+        np.asarray(meta.ds_start, np.float64)[:, None],
+        np.asarray(meta.ds_span, np.float64)[:, None],
+        np.asarray(meta.reg_mean, np.float64),
+        np.asarray(meta.reg_std, np.float64),
     ]
     return np.concatenate(cols, axis=1)
 
 
 def _unflatten_meta(rows: np.ndarray, config: ProphetConfig) -> ScalingMeta:
+    """Numpy float64 fields on purpose: jnp.asarray would silently downcast
+    to float32 (x64 is off) and re-introduce the quantization the store
+    avoids.  Consumers doing jnp math cast AFTER the precision-critical
+    differences are taken (warmstart.py)."""
     r = config.num_regressors
     return ScalingMeta(
-        y_scale=jnp.asarray(rows[:, 0]),
-        floor=jnp.asarray(rows[:, 1]),
-        ds_start=jnp.asarray(rows[:, 2]),
-        ds_span=jnp.asarray(rows[:, 3]),
-        reg_mean=jnp.asarray(rows[:, 4 : 4 + r]),
-        reg_std=jnp.asarray(rows[:, 4 + r : 4 + 2 * r]),
+        y_scale=np.asarray(rows[:, 0]),
+        floor=np.asarray(rows[:, 1]),
+        ds_start=np.asarray(rows[:, 2]),
+        ds_span=np.asarray(rows[:, 3]),
+        reg_mean=np.asarray(rows[:, 4 : 4 + r]),
+        reg_std=np.asarray(rows[:, 4 + r : 4 + 2 * r]),
     )
 
 
@@ -87,7 +97,7 @@ class ParamStore:
 
     def update(self, series_ids: Sequence, state: FitState) -> None:
         rows = np.concatenate(
-            [np.asarray(state.theta, np.float32), _flatten_meta(state.meta)],
+            [np.asarray(state.theta, np.float64), _flatten_meta(state.meta)],
             axis=1,
         )
         self._table.update(self._codes(series_ids, intern=True), rows)

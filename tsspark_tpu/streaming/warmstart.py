@@ -25,6 +25,7 @@ same problem for its Spark micro-batch refits.)
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from tsspark_tpu.config import ProphetConfig
@@ -41,13 +42,26 @@ def transfer_theta(
 ) -> jnp.ndarray:
     """Map (B, P) fitted params from meta_old's space into meta_new's space."""
     p = unpack(theta_old, config)
-    a = (meta_new.ds_span / meta_old.ds_span)[:, None]          # (B, 1)
-    b = ((meta_new.ds_start - meta_old.ds_start) / meta_old.ds_span)[:, None]
-    r = (meta_old.y_scale / meta_new.y_scale)[:, None]
+    # The affine maps in FLOAT64 on host: ds_start is absolute epoch days
+    # (~2e4), and start_new - start_old is a catastrophic cancellation in
+    # float32 (ulp ~5 min) at sub-daily cadence.  The differences/ratios are
+    # O(1), so casting the RESULTS to f32 for the jnp math below is lossless
+    # in every way that matters.
+    f64 = lambda x: np.asarray(x, np.float64)
+    dtype = theta_old.dtype
+    a = jnp.asarray(
+        f64(meta_new.ds_span) / f64(meta_old.ds_span), dtype
+    )[:, None]                                                   # (B, 1)
+    b = jnp.asarray(
+        (f64(meta_new.ds_start) - f64(meta_old.ds_start))
+        / f64(meta_old.ds_span), dtype
+    )[:, None]
+    r = jnp.asarray(
+        f64(meta_old.y_scale) / f64(meta_new.y_scale), dtype
+    )[:, None]
 
     n_cp = config.n_changepoints
     batch = theta_old.shape[0]
-    dtype = theta_old.dtype
     s_new = trend_mod.uniform_changepoints(
         jnp.zeros((batch,), dtype), jnp.ones((batch,), dtype),
         n_cp, config.changepoint_range,

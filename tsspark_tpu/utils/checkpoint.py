@@ -197,8 +197,10 @@ def load_state(
             "force-load"
         )
     z = np.load(path + ".npz")
+    # Meta stays HOST numpy float64 (see ScalingMeta): jnp.asarray would
+    # downcast ds_start/ds_span to f32 and quantize sub-daily warm starts.
     meta = ScalingMeta(**{
-        k[len("meta_"):]: jnp.asarray(z[k])
+        k[len("meta_"):]: np.asarray(z[k], np.float64)
         for k in z.files if k.startswith("meta_")
     })
     state = FitState(
