@@ -242,6 +242,28 @@ class Forecaster:
 
     # -- predict ---------------------------------------------------------------
 
+    def mcmc_diagnostics(self) -> pd.DataFrame:
+        """Per-series sampler health: worst split-R-hat, smallest bulk ESS,
+        acceptance rate, divergence count (the Stan-summary convergence gate
+        for the ``mcmc_samples`` path).  R-hat above ~1.05 or tiny ESS means
+        the chain has not converged — lengthen warmup/samples."""
+        if self.mcmc_state is None:
+            raise RuntimeError(
+                "no MCMC fit: construct with mcmc_samples=N (or mcmc_config) "
+                "and call fit first"
+            )
+        ms = self.mcmc_state
+        rhat = np.asarray(ms.rhat)
+        ess = np.asarray(ms.ess)
+        return pd.DataFrame({
+            "series_id": list(self.series_ids),
+            "rhat_max": rhat.max(axis=-1),
+            "ess_min": ess.min(axis=-1),
+            "ess_mean": ess.mean(axis=-1),
+            "accept_rate": np.asarray(ms.accept_rate),
+            "divergences": np.asarray(ms.divergences),
+        })
+
     def make_future_grid(self, horizon: int, include_history: bool = False
                          ) -> np.ndarray:
         if self._train_ds is None:

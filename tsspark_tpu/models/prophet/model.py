@@ -97,7 +97,9 @@ class McmcState(NamedTuple):
 
     ``map_state`` is the MAP fit the chains were initialized from — callers
     get the point-estimate surface (components, deterministic predict) for
-    free alongside the posterior draws.
+    free alongside the posterior draws.  ``rhat``/``ess`` are per-(series,
+    parameter) split-R-hat and bulk ESS (ops/hmc.split_rhat_ess) — the
+    convergence gate Stan users read off its summary.
     """
 
     samples: jnp.ndarray
@@ -106,6 +108,8 @@ class McmcState(NamedTuple):
     step_size: jnp.ndarray
     divergences: jnp.ndarray
     map_state: "FitState"
+    rhat: Optional[jnp.ndarray] = None   # (B, P)
+    ess: Optional[jnp.ndarray] = None    # (B, P)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "mcmc_config"))
@@ -252,6 +256,7 @@ class ProphetModel:
             data, map_state.theta, jax.random.PRNGKey(seed), self.config,
             mcmc_config,
         )
+        rhat, ess = hmc.split_rhat_ess(res.samples)
         return McmcState(
             samples=res.samples,
             meta=meta,
@@ -259,6 +264,8 @@ class ProphetModel:
             step_size=res.step_size,
             divergences=res.divergences,
             map_state=map_state,
+            rhat=rhat,
+            ess=ess,
         )
 
     # -- prediction ------------------------------------------------------------

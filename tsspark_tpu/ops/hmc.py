@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple, Tuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -62,6 +63,59 @@ class HmcResult(NamedTuple):
     step_size: jnp.ndarray    # (B,) adapted step size
     inv_mass: jnp.ndarray     # (B, P) adapted diagonal metric
     divergences: jnp.ndarray  # (B,) divergent-transition count over sampling
+
+
+def split_rhat_ess(samples) -> Tuple[np.ndarray, np.ndarray]:
+    """Split-R-hat and bulk ESS per (series, parameter) from (S, B, P) draws.
+
+    One chain per series is what the lockstep sampler produces, so the
+    single chain is split in half (Stan's split-R-hat): the halves disagree
+    when the chain is still drifting, which is exactly the non-convergence
+    mode a short warmup causes.  ESS follows Stan's FFT autocovariance +
+    Geyer initial-monotone-positive-sequence truncation, averaged over the
+    two half-chains.  Host numpy: this runs once, after sampling.
+
+    Returns (rhat (B, P), ess (B, P)).
+    """
+    x = np.asarray(samples, np.float64)
+    s = x.shape[0]
+    if s < 4:
+        raise ValueError(f"need >= 4 draws for split diagnostics, got {s}")
+    n = s // 2
+    ch = np.stack([x[:n], x[s - n:]], axis=0)          # (2, n, B, P)
+    mean_c = ch.mean(axis=1)                           # (2, B, P)
+    var_c = ch.var(axis=1, ddof=1)                     # (2, B, P)
+    w = var_c.mean(axis=0)                             # within-chain
+    b_var = n * mean_c.var(axis=0, ddof=1)             # between-chain
+    var_hat = (n - 1) / n * w + b_var / n
+    # Degenerate (constant) marginals: perfectly converged by convention.
+    degen = (w < 1e-300) | (var_hat < 1e-300)
+    rhat = np.where(degen, 1.0, np.sqrt(var_hat / np.where(degen, 1.0, w)))
+
+    # FFT autocovariance per half-chain (biased, as Stan uses).
+    xc = ch - mean_c[:, None]
+    m = 1
+    while m < 2 * n:
+        m *= 2
+    f = np.fft.rfft(xc, n=m, axis=1)
+    acov = np.fft.irfft(f * np.conj(f), n=m, axis=1)[:, :n].real / n
+    rho = 1.0 - (w[None] - acov.mean(axis=0)) / np.where(
+        degen, 1.0, var_hat
+    )[None]                                            # (n, B, P)
+    rho[0] = 1.0
+
+    n_pairs = n // 2
+    pair = rho[0:2 * n_pairs:2] + rho[1:2 * n_pairs:2]  # (n_pairs, B, P)
+    pos = pair > 0
+    first_neg = np.argmin(pos, axis=0)                 # 0 when all positive
+    k_stop = np.where(pos.all(axis=0), n_pairs, first_neg)
+    pair_mono = np.minimum.accumulate(pair, axis=0)
+    keep = np.arange(n_pairs)[:, None, None] < k_stop[None]
+    # tau = 1 + 2*sum_{t>=1} rho_t = 2*sum_k pair_k - 1  (pair_0 holds rho_0).
+    tau = np.maximum(2.0 * (pair_mono * keep).sum(axis=0) - 1.0, 1.0)
+    total = 2 * n
+    ess = np.where(degen, float(total), np.clip(total / tau, 1.0, total))
+    return rhat, ess
 
 
 def _leapfrog(logdensity_and_grad, theta, r, grad, eps, inv_mass, n_steps):

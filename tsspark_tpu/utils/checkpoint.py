@@ -169,7 +169,11 @@ def load_forecaster(path: str):
     if "mcmc_samples" in z.files:
         from tsspark_tpu.config import McmcConfig
         from tsspark_tpu.models.prophet.model import McmcState
+        from tsspark_tpu.ops import hmc
 
+        # Convergence diagnostics are a pure function of the draws — cheaper
+        # to recompute on load than to version in the checkpoint format.
+        rhat, ess = hmc.split_rhat_ess(z["mcmc_samples"])
         fc.mcmc_state = McmcState(
             samples=jnp.asarray(z["mcmc_samples"]),
             meta=state.meta,
@@ -177,6 +181,8 @@ def load_forecaster(path: str):
             step_size=jnp.asarray(z["mcmc_step_size"]),
             divergences=jnp.asarray(z["mcmc_divergences"]),
             map_state=state,
+            rhat=rhat,
+            ess=ess,
         )
         if ctx.get("mcmc_config"):
             fc.mcmc_config = McmcConfig(**ctx["mcmc_config"])
