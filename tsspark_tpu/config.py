@@ -148,10 +148,17 @@ class SolverConfig:
     history: int = 10  # L-BFGS memory
     tol: float = 2e-9  # relative objective-decrease tolerance (scipy's ftol)
     gtol: float = 1e-6  # gradient-inf-norm convergence tolerance
-    ls_max_steps: int = 20  # backtracking line-search steps
+    ls_max_steps: int = 20  # line-search step-ladder size (one fan eval)
     ls_shrink: float = 0.5
     ls_armijo_c1: float = 1e-4
     init_step: float = 1.0
+    # Float32 noise-floor detection: a series whose accepted relative
+    # objective decrease stays below floor_ulps machine epsilons for
+    # floor_patience consecutive iterations is stationary in this precision
+    # (gtol may be unreachable for it) and is marked converged with
+    # status=STATUS_FLOOR instead of burning the remaining budget.
+    floor_ulps: float = 8.0
+    floor_patience: int = 3
     # Warm start: "ridge" solves the batched masked normal equations in
     # closed form (models/prophet/init.py) so L-BFGS starts next to the
     # optimum; "heuristic" is Prophet's endpoint initializer.

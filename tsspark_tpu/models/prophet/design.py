@@ -189,9 +189,11 @@ def prepare_fit_data(
         cap_s = np.ones((b, t_len))
 
     # Changepoints: observed span maps to exactly [0, 1] in scaled time.
+    # Host numpy (like every other prep quantity): eager jnp ops here would
+    # pay a tiny-XLA-compile + tunnel dispatch on the per-chunk fit path.
     s = trend.uniform_changepoints(
-        jnp.zeros((b,), dtype),
-        jnp.ones((b,), dtype),
+        np.zeros((b,), dtype),
+        np.ones((b,), dtype),
         config.n_changepoints,
         config.changepoint_range,
     )

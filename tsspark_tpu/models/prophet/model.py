@@ -29,7 +29,12 @@ from tsspark_tpu.ops import hmc, lbfgs
 
 
 class FitState(NamedTuple):
-    """Fitted parameters + scaling metadata + solver diagnostics (all (B,...))."""
+    """Fitted parameters + scaling metadata + solver diagnostics (all (B,...)).
+
+    ``status`` is the per-series termination reason (ops/lbfgs.STATUS_*):
+    gtol / ftol / float32-noise-floor / stalled.  ``None`` on synthetic or
+    restored states that never ran the solver.
+    """
 
     theta: jnp.ndarray
     meta: ScalingMeta
@@ -37,6 +42,7 @@ class FitState(NamedTuple):
     grad_norm: jnp.ndarray
     converged: jnp.ndarray
     n_iters: jnp.ndarray
+    status: Optional[jnp.ndarray] = None
 
 
 @functools.partial(jax.jit, static_argnames=("config", "solver_config"))
@@ -177,6 +183,7 @@ class ProphetModel:
         regressors: Optional[jnp.ndarray] = None,
         init: Optional[jnp.ndarray] = None,
         iter_segment: Optional[int] = None,
+        on_segment=None,
     ) -> FitState:
         """Fit every series in the (B, T) batch.
 
@@ -189,12 +196,17 @@ class ProphetModel:
         only the dispatch granularity changes.  Use it to bound
         per-dispatch execution time (fragile tunneled runtimes) or to create
         preemption points for elastic schedulers.
+
+        ``on_segment`` (no-arg callable) fires after every completed segment
+        dispatch — a liveness hook for external watchdogs that cannot tell a
+        long-running solve from a wedged runtime (the bench orchestrator's
+        stall detector is the motivating consumer).
         """
         data, meta = prepare_fit_data(
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
             regressors=regressors,
         )
-        return self._fit_prepared(data, meta, init, iter_segment)
+        return self._fit_prepared(data, meta, init, iter_segment, on_segment)
 
     def _fit_prepared(
         self,
@@ -202,6 +214,7 @@ class ProphetModel:
         meta: ScalingMeta,
         init: Optional[jnp.ndarray],
         iter_segment: Optional[int] = None,
+        on_segment=None,
     ) -> FitState:
         # None -> warm start computed inside the jitted program (init.py).
         theta0 = init
@@ -215,6 +228,8 @@ class ProphetModel:
                 # Block per segment: keeps every dispatch short AND surfaces
                 # a dead runtime at the segment boundary, not downstream.
                 jax.block_until_ready(ls.theta)
+                if on_segment is not None:
+                    on_segment()
                 if bool(ls.converged.all()):
                     break
             res = lbfgs.to_result(ls)
@@ -227,6 +242,7 @@ class ProphetModel:
             grad_norm=res.grad_norm,
             converged=res.converged,
             n_iters=res.n_iters,
+            status=res.status,
         )
 
     def fit_mcmc(

@@ -35,12 +35,16 @@ def fourier_features(
     # Fold t into [0, period) first so the trig arguments keep phase
     # precision even for large absolute day counts.  Host arrays fold in
     # float64 (epoch days ~2e4 quantize to ~5min in f32 — visible phase
-    # error for sub-daily periods); traced/device arrays fold in-graph.
+    # error for sub-daily periods) and stay HOST numpy end-to-end: one eager
+    # jnp op here costs a tiny XLA compile + a tunnel dispatch, and this
+    # runs on the per-chunk critical path of the fit driver.
     if isinstance(t_days, np.ndarray):
-        t_mod = jnp.asarray(np.mod(t_days.astype(np.float64), period),
-                            jnp.float32)
-    else:
-        t_mod = jnp.mod(t_days, period)
+        t_mod = np.mod(t_days.astype(np.float64), period)
+        n = np.arange(1, order + 1, dtype=np.float64)
+        angles = 2.0 * np.pi * t_mod[..., None] * n / period
+        feats = np.stack([np.sin(angles), np.cos(angles)], axis=-1)
+        return feats.reshape(feats.shape[:-2] + (2 * order,)).astype(np.float32)
+    t_mod = jnp.mod(t_days, period)
     n = jnp.arange(1, order + 1, dtype=t_mod.dtype)
     angles = 2.0 * jnp.pi * t_mod[..., None] * n / period
     feats = jnp.stack([jnp.sin(angles), jnp.cos(angles)], axis=-1)
@@ -51,12 +55,14 @@ def seasonal_feature_matrix(
     t_days: jnp.ndarray, seasonalities: Sequence[SeasonalityConfig]
 ) -> jnp.ndarray:
     """Concatenate all seasonality blocks into one (..., T, F_seasonal) matrix."""
+    host = isinstance(t_days, np.ndarray)
     if not seasonalities:
-        return jnp.zeros(t_days.shape + (0,), jnp.float32)
+        zeros = np.zeros if host else jnp.zeros
+        return zeros(t_days.shape + (0,), jnp.float32)
     blocks = [
         fourier_features(t_days, s.period, s.fourier_order) for s in seasonalities
     ]
-    return jnp.concatenate(blocks, axis=-1)
+    return (np if host else jnp).concatenate(blocks, axis=-1)
 
 
 def feature_matrix(

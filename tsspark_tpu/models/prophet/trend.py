@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def changepoint_index(t: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
@@ -150,10 +151,11 @@ def uniform_changepoints(
     Returns:
       (B, n_changepoints) sorted changepoints.
     """
+    xp = np if isinstance(t_first, np.ndarray) else jnp
     if n_changepoints == 0:
-        return jnp.zeros(t_first.shape + (0,), t_first.dtype)
+        return xp.zeros(t_first.shape + (0,), t_first.dtype)
     span = (t_last - t_first) * changepoint_range
     # Fractions in (0, 1]: skip 0 so the first changepoint is strictly after
     # the first observation (a changepoint at t_first is unidentifiable).
-    fracs = jnp.arange(1, n_changepoints + 1, dtype=t_first.dtype) / n_changepoints
+    fracs = xp.arange(1, n_changepoints + 1, dtype=t_first.dtype) / n_changepoints
     return t_first[..., None] + span[..., None] * fracs[None, :]

@@ -17,16 +17,25 @@ Design constraints that shaped this implementation:
     ``history`` (default 10) static steps; each step is a (B,) dot-product
     (``sum over P``) plus an axpy — pure fused VPU work, no MXU needed, no
     per-series divergence.
-  * The line search is a fixed-shrink backtracking Armijo search implemented
-    as a nested bounded ``lax.while_loop``; each trial evaluates the batched
-    objective once for ALL series and accepts per-series (a (B,) mask), so
-    series that accept early simply keep their accepted candidate while
-    others continue shrinking.
-  * Safeguards: non-finite trial losses are treated as rejection (step keeps
-    shrinking); if the line search exhausts its budget for a series, that
-    series falls back to a tiny gradient step; curvature pairs with
+  * The line search is a *batched fan*: the geometric ladder of candidate
+    steps is known upfront, so all K trials (plus a tiny-gradient-step
+    fallback row) are evaluated in ONE objective call on a (K+1, B, P)
+    stack, and each series picks its largest Armijo-accepted step with a
+    gather.  This replaces up to K *sequential* full-batch evaluations per
+    iteration (the round-2 design, where the search ran until ALL series
+    accepted — nearly never early) with a single fused pass whose marginal
+    rows are almost free on a memory-bound objective.  The accepted point
+    per series is mathematically identical to sequential backtracking.
+  * Safeguards: non-finite trial losses are treated as rejection; if no
+    ladder step passes Armijo for a series, it falls back to the tiny
+    gradient step evaluated in the same fan; curvature pairs with
     non-positive ``s.y`` are dropped from the history (their rho is zeroed)
     to keep the inverse-Hessian estimate positive definite.
+  * Convergence distinguishes WHY a series stopped (``status``): gradient
+    tolerance, relative-decrease tolerance, stationarity at the float32
+    noise floor (consecutive iterations whose decrease is below a few ulps
+    of the objective — such series cannot make further progress in f32 and
+    burning more iterations on them is pure waste), or a failed search.
 
 The objective callable must map (B, P) params -> ((B,) losses, (B, P) grads).
 """
@@ -41,6 +50,14 @@ import jax.numpy as jnp
 from tsspark_tpu.config import SolverConfig
 
 
+# Per-series termination reasons (LbfgsState.status / LbfgsResult.status).
+STATUS_RUNNING = 0   # still iterating (or hit the iteration cap while moving)
+STATUS_GTOL = 1      # gradient inf-norm below gtol
+STATUS_FTOL = 2      # relative objective decrease below tol
+STATUS_FLOOR = 3     # stationary at the float32 noise floor (see SolverConfig)
+STATUS_STALLED = 4   # no acceptable step anywhere (ladder + fallback failed)
+
+
 class LbfgsState(NamedTuple):
     theta: jnp.ndarray      # (B, P)
     f: jnp.ndarray          # (B,)
@@ -52,6 +69,8 @@ class LbfgsState(NamedTuple):
     converged: jnp.ndarray  # (B,) bool
     n_iters: jnp.ndarray    # (B,) int32 — iterations each series actually ran
     prev_step: jnp.ndarray  # (B,) last accepted line-search step (seeds the next)
+    floor_count: jnp.ndarray  # (B,) int32 consecutive noise-floor iterations
+    status: jnp.ndarray     # (B,) int32 STATUS_* termination reason
 
 
 class LbfgsResult(NamedTuple):
@@ -60,6 +79,7 @@ class LbfgsResult(NamedTuple):
     grad_norm: jnp.ndarray
     converged: jnp.ndarray
     n_iters: jnp.ndarray
+    status: jnp.ndarray
 
 
 def _dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -128,6 +148,8 @@ def init_state(
         converged=jnp.zeros((b,), bool),
         n_iters=jnp.zeros((b,), jnp.int32),
         prev_step=jnp.full((b,), config.init_step, theta0.dtype),
+        floor_count=jnp.zeros((b,), jnp.int32),
+        status=jnp.zeros((b,), jnp.int32),
     )
 
 
@@ -138,6 +160,7 @@ def to_result(state: LbfgsState) -> LbfgsResult:
         grad_norm=jnp.max(jnp.abs(state.grad), axis=-1),
         converged=state.converged,
         n_iters=state.n_iters,
+        status=state.status,
     )
 
 
@@ -179,56 +202,50 @@ def run_segment(
         direction = jnp.where(bad[:, None], -state.grad, direction)
         dg = jnp.where(bad, -_dot(state.grad, state.grad), dg)
 
-        # --- backtracking Armijo line search, batched -----------------------
-        def ls_cond(carry):
-            step, accepted, _, _, tries = carry
-            return (tries < config.ls_max_steps) & ~jnp.all(
-                accepted | state.converged
-            )
-
-        def ls_body(carry):
-            step, accepted, best_theta, best_f, tries = carry
-            trial = state.theta + step[:, None] * direction
-            f_t = fun_value(trial)  # value only: trials never need the grad
-            ok = (
-                jnp.isfinite(f_t)
-                & (f_t <= state.f + config.ls_armijo_c1 * step * dg)
-                & ~accepted
-            )
-            best_theta = jnp.where(ok[:, None], trial, best_theta)
-            best_f = jnp.where(ok, f_t, best_f)
-            accepted = accepted | ok
-            step = jnp.where(accepted, step, step * config.ls_shrink)
-            return step, accepted, best_theta, best_f, tries + 1
-
+        # --- batched-fan Armijo line search ---------------------------------
+        # The whole geometric step ladder is evaluated in ONE objective call
+        # on a (K+1, B, P) stack (last row = tiny-gradient-step fallback);
+        # each series then gathers its largest accepted step.  Identical
+        # accepted points to sequential backtracking, at the cost of one
+        # fused memory-bound pass instead of up to K+1 sequential ones.
+        k_steps = config.ls_max_steps
         # Seed from the last accepted step (grown 4x, capped at init_step):
         # on ill-conditioned series whose usable step is ~2^-15, restarting
         # every search at 1.0 burns the whole backtracking budget and can
         # accept microscopic steps whose decrease trips the ftol test far
         # from the optimum (false convergence).
         step0 = jnp.minimum(state.prev_step * 4.0, config.init_step)
-        carry = (
-            step0,
-            jnp.zeros((b,), bool),
-            state.theta,
-            state.f,
-            jnp.zeros((), jnp.int32),
-        )
-        step_out, accepted, new_theta, new_f, _ = jax.lax.while_loop(
-            ls_cond, ls_body, carry
-        )
+        shrinks = config.ls_shrink ** jnp.arange(k_steps, dtype=state.f.dtype)
+        ladder = step0[None, :] * shrinks[:, None]  # (K, B)
 
-        # Line-search failure fallback: tiny gradient step (keeps making
-        # progress on pathological curvature instead of freezing).  Guarded
-        # by a scalar cond so the common all-accepted case skips the eval.
         gnorm = jnp.linalg.norm(state.grad, axis=-1)
         tiny = 1e-3 / jnp.maximum(gnorm, 1.0)
         fb_theta = state.theta - tiny[:, None] * state.grad
-        fb_f = jax.lax.cond(
-            jnp.all(accepted | state.converged),
-            lambda: jnp.full_like(state.f, jnp.inf),
-            lambda: fun_value(fb_theta),
+
+        trials = jnp.concatenate(
+            [
+                state.theta[None] + ladder[:, :, None] * direction[None],
+                fb_theta[None],
+            ],
+            axis=0,
+        )  # (K+1, B, P)
+        f_all = jax.vmap(fun_value)(trials)  # (K+1, B)
+        f_trials, fb_f = f_all[:k_steps], f_all[k_steps]
+
+        ok = jnp.isfinite(f_trials) & (
+            f_trials <= state.f[None] + config.ls_armijo_c1 * ladder * dg[None]
+        )  # (K, B)
+        accepted = jnp.any(ok, axis=0)
+        first = jnp.argmax(ok, axis=0)  # first True = largest accepted step
+        bidx = jnp.arange(b)
+        step_out = ladder[first, bidx]
+        new_theta = jnp.where(
+            accepted[:, None], trials[first, bidx], state.theta
         )
+        new_f = jnp.where(accepted, f_trials[first, bidx], state.f)
+
+        # Ladder exhausted: tiny gradient step (keeps making progress on
+        # pathological curvature instead of freezing).
         use_fb = ~accepted & jnp.isfinite(fb_f) & (fb_f < state.f)
         new_theta = jnp.where(use_fb[:, None], fb_theta, new_theta)
         new_f = jnp.where(use_fb, fb_f, new_f)
@@ -255,11 +272,33 @@ def run_segment(
         # --- convergence ----------------------------------------------------
         f_decrease = (state.f - new_f) / jnp.maximum(jnp.abs(state.f), 1.0)
         g_inf = jnp.max(jnp.abs(new_grad), axis=-1)
-        newly = active & (
-            (g_inf < config.gtol)
-            | (moved & (f_decrease < config.tol))
-            | ~moved  # no acceptable step anywhere -> stationary enough
+
+        # Float32 noise floor: a series whose accepted decrease is below a
+        # few ulps of its objective for several consecutive iterations is
+        # stationary *in this precision* — gtol=1e-6 may be unreachable for
+        # it, and burning the remaining iteration budget cannot improve it.
+        eps = jnp.asarray(jnp.finfo(state.f.dtype).eps, state.f.dtype)
+        at_floor = moved & (f_decrease <= config.floor_ulps * eps)
+        floor_count = jnp.where(
+            active,
+            jnp.where(at_floor, state.floor_count + 1, 0),
+            state.floor_count,
         )
+
+        hit_gtol = g_inf < config.gtol
+        hit_ftol = moved & (f_decrease < config.tol)
+        hit_floor = floor_count >= config.floor_patience
+        newly = active & (hit_gtol | hit_ftol | hit_floor | ~moved)
+        status_new = jnp.where(
+            hit_gtol,
+            STATUS_GTOL,
+            jnp.where(
+                hit_ftol,
+                STATUS_FTOL,
+                jnp.where(hit_floor, STATUS_FLOOR, STATUS_STALLED),
+            ),
+        ).astype(jnp.int32)
+        status = jnp.where(active & newly, status_new, state.status)
 
         prev_step = jnp.where(
             accepted & active,
@@ -278,6 +317,8 @@ def run_segment(
             converged=state.converged | newly,
             n_iters=state.n_iters + active.astype(jnp.int32),
             prev_step=prev_step,
+            floor_count=floor_count,
+            status=status,
         )
 
     return jax.lax.while_loop(cond, body, state)

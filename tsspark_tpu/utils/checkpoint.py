@@ -49,6 +49,8 @@ def save_state(
         "converged": state.converged,
         "n_iters": state.n_iters,
     }
+    if state.status is not None:
+        arrays["status"] = state.status
     arrays.update(
         {f"meta_{k}": v for k, v in state.meta._asdict().items()}
     )
@@ -216,6 +218,7 @@ def load_state(
         grad_norm=jnp.asarray(z["grad_norm"]),
         converged=jnp.asarray(z["converged"]),
         n_iters=jnp.asarray(z["n_iters"]),
+        status=jnp.asarray(z["status"]) if "status" in z.files else None,
     )
     ids = sidecar.get("series_ids")
     return state, None if ids is None else np.asarray(ids)
