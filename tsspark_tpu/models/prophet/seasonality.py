@@ -38,17 +38,15 @@ def fourier_features(
     # error for sub-daily periods) and stay HOST numpy end-to-end: one eager
     # jnp op here costs a tiny XLA compile + a tunnel dispatch, and this
     # runs on the per-chunk critical path of the fit driver.
-    if isinstance(t_days, np.ndarray):
-        t_mod = np.mod(t_days.astype(np.float64), period)
-        n = np.arange(1, order + 1, dtype=np.float64)
-        angles = 2.0 * np.pi * t_mod[..., None] * n / period
-        feats = np.stack([np.sin(angles), np.cos(angles)], axis=-1)
-        return feats.reshape(feats.shape[:-2] + (2 * order,)).astype(np.float32)
-    t_mod = jnp.mod(t_days, period)
-    n = jnp.arange(1, order + 1, dtype=t_mod.dtype)
-    angles = 2.0 * jnp.pi * t_mod[..., None] * n / period
-    feats = jnp.stack([jnp.sin(angles), jnp.cos(angles)], axis=-1)
-    return feats.reshape(feats.shape[:-2] + (2 * order,))
+    host = isinstance(t_days, np.ndarray)
+    xp = np if host else jnp
+    t_mod = xp.mod(t_days.astype(np.float64), period) if host \
+        else jnp.mod(t_days, period)
+    n = xp.arange(1, order + 1, dtype=t_mod.dtype)
+    angles = 2.0 * xp.pi * t_mod[..., None] * n / period
+    feats = xp.stack([xp.sin(angles), xp.cos(angles)], axis=-1)
+    feats = feats.reshape(feats.shape[:-2] + (2 * order,))
+    return feats.astype(np.float32) if host else feats
 
 
 def seasonal_feature_matrix(
