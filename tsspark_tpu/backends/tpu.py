@@ -12,6 +12,7 @@ code runs on any JAX backend (tests exercise it on the forced-CPU mesh).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
@@ -50,7 +51,8 @@ class TpuBackend(ForecastBackend):
     name = "tpu"
 
     def __init__(self, *args, chunk_size: int = 8192,
-                 iter_segment: Optional[int] = None, **kwargs):
+                 iter_segment: Optional[int] = None, on_segment=None,
+                 **kwargs):
         """chunk_size bounds series per program; iter_segment bounds solver
         iterations per program.
 
@@ -64,6 +66,7 @@ class TpuBackend(ForecastBackend):
         super().__init__(*args, **kwargs)
         self.chunk_size = chunk_size
         self.iter_segment = iter_segment
+        self.on_segment = on_segment  # liveness hook, fires per dispatch
         self._model = ProphetModel(self.config, self.solver_config)
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
@@ -112,8 +115,49 @@ class TpuBackend(ForecastBackend):
         state = self._model.fit(
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
             init=init, iter_segment=self.iter_segment,
+            on_segment=self.on_segment,
         )
         return _slice_state(state, 0, b)
+
+    def fit_twophase(self, ds, y, mask=None, cap=None, floor=None,
+                     regressors=None, init=None, phase1_iters: int = 12):
+        """Straggler-compacted fit: short lockstep phase, then finish only
+        the unconverged tail.
+
+        The batched solver advances every series in lockstep, so one slow
+        series makes the whole chunk pay full depth — measured on the M5
+        workload, mean iterations to converge is ~3 while <2% of series need
+        more than ``phase1_iters``.  Phase 1 fits everything with a
+        ``phase1_iters`` cap; phase 2 gathers the unconverged series into
+        one small compacted batch and continues only those (warm-started
+        from their phase-1 parameters) at the full ``max_iters`` depth.
+        Device work drops from O(B * max_iters) to
+        O(B * phase1_iters + stragglers * max_iters).
+        """
+        state = self._phase1(phase1_iters).fit(
+            ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
+            init=init,
+        )
+        idx = np.flatnonzero(~np.asarray(state.converged))
+        if idx.size == 0:
+            return state
+        sub = lambda a: None if a is None else np.asarray(a)[idx]
+        state2 = self.fit(
+            ds if np.asarray(ds).ndim == 1 else np.asarray(ds)[idx],
+            np.asarray(y)[idx], mask=sub(mask), cap=sub(cap),
+            floor=sub(floor), regressors=sub(regressors),
+            init=np.asarray(state.theta)[idx],
+        )
+        return patch_state(state, idx, state2)
+
+    def _phase1(self, phase1_iters: int) -> "TpuBackend":
+        return TpuBackend(
+            self.config,
+            dataclasses.replace(self.solver_config, max_iters=phase1_iters),
+            chunk_size=self.chunk_size,
+            iter_segment=self.iter_segment,
+            on_segment=self.on_segment,
+        )
 
     def predict(self, state, ds, cap=None, regressors=None, seed=0,
                 num_samples=None):
@@ -124,6 +168,33 @@ class TpuBackend(ForecastBackend):
 
     def components(self, state, ds, cap=None, regressors=None):
         return self._model.components(state, ds, cap=cap, regressors=regressors)
+
+
+def patch_state(state: FitState, idx: np.ndarray, sub: FitState) -> FitState:
+    """Scatter a compacted follow-up fit back into the full-batch FitState.
+
+    ``sub`` holds results for ``state``'s rows ``idx`` (same data, deeper
+    solve).  Iteration counts accumulate across phases; scaling meta is
+    recomputed deterministically from the same rows, so either copy works.
+    """
+
+    def scatter(full, part, accumulate=False):
+        if full is None or part is None:
+            return full
+        out = np.asarray(full).copy()
+        out[idx] = (out[idx] + np.asarray(part)) if accumulate \
+            else np.asarray(part)
+        return jnp.asarray(out) if isinstance(full, jax.Array) else out
+
+    return FitState(
+        theta=scatter(state.theta, sub.theta),
+        meta=state.meta,
+        loss=scatter(state.loss, sub.loss),
+        grad_norm=scatter(state.grad_norm, sub.grad_norm),
+        converged=scatter(state.converged, sub.converged),
+        n_iters=scatter(state.n_iters, sub.n_iters, accumulate=True),
+        status=scatter(state.status, sub.status),
+    )
 
 
 def _next_pow2(n: int) -> int:
