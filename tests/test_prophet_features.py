@@ -1,0 +1,179 @@
+"""Prophet capability parity: auto-seasonality selection, conditional
+seasonalities, and observed-quantile changepoint placement (round-3 feature
+set; upstream Prophet semantics, TPU-first batched implementation)."""
+
+import numpy as np
+import pandas as pd
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu import Forecaster, ProphetConfig, SeasonalityConfig
+from tsspark_tpu.config import DAILY, WEEKLY, YEARLY, SolverConfig
+from tsspark_tpu.models.prophet import seasonality as seas_mod
+from tsspark_tpu.models.prophet.design import (
+    prepare_fit_data,
+    quantile_changepoints,
+)
+from tsspark_tpu.models.prophet.model import ProphetModel
+
+
+# -- auto-seasonality ---------------------------------------------------------
+
+def test_auto_seasonalities_rule():
+    daily_3y = np.arange(0, 1100.0)
+    assert seas_mod.auto_seasonalities(daily_3y) == (YEARLY, WEEKLY)
+    daily_1m = np.arange(0, 30.0)
+    assert seas_mod.auto_seasonalities(daily_1m) == (WEEKLY,)
+    hourly_3d = np.arange(0, 3.0, 1 / 24)
+    assert seas_mod.auto_seasonalities(hourly_3d) == (DAILY,)
+    hourly_3w = np.arange(0, 21.0, 1 / 24)
+    assert seas_mod.auto_seasonalities(hourly_3w) == (WEEKLY, DAILY)
+    weekly_5y = np.arange(0, 1900.0, 7.0)  # spacing 7d: no weekly component
+    assert seas_mod.auto_seasonalities(weekly_5y) == (YEARLY,)
+    assert seas_mod.auto_seasonalities(np.asarray([0.0])) == ()
+
+
+def test_forecaster_auto_seasonality_resolves_at_fit():
+    rng = np.random.default_rng(0)
+    t = np.arange(800.0)
+    y = 10 + 2 * np.sin(2 * np.pi * t / 7) + rng.normal(0, 0.1, t.size)
+    df = pd.DataFrame({"series_id": "s0", "ds": t, "y": y})
+    fc = Forecaster(
+        ProphetConfig(n_changepoints=5), backend="tpu", auto_seasonality=True
+    )
+    fc.fit(df)
+    # 800 daily points: yearly (span >= 730) + weekly (spacing < 7).
+    assert tuple(s.name for s in fc.config.seasonalities) == (
+        "yearly", "weekly",
+    )
+    out = fc.predict(horizon=7)
+    assert np.isfinite(out["yhat"].to_numpy()).all()
+
+
+# -- conditional seasonalities ------------------------------------------------
+
+def test_conditional_seasonality_gates_component():
+    # Weekly pattern exists ONLY in the "on" regime (first half).  A gated
+    # weekly seasonality must (a) fit it there and (b) contribute exactly
+    # zero where the condition is off.
+    rng = np.random.default_rng(1)
+    n = 400
+    t = np.arange(float(n))
+    on = (t < n // 2).astype(float)
+    y = 5.0 + 0.01 * t + on * 2.0 * np.sin(2 * np.pi * t / 7) \
+        + rng.normal(0, 0.05, n)
+    cfg = ProphetConfig(
+        seasonalities=(
+            SeasonalityConfig("weekly_on", 7.0, 3, condition_name="on"),
+        ),
+        n_changepoints=4,
+    )
+    model = ProphetModel(cfg, SolverConfig(max_iters=200))
+    ds = jnp.asarray(t, jnp.float32)
+    y_j = jnp.asarray(y[None, :], jnp.float32)
+    cond = {"on": on[None, :]}
+    state = model.fit(ds, y_j, conditions=cond)
+    comps = model.components(state, ds, conditions=cond)
+    weekly = np.asarray(comps["weekly_on"])[0]
+    np.testing.assert_allclose(weekly[n // 2:], 0.0, atol=1e-6)
+    assert np.abs(weekly[: n // 2]).max() > 1.0
+    # The fit must actually capture the on-regime pattern.
+    fc = model.predict(state, ds, conditions=cond)
+    resid = np.asarray(fc["yhat"])[0] - y
+    assert np.abs(resid).mean() < 0.15
+
+
+def test_conditional_seasonality_requires_values():
+    cfg = ProphetConfig(
+        seasonalities=(
+            SeasonalityConfig("weekly_on", 7.0, 2, condition_name="on"),
+        ),
+        n_changepoints=2,
+    )
+    model = ProphetModel(cfg)
+    ds = jnp.arange(50, dtype=jnp.float32)
+    y = jnp.ones((1, 50))
+    with pytest.raises(ValueError, match="condition"):
+        model.fit(ds, y)
+
+
+def test_conditional_seasonality_through_forecaster():
+    rng = np.random.default_rng(2)
+    n = 300
+    t = np.arange(float(n))
+    weekend = ((t.astype(int) % 7) >= 5).astype(float)
+    y = 3.0 + weekend * 1.5 * np.sin(2 * np.pi * t / 7) \
+        + rng.normal(0, 0.05, n)
+    df = pd.DataFrame(
+        {"series_id": "s0", "ds": t, "y": y, "is_weekend": weekend}
+    )
+    cfg = ProphetConfig(
+        seasonalities=(
+            SeasonalityConfig("wk_weekend", 7.0, 3,
+                              condition_name="is_weekend"),
+        ),
+        n_changepoints=3,
+    )
+    fc = Forecaster(cfg, backend="tpu").fit(df)
+    # horizon-only predict cannot know future condition values.
+    with pytest.raises(ValueError, match="condition"):
+        fc.predict(horizon=7)
+    fut_t = np.arange(float(n), float(n) + 14)
+    fut = pd.DataFrame({
+        "series_id": "s0", "ds": fut_t,
+        "is_weekend": ((fut_t.astype(int) % 7) >= 5).astype(float),
+    })
+    out = fc.predict(future_df=fut)
+    assert np.isfinite(out["yhat"].to_numpy()).all()
+
+
+# -- observed-quantile changepoints ------------------------------------------
+
+def test_quantile_changepoints_follow_observation_density():
+    # 200 observations in the first 10% of scaled time, 20 in the rest:
+    # quantile placement must concentrate changepoints where the data is.
+    t = np.concatenate([
+        np.linspace(0.0, 0.1, 200), np.linspace(0.1, 1.0, 20),
+    ])[None, :]
+    mask = np.ones_like(t)
+    cps = quantile_changepoints(t, mask, 10, changepoint_range=0.9)
+    assert cps.shape == (1, 10)
+    assert (np.diff(cps[0]) >= 0).all()
+    # ~90% of the observations sit below t=0.1, so most changepoints must.
+    assert (cps[0] < 0.11).sum() >= 7
+    # Uniform placement would put at most 2 of 10 there.
+
+
+def test_quantile_placement_matches_uniform_on_regular_grid():
+    rng = np.random.default_rng(3)
+    n = 300
+    t = np.arange(float(n))
+    y = (4 + 0.02 * t + np.sin(2 * np.pi * t / 7)
+         + rng.normal(0, 0.1, (2, n))).astype(np.float32)
+    base = dict(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=6,
+    )
+    m_u = ProphetModel(ProphetConfig(**base))
+    m_q = ProphetModel(
+        ProphetConfig(changepoint_placement="quantile", **base)
+    )
+    ds = jnp.asarray(t, jnp.float32)
+    st_u = m_u.fit(ds, jnp.asarray(y))
+    st_q = m_q.fit(ds, jnp.asarray(y))
+    # On a regular fully-observed grid the placements coincide up to one
+    # grid step, so the optima must agree closely.
+    np.testing.assert_allclose(
+        np.asarray(st_q.loss), np.asarray(st_u.loss), rtol=5e-3, atol=0.5
+    )
+    # And prediction must round-trip the quantile grid through ScalingMeta.
+    fc = m_q.predict(st_q, ds)
+    assert np.isfinite(np.asarray(fc["yhat"])).all()
+
+
+def test_quantile_changepoints_respect_mask():
+    # Observations only in the middle third; changepoints must live there.
+    t = np.linspace(0.0, 1.0, 300)[None, :]
+    mask = ((t > 0.33) & (t < 0.67)).astype(np.float64)
+    cps = quantile_changepoints(t, mask, 5, changepoint_range=1.0)
+    assert (cps > 0.32).all() and (cps < 0.68).all()

@@ -40,11 +40,11 @@ class CpuBackend(ForecastBackend):
         self._vag = vag
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
-            init=None):
+            init=None, conditions=None):
         with jax.default_device(self._cpu):
             data, meta = prepare_fit_data(
                 ds, y, self.config, mask=mask, cap=cap, floor=floor,
-                regressors=regressors,
+                regressors=regressors, conditions=conditions,
             )
             # Same warm-start policy as the TPU path (SolverConfig.init),
             # so parity runs compare solver behavior, not starting points.
@@ -104,10 +104,11 @@ class CpuBackend(ForecastBackend):
             )
 
     def predict(self, state, ds, cap=None, regressors=None, seed=0,
-                num_samples=None):
+                num_samples=None, conditions=None):
         with jax.default_device(self._cpu):
             data = predict_mod.prepare_predict_data(
-                ds, state.meta, self.config, cap=cap, regressors=regressors
+                ds, state.meta, self.config, cap=cap, regressors=regressors,
+                conditions=conditions,
             )
             return predict_mod.forecast(
                 state.theta, data, state.meta, self.config,

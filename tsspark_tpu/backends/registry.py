@@ -35,15 +35,16 @@ class ForecastBackend(abc.ABC):
 
     @abc.abstractmethod
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
-            init=None):
+            init=None, conditions=None):
         """Fit a (B, T) batch; returns a FitState."""
 
     @abc.abstractmethod
     def predict(self, state, ds, cap=None, regressors=None, seed=0,
-                num_samples=None):
+                num_samples=None, conditions=None):
         """Forecast a fitted state on a time grid; returns dict of arrays."""
 
-    def components(self, state, ds, cap=None, regressors=None):
+    def components(self, state, ds, cap=None, regressors=None,
+                   conditions=None):
         """Per-block component arrays for a fitted state.
 
         Decomposition is pure model math on the fitted parameters — identical
@@ -53,7 +54,7 @@ class ForecastBackend(abc.ABC):
         from tsspark_tpu.models.prophet.model import ProphetModel
 
         return ProphetModel(self.config, self.solver_config).components(
-            state, ds, cap=cap, regressors=regressors
+            state, ds, cap=cap, regressors=regressors, conditions=conditions
         )
 
 

@@ -70,28 +70,34 @@ class TpuBackend(ForecastBackend):
         self._model = ProphetModel(self.config, self.solver_config)
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
-            init=None):
+            init=None, conditions=None):
         y = jnp.asarray(y)
         ds = jnp.asarray(ds)
         b = y.shape[0]
         c = min(self.chunk_size, _next_pow2(b))
         if b <= c:
-            return self._fit_padded(ds, y, mask, cap, floor, regressors, init, c)
+            return self._fit_padded(
+                ds, y, mask, cap, floor, regressors, init, conditions, c
+            )
 
         states = []
         for lo in range(0, b, c):
             hi = min(lo + c, b)
             sl = lambda a: None if a is None else a[lo:hi]
+            slc = lambda d: None if d is None else {
+                k: v[lo:hi] for k, v in d.items()
+            }
             states.append(
                 self._fit_padded(
                     ds if ds.ndim == 1 else ds[lo:hi],
                     y[lo:hi], sl(mask), sl(cap), sl(floor), sl(regressors),
-                    sl(init), c,
+                    sl(init), slc(conditions), c,
                 )
             )
         return _concat_states(states)
 
-    def _fit_padded(self, ds, y, mask, cap, floor, regressors, init, c):
+    def _fit_padded(self, ds, y, mask, cap, floor, regressors, init,
+                    conditions, c):
         b = y.shape[0]
         if b < c:
             if ds.ndim == 2:
@@ -112,15 +118,21 @@ class TpuBackend(ForecastBackend):
             floor = _pad_batch(floor, c) if floor is not None else None
             regressors = _pad_batch(regressors, c) if regressors is not None else None
             init = _pad_batch(init, c) if init is not None else None
+            if conditions is not None:
+                conditions = {
+                    k: _pad_batch(jnp.asarray(v), c)
+                    for k, v in conditions.items()
+                }
         state = self._model.fit(
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
             init=init, iter_segment=self.iter_segment,
-            on_segment=self.on_segment,
+            on_segment=self.on_segment, conditions=conditions,
         )
         return _slice_state(state, 0, b)
 
     def fit_twophase(self, ds, y, mask=None, cap=None, floor=None,
-                     regressors=None, init=None, phase1_iters: int = 12):
+                     regressors=None, init=None, conditions=None,
+                     phase1_iters: int = 12):
         """Straggler-compacted fit: short lockstep phase, then finish only
         the unconverged tail.
 
@@ -136,7 +148,7 @@ class TpuBackend(ForecastBackend):
         """
         state = self._phase1(phase1_iters).fit(
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
-            init=init,
+            init=init, conditions=conditions,
         )
         idx = np.flatnonzero(~np.asarray(state.converged))
         if idx.size == 0:
@@ -147,6 +159,9 @@ class TpuBackend(ForecastBackend):
             np.asarray(y)[idx], mask=sub(mask), cap=sub(cap),
             floor=sub(floor), regressors=sub(regressors),
             init=np.asarray(state.theta)[idx],
+            conditions=None if conditions is None else {
+                k: np.asarray(v)[idx] for k, v in conditions.items()
+            },
         )
         return patch_state(state, idx, state2)
 
@@ -160,14 +175,17 @@ class TpuBackend(ForecastBackend):
         )
 
     def predict(self, state, ds, cap=None, regressors=None, seed=0,
-                num_samples=None):
+                num_samples=None, conditions=None):
         return self._model.predict(
             state, ds, cap=cap, regressors=regressors, seed=seed,
-            num_samples=num_samples,
+            num_samples=num_samples, conditions=conditions,
         )
 
-    def components(self, state, ds, cap=None, regressors=None):
-        return self._model.components(state, ds, cap=cap, regressors=regressors)
+    def components(self, state, ds, cap=None, regressors=None,
+                   conditions=None):
+        return self._model.components(
+            state, ds, cap=cap, regressors=regressors, conditions=conditions
+        )
 
 
 def patch_state(state: FitState, idx: np.ndarray, sub: FitState) -> FitState:

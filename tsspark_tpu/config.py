@@ -33,6 +33,12 @@ class SeasonalityConfig:
     fourier_order: int
     prior_scale: float = 10.0
     mode: str = "additive"  # "additive" | "multiplicative"
+    # Conditional seasonality (Prophet's condition_name): the block's feature
+    # columns are zeroed on rows where the named boolean condition is False,
+    # so the component only acts (and is only fit) where the condition holds
+    # (e.g. "on_season", "is_weekend").  Condition values are per-(series,
+    # timestamp) data supplied at fit/predict time.
+    condition_name: Optional[str] = None
 
     def __post_init__(self):
         if self.fourier_order < 1:
@@ -79,6 +85,10 @@ class ProphetConfig:
     n_changepoints: int = 25
     changepoint_range: float = 0.8
     changepoint_prior_scale: float = 0.05
+    # "uniform": even grid over the observed span (identical to quantiles on
+    # regular grids, zero gathers).  "quantile": observed-timestamp order
+    # statistics per series (Prophet's placement) — use for irregular grids.
+    changepoint_placement: str = "uniform"
     seasonalities: Tuple[SeasonalityConfig, ...] = (YEARLY, WEEKLY)
     regressors: Tuple[RegressorConfig, ...] = ()
     seasonality_mode: str = "additive"  # default mode for seasonalities
@@ -92,6 +102,11 @@ class ProphetConfig:
     def __post_init__(self):
         if self.growth not in ("linear", "logistic", "flat"):
             raise ValueError(f"growth must be linear|logistic|flat, got {self.growth}")
+        if self.changepoint_placement not in ("uniform", "quantile"):
+            raise ValueError(
+                "changepoint_placement must be uniform|quantile, "
+                f"got {self.changepoint_placement}"
+            )
         if not 0.0 < self.changepoint_range <= 1.0:
             raise ValueError("changepoint_range must be in (0, 1]")
         if self.n_changepoints < 0:
@@ -103,6 +118,16 @@ class ProphetConfig:
             raise ValueError(f"duplicate seasonality/regressor names: {names}")
 
     # ---- static shape helpers -------------------------------------------------
+
+    @property
+    def condition_names(self) -> Tuple[str, ...]:
+        """Unique condition names used by conditional seasonalities, in
+        first-appearance order."""
+        seen = []
+        for s in self.seasonalities:
+            if s.condition_name and s.condition_name not in seen:
+                seen.append(s.condition_name)
+        return tuple(seen)
 
     @property
     def num_seasonal_features(self) -> int:

@@ -36,9 +36,29 @@ from tsspark_tpu.data import datasets
 from tsspark_tpu.eval import metrics
 
 
+def _config3():
+    """Eval config 3 (M5 retail: holiday regressors + external features)."""
+    return (
+        ProphetConfig(
+            seasonalities=(
+                SeasonalityConfig("yearly", 365.25, 8),
+                SeasonalityConfig("weekly", 7.0, 3),
+            ),
+            regressors=(
+                RegressorConfig("holiday", standardize=False),
+                RegressorConfig("price"),
+                RegressorConfig("promo", standardize=False),
+            ),
+            n_changepoints=25,
+        ),
+        SolverConfig(max_iters=120),
+    )
+
+
 def _case_configs(scale: float):
     """The four fit configs (5 is streaming; its parity is covered by the
     warm-start tests) with datasets sized for a tractable scipy oracle."""
+    cfg3, solver3 = _config3()
     return {
         "config1_peyton": (
             datasets.peyton_manning_like(n_days=max(400, int(2905 * scale))),
@@ -64,19 +84,8 @@ def _case_configs(scale: float):
         ),
         "config3_m5": (
             datasets.m5_like(n_series=max(16, int(30490 * scale))),
-            ProphetConfig(
-                seasonalities=(
-                    SeasonalityConfig("yearly", 365.25, 8),
-                    SeasonalityConfig("weekly", 7.0, 3),
-                ),
-                regressors=(
-                    RegressorConfig("holiday", standardize=False),
-                    RegressorConfig("price"),
-                    RegressorConfig("promo", standardize=False),
-                ),
-                n_changepoints=25,
-            ),
-            SolverConfig(max_iters=120),
+            cfg3,
+            solver3,
         ),
         "config4_wiki_logistic": (
             datasets.wiki_logistic_like(n_series=max(4, int(8 * scale * 8))),
@@ -126,6 +135,16 @@ def _smape_per_series(cfg, solver, batch, backend: str, holdout_frac=0.1):
     )
 
 
+def _delta_dist(deltas: np.ndarray) -> Dict:
+    """Per-series |delta sMAPE| distribution (the parity gate statistic)."""
+    a = np.abs(deltas)
+    return {
+        "p50": round(float(np.percentile(a, 50)), 4),
+        "p95": round(float(np.percentile(a, 95)), 4),
+        "max": round(float(a.max()), 4),
+    }
+
+
 def run_parity(scale: float = 0.01) -> Dict:
     out = {}
     for name, (batch, cfg, solver) in _case_configs(scale).items():
@@ -143,10 +162,50 @@ def run_parity(scale: float = 0.01) -> Dict:
             "delta_holdout_max_abs": round(
                 float(np.abs(ho_tpu - ho_cpu).max()), 4
             ),
+            "delta_holdout_dist": _delta_dist(ho_tpu - ho_cpu),
+            "delta_train_dist": _delta_dist(tr_tpu - tr_cpu),
             "fit_seconds_cpu": round(s_cpu, 2),
             "fit_seconds_tpu": round(s_tpu, 2),
         }
     return out
+
+
+def run_config3_at_scale(
+    n_series: int = 30490, oracle_n: int = 512, seed: int = 0
+) -> Dict:
+    """Bench-scale parity for eval config 3: the batched solver fits the FULL
+    series batch; the scipy oracle (the cost bound — a per-series Python
+    loop) runs on a random subsample, and the per-series holdout |delta
+    sMAPE| distribution over that subsample is the gate statistic.
+
+    This answers round-2 weakness #7: small-scale parity audits cannot see
+    distribution tails that only appear at bench scale.
+    """
+    cfg, solver = _config3()
+    batch = datasets.m5_like(n_series=n_series)
+    tr_tpu, ho_tpu, s_tpu = _smape_per_series(cfg, solver, batch, "tpu")
+    rng = np.random.default_rng(seed)
+    idx = np.sort(rng.choice(n_series, size=min(oracle_n, n_series),
+                             replace=False))
+    sub = batch._replace(
+        y=batch.y[idx], mask=batch.mask[idx],
+        series_ids=batch.series_ids[idx],
+        cap=None if batch.cap is None else batch.cap[idx],
+        regressors=None if batch.regressors is None
+        else batch.regressors[idx],
+    )
+    tr_cpu, ho_cpu, s_cpu = _smape_per_series(cfg, solver, sub, "cpu")
+    return {
+        "n_series_tpu": n_series,
+        "n_series_oracle": int(idx.size),
+        "smape_holdout_tpu_full": round(float(ho_tpu.mean()), 4),
+        "smape_holdout_tpu_sub": round(float(ho_tpu[idx].mean()), 4),
+        "smape_holdout_cpu_sub": round(float(ho_cpu.mean()), 4),
+        "delta_holdout_dist": _delta_dist(ho_tpu[idx] - ho_cpu),
+        "delta_train_dist": _delta_dist(tr_tpu[idx] - tr_cpu),
+        "fit_seconds_tpu_full": round(s_tpu, 2),
+        "fit_seconds_cpu_sub": round(s_cpu, 2),
+    }
 
 
 def main():
@@ -156,8 +215,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=0.01)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--config3-full", action="store_true",
+                    help="additionally run the bench-scale config-3 parity "
+                         "(full TPU batch vs oracle subsample)")
+    ap.add_argument("--oracle-n", type=int, default=512)
     args = ap.parse_args()
     result = {"scale": args.scale, "configs": run_parity(args.scale)}
+    if args.config3_full:
+        result["config3_bench_scale"] = run_config3_at_scale(
+            oracle_n=args.oracle_n
+        )
     text = json.dumps(result, indent=2)
     print(text)
     if args.out:

@@ -125,6 +125,7 @@ class Forecaster:
         holidays: Sequence[holidays_mod.Holiday] = (),
         mcmc_samples: int = 0,
         mcmc_config: Optional[McmcConfig] = None,
+        auto_seasonality: bool = False,
         **backend_kwargs,
     ):
         """``mcmc_samples > 0`` switches fitting to the full-posterior HMC
@@ -140,6 +141,12 @@ class Forecaster:
         if self.holidays:
             config = holidays_mod.add_holidays(config, self.holidays)
         self.config = config
+        # auto_seasonality defers the seasonality choice to fit time, where
+        # Prophet's span/frequency rule is applied to the observed calendar
+        # (seasonality.auto_seasonalities) and the backend is rebuilt with
+        # the resolved config.  Explicit `seasonalities` are then ignored.
+        self.auto_seasonality = auto_seasonality
+        self._backend_ctor = (backend, solver_config, dict(backend_kwargs))
         self.backend: ForecastBackend = get_backend(
             backend, config, solver_config, **backend_kwargs
         )
@@ -208,21 +215,35 @@ class Forecaster:
     def fit(self, df: pd.DataFrame, init: Optional[jnp.ndarray] = None
             ) -> "Forecaster":
         self._was_datetime = not np.issubdtype(df[self.ds_col].dtype, np.number)
+        cond_names = self.config.condition_names
         batch = pivot_long(
             df, self.id_col, self.ds_col, self.y_col,
-            self.cap_col, self.floor_col, self.regressor_cols,
+            self.cap_col, self.floor_col,
+            tuple(self.regressor_cols) + cond_names,
         )
         self.series_ids = batch.series_ids
         self._train_ds = batch.ds
         diffs = np.diff(batch.ds)
         self._freq_days = float(np.median(diffs)) if len(diffs) else 1.0
+        if self.auto_seasonality:
+            from tsspark_tpu.models.prophet import seasonality as seas_mod
+            import dataclasses as _dc
+
+            self.config = _dc.replace(
+                self.config,
+                seasonalities=seas_mod.auto_seasonalities(batch.ds),
+            )
+            name, solver, kwargs = self._backend_ctor
+            self.backend = get_backend(name, self.config, solver, **kwargs)
+        reg, conditions = self._split_conditions(batch.regressors, cond_names)
         reg = self._combined_regressors(
-            batch.ds, batch.regressors, len(batch.series_ids)
+            batch.ds, reg, len(batch.series_ids)
         )
         fit_kw = dict(
             cap=None if batch.cap is None else jnp.asarray(np.nan_to_num(batch.cap)),
             floor=None if batch.floor is None else jnp.asarray(batch.floor),
             regressors=None if reg is None else jnp.asarray(reg),
+            conditions=conditions,
         )
         if self.mcmc_config is not None:
             # Full-posterior path: backend-independent model math (MAP init
@@ -239,6 +260,18 @@ class Forecaster:
                 **fit_kw,
             )
         return self
+
+    def _split_conditions(self, reg, cond_names):
+        """Separate pivoted condition columns (appended after the user's
+        regressor columns) back into the conditions dict."""
+        if not cond_names:
+            return reg, None
+        n_r = len(self.regressor_cols)
+        conditions = {
+            c: reg[:, :, n_r + i] for i, c in enumerate(cond_names)
+        }
+        reg = reg[:, :, :n_r] if n_r else None
+        return reg, conditions
 
     # -- predict ---------------------------------------------------------------
 
@@ -289,7 +322,7 @@ class Forecaster:
         if self.state is None:
             raise RuntimeError("fit before predict")
         if future_df is not None:
-            grid, cap, reg = self._align_future(future_df)
+            grid, cap, reg, conditions = self._align_future(future_df)
         else:
             if horizon is None:
                 raise ValueError("give horizon or future_df")
@@ -298,9 +331,15 @@ class Forecaster:
                     "models with external regressors need future_df with "
                     "future regressor values"
                 )
+            if self.config.condition_names:
+                raise ValueError(
+                    "models with conditional seasonalities need future_df "
+                    "with future condition values"
+                )
             grid = self.make_future_grid(horizon, include_history)
             cap = None
             reg = None
+            conditions = None
             if self.cap_col is not None:
                 raise ValueError("logistic models need future_df with cap")
 
@@ -312,21 +351,23 @@ class Forecaster:
             fc = model.predict_mcmc(
                 self.mcmc_state, jnp.asarray(grid), cap=cap_j,
                 regressors=reg_j, seed=seed, max_draws=num_samples,
+                conditions=conditions,
             )
         else:
             fc = self.backend.predict(
                 self.state, jnp.asarray(grid), cap=cap_j, regressors=reg_j,
-                seed=seed, num_samples=num_samples,
+                seed=seed, num_samples=num_samples, conditions=conditions,
             )
         return self._to_long(grid, fc)
 
     def _align_future(self, future_df: pd.DataFrame):
         """Pivot a future frame and align its series order with training."""
+        cond_names = self.config.condition_names
         batch = pivot_long(
             future_df, self.id_col, self.ds_col,
             y_col=self.ds_col,  # y unused at predict; reuse ds column
             cap_col=self.cap_col, floor_col=self.floor_col,
-            regressor_cols=self.regressor_cols,
+            regressor_cols=tuple(self.regressor_cols) + cond_names,
         )
         order = {s: i for i, s in enumerate(batch.series_ids)}
         missing = [s for s in self.series_ids if s not in order]
@@ -339,7 +380,8 @@ class Forecaster:
         perm = np.asarray([order[s] for s in self.series_ids])
         cap = None if batch.cap is None else batch.cap[perm]
         reg = None if batch.regressors is None else batch.regressors[perm]
-        return batch.ds, cap, reg
+        reg, conditions = self._split_conditions(reg, cond_names)
+        return batch.ds, cap, reg, conditions
 
     def components(
         self,
@@ -356,12 +398,13 @@ class Forecaster:
         if self.state is None:
             raise RuntimeError("fit before components")
         if future_df is not None:
-            grid, cap, reg = self._align_future(future_df)
+            grid, cap, reg, conditions = self._align_future(future_df)
         else:
-            if self.regressor_cols or self.cap_col:
+            if self.regressor_cols or self.cap_col or \
+                    self.config.condition_names:
                 raise ValueError(
-                    "models with regressors or caps need future_df for "
-                    "components"
+                    "models with regressors, caps, or conditional "
+                    "seasonalities need future_df for components"
                 )
             grid = self.make_future_grid(
                 horizon or 0, include_history=include_history
@@ -371,12 +414,13 @@ class Forecaster:
                     "components with horizon=0 and include_history=False "
                     "selects no timestamps"
                 )
-            cap = reg = None
+            cap = reg = conditions = None
         reg = self._combined_regressors(grid, reg, len(self.series_ids))
         comps = self.backend.components(
             self.state, jnp.asarray(grid),
             cap=None if cap is None else jnp.asarray(np.nan_to_num(cap)),
             regressors=None if reg is None else jnp.asarray(reg),
+            conditions=conditions,
         )
         ds_out = _days_to_ts(grid) if self._was_datetime else grid
         return ds_out, {k: np.asarray(v) for k, v in comps.items()}

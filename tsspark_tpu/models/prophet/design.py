@@ -41,6 +41,7 @@ class ScalingMeta(NamedTuple):
     ds_span: np.ndarray        # (B,) observed span in days (>= 1 step)
     reg_mean: np.ndarray       # (B, R) regressor standardization mean
     reg_std: np.ndarray        # (B, R) regressor standardization std
+    changepoints: np.ndarray   # (B, n_cp) changepoint locations, scaled time
 
 
 class FitData(NamedTuple):
@@ -120,6 +121,7 @@ def prepare_fit_data(
     cap: Optional[jnp.ndarray] = None,
     floor: Optional[jnp.ndarray] = None,
     regressors: Optional[jnp.ndarray] = None,
+    conditions=None,
     dtype: jnp.dtype = jnp.float32,
 ) -> Tuple[FitData, ScalingMeta]:
     """Scale, mask, and assemble a padded batch for fitting.
@@ -131,6 +133,8 @@ def prepare_fit_data(
       cap: (B, T) capacities, required for logistic growth (data units).
       floor: (B,) or (B, T) logistic floor, defaults to 0.
       regressors: (B, T, R) raw external regressor values.
+      conditions: dict condition_name -> (B, T) truthy values, required when
+        any seasonality has a condition_name (seasonality.apply_conditions).
 
     Returns:
       (FitData, ScalingMeta).
@@ -188,21 +192,32 @@ def prepare_fit_data(
     else:
         cap_s = np.ones((b, t_len))
 
-    # Changepoints: observed span maps to exactly [0, 1] in scaled time.
+    # Changepoints in scaled time (the observed span maps to exactly [0, 1]).
     # Host numpy (like every other prep quantity): eager jnp ops here would
     # pay a tiny-XLA-compile + tunnel dispatch on the per-chunk fit path.
-    s = trend.uniform_changepoints(
-        np.zeros((b,), dtype),
-        np.ones((b,), dtype),
-        config.n_changepoints,
-        config.changepoint_range,
-    )
+    # The chosen grid is recorded in ScalingMeta so prediction, warm-start
+    # transfer, and checkpoint restore all reuse the FIT-time locations.
+    if config.changepoint_placement == "quantile":
+        s = quantile_changepoints(
+            t, mask_np, config.n_changepoints, config.changepoint_range
+        ).astype(dtype)
+    else:
+        s = trend.uniform_changepoints(
+            np.zeros((b,), dtype),
+            np.ones((b,), dtype),
+            config.n_changepoints,
+            config.changepoint_range,
+        )
 
     # Seasonal features from absolute time; shared grid -> shared matrix.
     # (f64 host input: the period fold inside keeps full phase precision.)
     x_season = seasonality.seasonal_feature_matrix(
         ds_np if shared_grid else ds_b, config.seasonalities
     ).astype(dtype)
+    # Conditional blocks force a per-series matrix (conditions are data).
+    x_season = seasonality.apply_conditions(
+        x_season, config.seasonalities, conditions, b
+    )
 
     # External regressors: per-series standardization over observed window.
     r = config.num_regressors
@@ -252,5 +267,56 @@ def prepare_fit_data(
         ds_span=ds_span,
         reg_mean=mean_eff,
         reg_std=std_eff,
+        changepoints=np.asarray(s, np.float64),
     )
     return data, meta
+
+
+def quantile_changepoints(
+    t: np.ndarray,
+    mask: np.ndarray,
+    n_changepoints: int,
+    changepoint_range: float,
+) -> np.ndarray:
+    """Per-series changepoints at observed-timestamp quantiles (host numpy).
+
+    Mirrors public Prophet's placement: the first ``changepoint_range``
+    fraction of each series' OBSERVED rows, with changepoints at evenly
+    spaced order statistics of those timestamps.  On a regular grid this
+    coincides with the uniform grid; on irregular grids (bursty sampling,
+    gaps) it puts trend flexibility where the data actually is.
+
+    Args:
+      t: (B, T) scaled times; mask: (B, T) 1.0 where observed.
+    Returns:
+      (B, n_changepoints) sorted changepoint locations in scaled time.
+    """
+    b, t_len = t.shape
+    if n_changepoints == 0:
+        return np.zeros((b, 0), t.dtype)
+    # Observed times sorted to the front; padding/missing rows go to +inf.
+    sorted_t = np.sort(np.where(mask > 0, t, np.inf), axis=1)
+    n_obs = (mask > 0).sum(axis=1)
+    hist = np.floor(n_obs * changepoint_range).astype(np.int64)
+    # Order-statistic indexes j/n_cp of the first `hist` observations,
+    # skipping index 0 (a changepoint at the first observation is
+    # unidentifiable) — Prophet's np.linspace(0, hist-1, n_cp+1)[1:].
+    fracs = np.arange(1, n_changepoints + 1, dtype=np.float64) / n_changepoints
+    idx = np.round(np.maximum(hist - 1, 0)[:, None] * fracs[None, :]).astype(
+        np.int64
+    )
+    q = np.take_along_axis(sorted_t, np.minimum(idx, t_len - 1), axis=1)
+    # Degenerate series — fully masked (q non-finite) or too few observed
+    # rows to spread a grid over (hist < 2, which would stack every
+    # changepoint on one timestamp and make all delta columns colinear) —
+    # fall back to the uniform grid.  Ties between neighboring changepoints
+    # on merely sparse series are retained (Prophet shrinks n_changepoints
+    # instead, but per-series feature counts would break the batched static
+    # shapes; coincident changepoints are mathematically benign — their
+    # deltas share one location under the same Laplace prior).
+    uniform = trend.uniform_changepoints(
+        np.zeros((b,), t.dtype), np.ones((b,), t.dtype),
+        n_changepoints, changepoint_range,
+    )
+    bad = (hist < 2)[:, None] | ~np.isfinite(q)
+    return np.where(bad, uniform, q)

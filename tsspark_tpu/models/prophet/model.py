@@ -184,6 +184,7 @@ class ProphetModel:
         init: Optional[jnp.ndarray] = None,
         iter_segment: Optional[int] = None,
         on_segment=None,
+        conditions=None,
     ) -> FitState:
         """Fit every series in the (B, T) batch.
 
@@ -204,7 +205,7 @@ class ProphetModel:
         """
         data, meta = prepare_fit_data(
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
-            regressors=regressors,
+            regressors=regressors, conditions=conditions,
         )
         return self._fit_prepared(data, meta, init, iter_segment, on_segment)
 
@@ -256,6 +257,7 @@ class ProphetModel:
         mcmc_config: McmcConfig = McmcConfig(),
         seed: int = 0,
         init: Optional[jnp.ndarray] = None,
+        conditions=None,
     ) -> McmcState:
         """Full-posterior fit: MAP solve, then one HMC chain per series.
 
@@ -265,7 +267,7 @@ class ProphetModel:
         """
         data, meta = prepare_fit_data(
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
-            regressors=regressors,
+            regressors=regressors, conditions=conditions,
         )
         map_state = self._fit_prepared(data, meta, init)
         res = mcmc_core(
@@ -294,10 +296,12 @@ class ProphetModel:
         regressors: Optional[jnp.ndarray] = None,
         seed: int = 0,
         num_samples: Optional[int] = None,
+        conditions=None,
     ) -> Dict[str, jnp.ndarray]:
         """Forecast on an arbitrary time grid (in-sample and/or future)."""
         data = predict_mod.prepare_predict_data(
-            ds, state.meta, self.config, cap=cap, regressors=regressors
+            ds, state.meta, self.config, cap=cap, regressors=regressors,
+            conditions=conditions,
         )
         key = jax.random.PRNGKey(seed)
         return predict_mod.forecast(
@@ -313,10 +317,12 @@ class ProphetModel:
         regressors: Optional[jnp.ndarray] = None,
         seed: int = 0,
         max_draws: Optional[int] = None,
+        conditions=None,
     ) -> Dict[str, jnp.ndarray]:
         """Posterior-predictive forecast from the MCMC draws."""
         data = predict_mod.prepare_predict_data(
-            ds, state.meta, self.config, cap=cap, regressors=regressors
+            ds, state.meta, self.config, cap=cap, regressors=regressors,
+            conditions=conditions,
         )
         samples = state.samples
         if max_draws is not None and samples.shape[0] > max_draws:
@@ -326,9 +332,11 @@ class ProphetModel:
             samples, data, state.meta, self.config, jax.random.PRNGKey(seed)
         )
 
-    def components(self, state: FitState, ds, cap=None, regressors=None):
+    def components(self, state: FitState, ds, cap=None, regressors=None,
+                   conditions=None):
         data = predict_mod.prepare_predict_data(
-            ds, state.meta, self.config, cap=cap, regressors=regressors
+            ds, state.meta, self.config, cap=cap, regressors=regressors,
+            conditions=conditions,
         )
         return predict_mod.component_breakdown(
             state.theta, data, state.meta, self.config

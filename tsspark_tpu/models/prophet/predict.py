@@ -36,6 +36,7 @@ def prepare_predict_data(
     config: ProphetConfig,
     cap: Optional[jnp.ndarray] = None,
     regressors: Optional[jnp.ndarray] = None,
+    conditions=None,
     dtype: jnp.dtype = jnp.float32,
 ) -> FitData:
     """Assemble design tensors for a (future or in-sample) time grid.
@@ -72,6 +73,9 @@ def prepare_predict_data(
     x_season = seasonality.seasonal_feature_matrix(
         ds_np if shared_grid else ds_b, config.seasonalities
     ).astype(dtype)
+    x_season = seasonality.apply_conditions(
+        x_season, config.seasonalities, conditions, b
+    )
 
     r = config.num_regressors
     if r:
@@ -86,12 +90,10 @@ def prepare_predict_data(
     else:
         x_reg = jnp.zeros((b, t_len, 0), dtype)
 
-    s = trend.uniform_changepoints(
-        jnp.zeros((b,), dtype),
-        jnp.ones((b,), dtype),
-        config.n_changepoints,
-        config.changepoint_range,
-    )
+    # Fit-time changepoint locations from meta: prediction must evaluate the
+    # trend on the SAME grid the parameters were fit against (quantile
+    # placement makes the grid data-dependent; uniform round-trips too).
+    s = jnp.asarray(meta.changepoints, dtype)
     return FitData(
         t=t,
         y=jnp.zeros((b, t_len), dtype),

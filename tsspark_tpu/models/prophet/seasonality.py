@@ -10,7 +10,7 @@ through Spark executors; see BASELINE.json:5).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Sequence, Tuple
 
 import numpy as np
 import jax.numpy as jnp
@@ -61,6 +61,94 @@ def seasonal_feature_matrix(
         fourier_features(t_days, s.period, s.fourier_order) for s in seasonalities
     ]
     return (np if host else jnp).concatenate(blocks, axis=-1)
+
+
+def apply_conditions(
+    x_season,
+    seasonalities: Sequence[SeasonalityConfig],
+    conditions,
+    batch: int,
+):
+    """Gate conditional seasonality blocks by their per-row conditions.
+
+    Args:
+      x_season: (T, Fs) shared or (B, T, Fs) per-series feature matrix
+        (numpy on the host prep path, jnp on traced paths).
+      conditions: dict mapping condition_name -> (B, T) truthy array.
+      batch: B (needed to broadcast a shared matrix per-series).
+
+    Returns:
+      (B, T, Fs): gated blocks are zero where their condition is False, so
+      the gated component contributes nothing there and its betas are fit
+      only against rows where the condition holds (Prophet's
+      ``add_seasonality(..., condition_name=...)`` semantics).
+    """
+    cond_needed = [s.condition_name for s in seasonalities if s.condition_name]
+    if not cond_needed:
+        return x_season
+    conditions = conditions or {}
+    missing = [c for c in cond_needed if c not in conditions]
+    if missing:
+        raise ValueError(
+            f"conditional seasonalities need condition values for {missing}"
+        )
+    host = isinstance(x_season, np.ndarray)
+    xp = np if host else jnp
+    t_len = x_season.shape[-2]
+    if x_season.ndim == 2:
+        x_season = xp.broadcast_to(
+            x_season, (batch, t_len, x_season.shape[-1])
+        )
+    gated = []
+    offset = 0
+    for s in seasonalities:
+        block = x_season[..., offset : offset + s.num_features]
+        if s.condition_name:
+            c = xp.asarray(conditions[s.condition_name])
+            if c.shape != (batch, t_len):
+                raise ValueError(
+                    f"condition {s.condition_name!r} has shape {c.shape}, "
+                    f"expected {(batch, t_len)}"
+                )
+            block = block * (c[..., None] != 0)
+        gated.append(block)
+        offset += s.num_features
+    return xp.concatenate(gated, axis=-1)
+
+
+def auto_seasonalities(
+    ds_days, mask=None
+) -> "Tuple[SeasonalityConfig, ...]":
+    """Prophet's auto-seasonality rule from the observed calendar.
+
+    yearly  — span >= 2 years (730 days);
+    weekly  — span >= 2 weeks AND finest spacing < 7 days;
+    daily   — span >= 2 days  AND finest spacing < 1 day.
+
+    Args:
+      ds_days: (T,) or (B, T) absolute days; NaN/masked entries ignored.
+      mask: optional validity mask matching ds_days.
+    Returns:
+      tuple of the standard YEARLY / WEEKLY / DAILY configs that apply.
+    """
+    from tsspark_tpu.config import DAILY, WEEKLY, YEARLY
+
+    ds = np.asarray(ds_days, np.float64).ravel()
+    if mask is not None:
+        ds = ds[np.asarray(mask).ravel() > 0]
+    ds = np.unique(ds[np.isfinite(ds)])
+    if ds.size < 2:
+        return ()
+    span = float(ds[-1] - ds[0])
+    spacing = float(np.min(np.diff(ds)))
+    out = []
+    if span >= 730.0:
+        out.append(YEARLY)
+    if span >= 14.0 and spacing < 7.0:
+        out.append(WEEKLY)
+    if span >= 2.0 and spacing < 1.0:
+        out.append(DAILY)
+    return tuple(out)
 
 
 def feature_matrix(

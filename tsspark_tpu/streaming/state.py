@@ -27,7 +27,9 @@ from tsspark_tpu.utils import checkpoint as ckpt
 
 def _meta_dim(config: ProphetConfig) -> int:
     # y_scale, floor, ds_start, ds_span + reg_mean/reg_std (R each).
-    return 4 + 2 * config.num_regressors
+    # Row layout: y_scale, floor, ds_start, ds_span (4) + reg_mean (R) +
+    # reg_std (R) + changepoints (n_cp); see _flatten_meta.
+    return 4 + 2 * config.num_regressors + config.n_changepoints
 
 
 def _flatten_meta(meta: ScalingMeta) -> np.ndarray:
@@ -45,6 +47,7 @@ def _flatten_meta(meta: ScalingMeta) -> np.ndarray:
         np.asarray(meta.ds_span, np.float64)[:, None],
         np.asarray(meta.reg_mean, np.float64),
         np.asarray(meta.reg_std, np.float64),
+        np.asarray(meta.changepoints, np.float64),
     ]
     return np.concatenate(cols, axis=1)
 
@@ -62,6 +65,7 @@ def _unflatten_meta(rows: np.ndarray, config: ProphetConfig) -> ScalingMeta:
         ds_span=np.asarray(rows[:, 3]),
         reg_mean=np.asarray(rows[:, 4 : 4 + r]),
         reg_std=np.asarray(rows[:, 4 + r : 4 + 2 * r]),
+        changepoints=np.asarray(rows[:, 4 + 2 * r :]),
     )
 
 

@@ -71,13 +71,12 @@ def transfer_theta(
         / np.where(scale_new > 0, scale_new, 1.0), dtype
     )[:, None]
 
-    n_cp = config.n_changepoints
     batch = theta_old.shape[0]
-    s_new = trend_mod.uniform_changepoints(
-        jnp.zeros((batch,), dtype), jnp.ones((batch,), dtype),
-        n_cp, config.changepoint_range,
-    )
-    s_old = s_new  # changepoint fractions are identical in each scaled space
+    # Fit-time changepoint grids from the metas: with quantile placement the
+    # grids are data-dependent and differ between the old and new fits (and
+    # between series); uniform grids round-trip through this identically.
+    s_old = jnp.asarray(meta_old.changepoints, dtype)
+    s_new = jnp.asarray(meta_new.changepoints, dtype)
 
     # Old cumulative slope evaluated at new-grid points mapped to old time.
     # slope_old(t) = k + sum_{j: s_old_j <= t} delta_j.  New time t_new maps

@@ -207,10 +207,22 @@ def load_state(
     z = np.load(path + ".npz")
     # Meta stays HOST numpy float64 (see ScalingMeta): jnp.asarray would
     # downcast ds_start/ds_span to f32 and quantize sub-daily warm starts.
-    meta = ScalingMeta(**{
+    fields = {
         k[len("meta_"):]: np.asarray(z[k], np.float64)
         for k in z.files if k.startswith("meta_")
-    })
+    }
+    if "changepoints" not in fields:
+        # Checkpoint predates per-series changepoint grids in ScalingMeta.
+        # Uniform placement (the only placement that existed then) is exactly
+        # reconstructible from the config.
+        from tsspark_tpu.models.prophet import trend as trend_mod
+
+        b = fields["y_scale"].shape[0]
+        fields["changepoints"] = np.asarray(trend_mod.uniform_changepoints(
+            np.zeros((b,)), np.ones((b,)),
+            config.n_changepoints, config.changepoint_range,
+        ))
+    meta = ScalingMeta(**fields)
     state = FitState(
         theta=jnp.asarray(z["theta"]),
         meta=meta,
