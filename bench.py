@@ -217,7 +217,9 @@ def fit_worker(args) -> int:
     if straggler_idx:
         heartbeat()  # phase 2 starts: reset the stall clock
         idx = np.asarray(straggler_idx)
-        state2 = backend.fit(
+        # Stragglers get the GN-diagonal initial metric (ill-conditioned
+        # tail; see SolverConfig.precond / TpuBackend._straggler_backend).
+        state2 = backend._straggler_backend().fit(
             ds,
             np.ascontiguousarray(y[idx]),
             mask=np.ascontiguousarray(mask[idx]),

@@ -104,3 +104,23 @@ def test_chunked_fit_with_per_series_grids():
     np.testing.assert_allclose(
         np.asarray(state.meta.ds_start), ds[:, 0], atol=1e-6
     )
+
+
+def test_regressor_coefficients_recover_known_effect():
+    """regressor_coefficients must report the effect per RAW unit of the
+    regressor in data units, undoing both y-scaling and standardization."""
+    rng = np.random.default_rng(9)
+    n = 300
+    t = np.arange(float(n))
+    price = rng.normal(50.0, 10.0, n)
+    y = 100.0 + 0.05 * t + 2.5 * price + rng.normal(0, 0.5, n)
+    df = pd.DataFrame({"series_id": "s0", "ds": t, "y": y, "price": price})
+    cfg = ProphetConfig(
+        seasonalities=(), n_changepoints=3,
+        regressors=(RegressorConfig("price"),),
+    )
+    fc = tt.Forecaster(cfg, regressor_cols=("price",)).fit(df)
+    out = fc.regressor_coefficients()
+    assert set(out.columns) == {"series_id", "regressor", "mode", "coef"}
+    assert out.shape[0] == 1
+    np.testing.assert_allclose(out["coef"].iloc[0], 2.5, rtol=0.05)

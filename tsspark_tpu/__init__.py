@@ -44,8 +44,9 @@ from tsspark_tpu.models.holidays import (
     holidays_from_df,
 )
 from tsspark_tpu.models.prophet.model import FitState, McmcState, ProphetModel
+from tsspark_tpu.models.prophet.seasonality import auto_seasonalities
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 __all__ = [
     "DAILY",
@@ -56,6 +57,7 @@ __all__ = [
     "McmcConfig",
     "McmcState",
     "add_holidays",
+    "auto_seasonalities",
     "country_holidays",
     "holidays_from_df",
     "ProphetConfig",

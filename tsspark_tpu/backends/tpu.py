@@ -154,7 +154,7 @@ class TpuBackend(ForecastBackend):
         if idx.size == 0:
             return state
         sub = lambda a: None if a is None else np.asarray(a)[idx]
-        state2 = self.fit(
+        state2 = self._straggler_backend().fit(
             ds if np.asarray(ds).ndim == 1 else np.asarray(ds)[idx],
             np.asarray(y)[idx], mask=sub(mask), cap=sub(cap),
             floor=sub(floor), regressors=sub(regressors),
@@ -165,14 +165,26 @@ class TpuBackend(ForecastBackend):
         )
         return patch_state(state, idx, state2)
 
-    def _phase1(self, phase1_iters: int) -> "TpuBackend":
+    def _derived(self, **solver_overrides) -> "TpuBackend":
+        """Same backend with SolverConfig fields replaced (keeps chunking
+        and liveness wiring in one place)."""
         return TpuBackend(
             self.config,
-            dataclasses.replace(self.solver_config, max_iters=phase1_iters),
+            dataclasses.replace(self.solver_config, **solver_overrides),
             chunk_size=self.chunk_size,
             iter_segment=self.iter_segment,
             on_segment=self.on_segment,
         )
+
+    def _phase1(self, phase1_iters: int) -> "TpuBackend":
+        return self._derived(max_iters=phase1_iters)
+
+    def _straggler_backend(self) -> "TpuBackend":
+        """Full-depth backend for the compacted unconverged tail, with the
+        GN-diagonal initial metric: stragglers are by construction the
+        ill-conditioned series the plain metric stalls on (SolverConfig.
+        precond), while the fast majority never pays for it."""
+        return self._derived(precond="gn_diag")
 
     def predict(self, state, ds, cap=None, regressors=None, seed=0,
                 num_samples=None, conditions=None):

@@ -297,6 +297,38 @@ class Forecaster:
             "divergences": np.asarray(ms.divergences),
         })
 
+    def regressor_coefficients(self) -> pd.DataFrame:
+        """Fitted external-regressor effects in interpretable units (the
+        Prophet ``regressor_coefficients`` utility).
+
+        Returns one row per (series, regressor): ``coef`` is the change in
+        yhat per unit change of the RAW regressor value — additive effects
+        in data units (beta rescaled by y_scale and the standardization
+        std), multiplicative effects as a relative fraction of the trend.
+        """
+        if self.state is None:
+            raise RuntimeError("fit before regressor_coefficients")
+        regs = self.config.regressors
+        if not regs:
+            raise ValueError("model has no external regressors")
+        from tsspark_tpu.models.prophet.params import unpack
+
+        p = unpack(np.asarray(self.state.theta), self.config)
+        beta = np.asarray(p.beta)[:, self.config.num_seasonal_features:]
+        meta = self.state.meta
+        rows = []
+        for j, rc in enumerate(regs):
+            raw = beta[:, j] / np.asarray(meta.reg_std)[:, j]
+            coef = raw if rc.mode == "multiplicative" \
+                else raw * np.asarray(meta.y_scale)
+            rows.append(pd.DataFrame({
+                self.id_col: list(self.series_ids),
+                "regressor": rc.name,
+                "mode": rc.mode,
+                "coef": coef,
+            }))
+        return pd.concat(rows, ignore_index=True)
+
     def make_future_grid(self, horizon: int, include_history: bool = False
                          ) -> np.ndarray:
         if self._train_ds is None:

@@ -154,3 +154,48 @@ def initial_theta(
     if solver_config.init == "ridge":
         return ridge_init(data, config)
     return init_theta(config, data.y, data.mask, data.t)
+
+
+def curvature_diag(data, config: ProphetConfig, theta0: jnp.ndarray
+                   ) -> jnp.ndarray:
+    """(B, P) inverse Gauss-Newton-diagonal of the MAP objective at theta0.
+
+    Used as the L-BFGS initial metric (ops/lbfgs.py): the Prophet posterior
+    mixes parameters whose curvatures differ by orders of magnitude (sigma's
+    ~2n against a changepoint column active on a handful of points), and in
+    float32 the unpreconditioned solver stalls on such series at objective
+    values the scipy oracle beats (measured ~1.4 nats on 64-day series).
+    The GN diagonal is exact for every linear parameter; non-linear growth
+    reuses the linear-trend columns as scale proxies — preconditioning needs
+    magnitudes, not exactness.
+    """
+    p = unpack(theta0, config)
+    mask, t = data.mask, data.t
+    b = t.shape[0]
+    dtype = t.dtype
+    sigma = 1e-5 + jnp.exp(p.log_sigma)  # matches loss._SIGMA_FLOOR
+    w = mask / (sigma * sigma)[:, None]  # (B, T) residual precision
+    n_obs = mask.sum(axis=-1)
+
+    h_k = jnp.sum(w * t * t, axis=-1) + 1.0 / config.k_prior_scale**2
+    h_m = jnp.sum(w, axis=-1) + 1.0 / config.m_prior_scale**2
+    # d2/dlog_sigma2 of [n log sigma + SSR/(2 sigma^2)] ~ 2 n at the optimum;
+    # floor keeps fully-masked padding rows finite.
+    h_sig = jnp.maximum(2.0 * n_obs, 1.0) + 1.0 / config.sigma_prior_scale**2
+    parts = [h_k[:, None], h_m[:, None], h_sig[:, None]]
+    if config.n_changepoints:
+        relu = jnp.maximum(t[:, :, None] - data.s[:, None, :], 0.0)
+        h_delta = jnp.einsum("bt,btc->bc", w, relu * relu)
+        # Laplace(0, b) moment-matched to Normal(0, sqrt(2) b), like the
+        # ridge init: the kink curvature (1/(b*eps_huber), ~1e5) would be
+        # honest at delta=0 but freezes changepoints the data wants to move.
+        h_delta = h_delta + 0.5 / config.changepoint_prior_scale**2
+        parts.append(h_delta)
+    if config.num_features:
+        x = _feature_matrix(data, b)
+        h_beta = jnp.einsum("bt,btf->bf", w, x * x)
+        h_beta = h_beta + (
+            1.0 / jnp.asarray(config.feature_prior_scales(), dtype) ** 2
+        )
+        parts.append(h_beta)
+    return 1.0 / jnp.concatenate(parts, axis=-1)

@@ -23,7 +23,7 @@ from tsspark_tpu.models.prophet.design import (
     ScalingMeta,
     prepare_fit_data,
 )
-from tsspark_tpu.models.prophet.init import initial_theta
+from tsspark_tpu.models.prophet.init import curvature_diag, initial_theta
 from tsspark_tpu.models.prophet.loss import value_and_grad_batch, value_batch
 from tsspark_tpu.ops import hmc, lbfgs
 
@@ -59,9 +59,12 @@ def fit_core(
     """
     if theta0 is None:
         theta0 = initial_theta(data, config, solver_config)
+    precond = (curvature_diag(data, config, theta0)
+               if solver_config.precond == "gn_diag" else None)
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
-    return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval)
+    return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval,
+                          precond=precond)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "solver_config"))
@@ -74,8 +77,10 @@ def fit_init_core(
     """Jitted solver-state construction (for the segmented fit path)."""
     if theta0 is None:
         theta0 = initial_theta(data, config, solver_config)
+    precond = (curvature_diag(data, config, theta0)
+               if solver_config.precond == "gn_diag" else None)
     fun = lambda th: value_and_grad_batch(th, data, config)
-    return lbfgs.init_state(fun, theta0, solver_config)
+    return lbfgs.init_state(fun, theta0, solver_config, precond)
 
 
 @functools.partial(

@@ -71,6 +71,7 @@ class LbfgsState(NamedTuple):
     prev_step: jnp.ndarray  # (B,) last accepted line-search step (seeds the next)
     floor_count: jnp.ndarray  # (B,) int32 consecutive noise-floor iterations
     status: jnp.ndarray     # (B,) int32 STATUS_* termination reason
+    precond: jnp.ndarray    # (B, P) inverse-curvature diag (initial metric)
 
 
 class LbfgsResult(NamedTuple):
@@ -109,13 +110,18 @@ def _two_loop_direction(state: LbfgsState, history: int) -> jnp.ndarray:
         q = q - jnp.where(r_i[:, None] != 0, alpha[:, None] * y_i, 0.0)
         alphas.append((idx, alpha))
 
-    # Initial Hessian scaling gamma = s.y / y.y of the newest valid pair.
+    # Initial metric H0 = gamma * D, D = diag inverse-curvature preconditioner
+    # (ones when disabled).  gamma = s.y / (y.D y) of the newest valid pair —
+    # the standard scaled-L-BFGS H0; with empty history the direction is the
+    # preconditioned gradient -D g (a Newton-diagonal step, which is what
+    # rescues ill-conditioned series the plain -g step stalls on in f32).
+    d2 = state.precond
     s_n, y_n, r_n = state.s_hist[newest], state.y_hist[newest], state.rho[newest]
-    yy = _dot(y_n, y_n)
+    yy = _dot(y_n * d2, y_n)
     gamma = jnp.where(
         (r_n != 0) & (yy > 0), _dot(s_n, y_n) / jnp.maximum(yy, 1e-30), 1.0
     )
-    r = q * gamma[:, None]
+    r = q * gamma[:, None] * d2
 
     for idx, alpha in reversed(alphas):
         s_i = state.s_hist[idx]
@@ -132,11 +138,18 @@ def init_state(
     fun: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
     theta0: jnp.ndarray,
     config: SolverConfig = SolverConfig(),
+    precond: Optional[jnp.ndarray] = None,
 ) -> LbfgsState:
-    """Fresh solver state at theta0 (one objective evaluation)."""
+    """Fresh solver state at theta0 (one objective evaluation).
+
+    ``precond``: optional (B, P) inverse-curvature diagonal used as the
+    L-BFGS initial metric (see _two_loop_direction); None disables it.
+    """
     b, p = theta0.shape
     m = config.history
     f0, g0 = fun(theta0)
+    if precond is None:
+        precond = jnp.ones_like(theta0)
     return LbfgsState(
         theta=theta0,
         f=f0,
@@ -150,6 +163,7 @@ def init_state(
         prev_step=jnp.full((b,), config.init_step, theta0.dtype),
         floor_count=jnp.zeros((b,), jnp.int32),
         status=jnp.zeros((b,), jnp.int32),
+        precond=precond,
     )
 
 
@@ -196,11 +210,14 @@ def run_segment(
     def body(state: LbfgsState) -> LbfgsState:
         direction = _two_loop_direction(state, m)
         # Descent safeguard: if the two-loop direction is not a descent
-        # direction (stale/indefinite history), fall back to -grad.
+        # direction (stale/indefinite history), fall back to the
+        # preconditioned steepest descent -D g (D > 0 keeps it a descent
+        # direction; D = ones when preconditioning is off).
+        pgrad = state.precond * state.grad
         dg = _dot(direction, state.grad)  # (B,)
         bad = dg >= 0
-        direction = jnp.where(bad[:, None], -state.grad, direction)
-        dg = jnp.where(bad, -_dot(state.grad, state.grad), dg)
+        direction = jnp.where(bad[:, None], -pgrad, direction)
+        dg = jnp.where(bad, -_dot(pgrad, state.grad), dg)
 
         # --- batched-fan Armijo line search ---------------------------------
         # The whole geometric step ladder is evaluated in ONE objective call
@@ -218,9 +235,9 @@ def run_segment(
         shrinks = config.ls_shrink ** jnp.arange(k_steps, dtype=state.f.dtype)
         ladder = step0[None, :] * shrinks[:, None]  # (K, B)
 
-        gnorm = jnp.linalg.norm(state.grad, axis=-1)
+        gnorm = jnp.linalg.norm(pgrad, axis=-1)
         tiny = 1e-3 / jnp.maximum(gnorm, 1.0)
-        fb_theta = state.theta - tiny[:, None] * state.grad
+        fb_theta = state.theta - tiny[:, None] * pgrad
 
         trials = jnp.concatenate(
             [
@@ -319,6 +336,7 @@ def run_segment(
             prev_step=prev_step,
             floor_count=floor_count,
             status=status,
+            precond=state.precond,
         )
 
     return jax.lax.while_loop(cond, body, state)
@@ -329,6 +347,7 @@ def minimize(
     theta0: jnp.ndarray,
     config: SolverConfig = SolverConfig(),
     fun_value: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
+    precond: Optional[jnp.ndarray] = None,
 ) -> LbfgsResult:
     """Minimize a batch of independent objectives with shared compute.
 
@@ -337,6 +356,7 @@ def minimize(
       theta0: (B, P) initial parameters.
       fun_value: optional value-only objective for line-search trials
         (defaults to ``fun(th)[0]``, which wastes the gradient).
+      precond: optional (B, P) inverse-curvature diagonal (initial metric).
 
     Returns:
       LbfgsResult with per-series optimum, loss, grad inf-norm, convergence
@@ -344,6 +364,7 @@ def minimize(
     """
     return to_result(
         run_segment(
-            fun, init_state(fun, theta0, config), config, fun_value=fun_value
+            fun, init_state(fun, theta0, config, precond), config,
+            fun_value=fun_value,
         )
     )

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tsspark_tpu.config import ProphetConfig, ShardingConfig, SolverConfig
 from tsspark_tpu.models.prophet.design import FitData
-from tsspark_tpu.models.prophet.init import initial_theta
+from tsspark_tpu.models.prophet.init import curvature_diag, initial_theta
 from tsspark_tpu.models.prophet.loss import value_and_grad_batch, value_batch
 from tsspark_tpu.ops import lbfgs
 
@@ -74,9 +74,12 @@ def _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg):
     theta0 = jax.lax.with_sharding_constraint(
         theta0, NamedSharding(mesh, P(s_ax, None))
     )
+    precond = (curvature_diag(data, config, theta0)
+               if solver_config.precond == "gn_diag" else None)
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
-    return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval)
+    return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval,
+                          precond=precond)
 
 
 def fit_sharded(
