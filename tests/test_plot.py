@@ -65,3 +65,26 @@ def test_plot_components(fitted):
     import matplotlib.pyplot as plt
 
     plt.close(fig)
+
+
+def test_plot_cross_validation_metric(tmp_path):
+    import pandas as pd
+    from tsspark_tpu import plot
+
+    rng = np.random.default_rng(3)
+    n = 60
+    cv = pd.DataFrame({
+        "series_id": "s0",
+        "ds": np.tile(np.arange(10.0, 10.0 + n / 3), 3),
+        "cutoff": np.repeat([9.0, 8.0, 7.0], n / 3),
+        "y": rng.normal(10, 1, n),
+        "yhat": rng.normal(10, 1, n),
+        "yhat_lower": np.full(n, 5.0),
+        "yhat_upper": np.full(n, 15.0),
+    })
+    ax = plot.plot_cross_validation_metric(cv, metric="smape")
+    assert ax.get_ylabel() == "smape"
+    ax2 = plot.plot_cross_validation_metric(cv, metric="coverage")
+    assert ax2.get_ylabel() == "coverage"
+    with pytest.raises(ValueError, match="unknown metric"):
+        plot.plot_cross_validation_metric(cv, metric="nope")

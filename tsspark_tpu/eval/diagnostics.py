@@ -100,6 +100,38 @@ def cross_validation(
 _ALL_METRICS = ("mse", "rmse", "mae", "mape", "mdape", "smape", "coverage")
 
 
+def point_metrics(
+    d: pd.DataFrame, metrics: Sequence[str] = _ALL_METRICS,
+    y_col: str = "y",
+) -> pd.DataFrame:
+    """Per-row metric values for a cross_validation-shaped frame.
+
+    The single source of the per-point metric definitions — both the
+    horizon-aggregated table (:func:`performance_metrics`) and the raw
+    scatter in ``plot.plot_cross_validation_metric`` are built from it, so
+    conventions (sMAPE denominator, eps, coverage inclusivity) cannot drift
+    apart.  ``rmse`` aggregates from ``mse``; ``mdape`` from ``mape``.
+    """
+    y = d[y_col].to_numpy(float)
+    yhat = d["yhat"].to_numpy(float)
+    err = y - yhat
+    eps = 1e-12
+    point = pd.DataFrame(index=d.index)
+    point["mse"] = err**2
+    point["mae"] = np.abs(err)
+    point["mape"] = np.abs(err) / np.maximum(np.abs(y), eps)
+    point["mdape"] = point["mape"]
+    point["smape"] = 2.0 * np.abs(err) / np.maximum(
+        np.abs(y) + np.abs(yhat), eps
+    )
+    if "coverage" in metrics:
+        point["coverage"] = (
+            (y >= d["yhat_lower"].to_numpy(float))
+            & (y <= d["yhat_upper"].to_numpy(float))
+        ).astype(float)
+    return point
+
+
 def performance_metrics(
     cv_df: pd.DataFrame,
     rolling_window: float = 0.1,
@@ -122,24 +154,7 @@ def performance_metrics(
     d = cv_df.copy()
     d["horizon"] = d[ds_col] - d["cutoff"]
     d = d.sort_values("horizon", kind="stable").reset_index(drop=True)
-
-    y = d[y_col].to_numpy(float)
-    yhat = d["yhat"].to_numpy(float)
-    err = y - yhat
-    eps = 1e-12
-    point = pd.DataFrame(index=d.index)
-    point["mse"] = err**2
-    point["mae"] = np.abs(err)
-    point["mape"] = np.abs(err) / np.maximum(np.abs(y), eps)
-    point["mdape"] = point["mape"]
-    point["smape"] = 2.0 * np.abs(err) / np.maximum(
-        np.abs(y) + np.abs(yhat), eps
-    )
-    if "coverage" in metrics:
-        point["coverage"] = (
-            (y >= d["yhat_lower"].to_numpy(float))
-            & (y <= d["yhat_upper"].to_numpy(float))
-        ).astype(float)
+    point = point_metrics(d, metrics, y_col=y_col)
 
     if rolling_window <= 0:
         # Exact per-horizon aggregation, no smoothing.

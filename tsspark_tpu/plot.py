@@ -119,3 +119,48 @@ def plot_components(
     fig.autofmt_xdate()
     fig.tight_layout()
     return fig
+
+
+def plot_cross_validation_metric(
+    cv_df: pd.DataFrame,
+    metric: str = "smape",
+    rolling_window: float = 0.1,
+    ds_col: str = "ds",
+    y_col: str = "y",
+    ax=None,
+    figsize=(10, 4),
+):
+    """Per-point metric scatter + rolling-mean curve over forecast horizon.
+
+    Mirrors ``prophet.plot.plot_cross_validation_metric``: dots are the raw
+    per-(series, cutoff, ds) errors from a :func:`cross_validation` frame,
+    the line is the horizon-rolling aggregate from
+    :func:`performance_metrics`.  Both are computed from the same
+    ``point_metrics`` definitions, so they cannot drift apart; the dots for
+    ``rmse``/``mdape`` show their per-point bases (|err| / APE).
+    """
+    from tsspark_tpu.eval.diagnostics import (
+        _ALL_METRICS, performance_metrics, point_metrics,
+    )
+
+    if metric not in _ALL_METRICS:
+        raise ValueError(
+            f"unknown metric {metric!r}; choose from {_ALL_METRICS}"
+        )
+    plt = _mpl()
+    if ax is None:
+        _, ax = plt.subplots(figsize=figsize)
+    d = cv_df.copy()
+    d["horizon"] = d[ds_col] - d["cutoff"]
+    point = point_metrics(d, (metric,), y_col=y_col)
+    base = {"rmse": "mae", "mdape": "mape"}.get(metric, metric)
+    ax.plot(d["horizon"], point[base], ".", alpha=0.3, markersize=3,
+            color="gray")
+    pm = performance_metrics(
+        cv_df, rolling_window=rolling_window, metrics=(metric,),
+        ds_col=ds_col, y_col=y_col,
+    )
+    ax.plot(pm["horizon"], pm[metric], color="#0072B2", linewidth=2)
+    ax.set_xlabel("horizon")
+    ax.set_ylabel(metric)
+    return ax
