@@ -1,0 +1,82 @@
+"""Multi-host execution: distributed runtime init + host-local data feeding.
+
+The reference scales across machines through Spark's executor fan-out; the
+TPU-native equivalent is one SPMD program over a multi-host mesh, with
+per-host processes that each hold only their slice of the series batch:
+
+  1. every process calls :func:`initialize` (JAX distributed runtime — the
+     coordination layer under multi-host DCN collectives),
+  2. every process loads/prepares only ITS series rows (host-local numpy),
+  3. :func:`global_batch` assembles the per-host rows into global sharded
+     ``jax.Array``s addressable by the whole mesh, and the usual
+     ``sharding.fit_sharded`` program runs unchanged — XLA routes
+     collectives over ICI within a host and DCN across hosts.
+
+Single-process meshes degrade gracefully: ``global_batch`` is then just a
+device_put onto the mesh sharding (this is what the CPU-mesh tests cover;
+multi-process behavior uses the same jax.make_array_from_process_local_data
+contract).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tsspark_tpu.config import ShardingConfig
+from tsspark_tpu.models.prophet.design import FitData
+from tsspark_tpu.parallel.sharding import data_shardings
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Start the JAX distributed runtime (multi-host DCN coordination).
+
+    Call once per host process before building meshes.  On single-host
+    setups (and TPU pods with automatic environment discovery) all
+    arguments may be omitted.  Thin passthrough to
+    ``jax.distributed.initialize`` so callers depend on this package's
+    API rather than JAX internals.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def global_batch(
+    data: FitData,
+    mesh: Mesh,
+    config: ShardingConfig = ShardingConfig(),
+) -> FitData:
+    """Assemble per-host FitData rows into globally-sharded jax.Arrays.
+
+    Each process passes the rows of the series batch IT loaded (equal row
+    counts per process; pad with inert mask-0 rows if needed).  The result
+    is a FitData of global arrays laid out per ``data_shardings`` — series
+    axis split across the mesh — ready for ``fit_sharded``/``fit_core``
+    without any host ever materializing the full batch.
+    """
+    specs = data_shardings(mesh, data, config)
+
+    def put(x, spec):
+        if x is None:
+            return None
+        x = np.asarray(x)
+        sh = NamedSharding(mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(x, sh)
+        return jax.make_array_from_process_local_data(sh, x)
+
+    # data's leaves are arrays, so tree.map takes each corresponding spec
+    # subtree (a PartitionSpec) whole — no is_leaf needed.
+    return jax.tree.map(put, data, specs)
