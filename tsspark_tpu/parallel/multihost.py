@@ -24,7 +24,7 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from tsspark_tpu.config import ShardingConfig
 from tsspark_tpu.models.prophet.design import FitData
@@ -69,13 +69,13 @@ def global_batch(
     specs = data_shardings(mesh, data, config)
 
     def put(x, spec):
-        if x is None:
-            return None
-        x = np.asarray(x)
         sh = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
+            # Degenerate mode: device_put reshards device arrays directly —
+            # no host round trip.
             return jax.device_put(x, sh)
-        return jax.make_array_from_process_local_data(sh, x)
+        # Multi-process contract: x is this host's local numpy rows.
+        return jax.make_array_from_process_local_data(sh, np.asarray(x))
 
     # data's leaves are arrays, so tree.map takes each corresponding spec
     # subtree (a PartitionSpec) whole — no is_leaf needed.
