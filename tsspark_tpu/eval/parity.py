@@ -101,38 +101,71 @@ def _case_configs(scale: float):
     }
 
 
-def _smape_per_series(cfg, solver, batch, backend: str, holdout_frac=0.1):
+def _smape_per_series(cfg, solver, batch, backend: str, holdout_frac=0.1,
+                      transfer_chunk: int = 2048, **backend_kwargs):
+    """Fit on the train window, score per-series sMAPE on train + holdout.
+
+    BOTH legs stream through host-side series chunks of ``transfer_chunk``:
+    at bench scale a single full-batch transfer (y/mask ~210 MB each, the
+    (30490, 1941, R) regressors ~640 MB) is far beyond the TPU tunnel's
+    observed ~64 MB single-buffer crash envelope (bench.py header), so the
+    device must only ever see chunk-sized buffers.  The tail chunk is
+    index-padded to the full chunk shape so every dispatch reuses one
+    compiled program.
+    """
+    from tsspark_tpu.backends.tpu import _concat_states, _slice_state
+
     t_len = batch.y.shape[1]
     split = int(t_len * (1 - holdout_frac))
-    bk = get_backend(backend, cfg, solver)
-    kw = {}
-    if batch.cap is not None:
-        kw["cap"] = jnp.asarray(batch.cap[:, :split])
-    if batch.regressors is not None:
-        kw["regressors"] = jnp.asarray(batch.regressors[:, :split])
+    bk = get_backend(backend, cfg, solver, **backend_kwargs)
+    b = batch.y.shape[0]
+    chunk = min(transfer_chunk, b)
+
+    ds_train = jnp.asarray(batch.ds[:split])
     t0 = time.time()
-    state = bk.fit(
-        jnp.asarray(batch.ds[:split]),
-        jnp.asarray(np.nan_to_num(batch.y[:, :split])),
-        mask=jnp.asarray(batch.mask[:, :split]),
-        **kw,
-    )
+    states = []
+    for lo in range(0, b, chunk):
+        # Tail padded by replicating row 0: same compiled shape for every
+        # chunk; the duplicate rows are sliced away below.
+        idx = np.arange(lo, lo + chunk) % b if lo + chunk > b \
+            else np.arange(lo, lo + chunk)
+        kw = {}
+        if batch.cap is not None:
+            kw["cap"] = jnp.asarray(batch.cap[idx][:, :split])
+        if batch.regressors is not None:
+            kw["regressors"] = jnp.asarray(batch.regressors[idx][:, :split])
+        st = bk.fit(
+            ds_train,
+            jnp.asarray(np.nan_to_num(batch.y[idx][:, :split])),
+            mask=jnp.asarray(batch.mask[idx][:, :split]),
+            **kw,
+        )
+        states.append(_slice_state(st, 0, min(chunk, b - lo)))
+    state = states[0] if len(states) == 1 else _concat_states(states)
     jax.block_until_ready(state.theta)
     fit_s = time.time() - t0
-    pkw = {}
-    if batch.cap is not None:
-        pkw["cap"] = jnp.asarray(batch.cap)
-    if batch.regressors is not None:
-        pkw["regressors"] = jnp.asarray(batch.regressors)
-    fc = bk.predict(state, jnp.asarray(batch.ds), num_samples=0, **pkw)
-    y = jnp.asarray(np.nan_to_num(batch.y))
-    m_train = jnp.asarray(batch.mask).at[:, split:].set(0.0)
-    m_hold = jnp.asarray(batch.mask).at[:, :split].set(0.0)
-    return (
-        np.asarray(metrics.smape(y, fc["yhat"], m_train)),
-        np.asarray(metrics.smape(y, fc["yhat"], m_hold)),
-        fit_s,
-    )
+
+    ds_full = jnp.asarray(batch.ds)
+    tr, ho = [], []
+    for lo in range(0, b, chunk):
+        n_real = min(chunk, b - lo)
+        idx = np.arange(lo, lo + chunk) % b
+        st = jax.tree.map(lambda a: a[idx], state)  # device and host leaves
+        pkw = {}
+        if batch.cap is not None:
+            pkw["cap"] = jnp.asarray(batch.cap[idx])
+        if batch.regressors is not None:
+            pkw["regressors"] = jnp.asarray(batch.regressors[idx])
+        fc = bk.predict(st, ds_full, num_samples=0, **pkw)
+        y = jnp.asarray(np.nan_to_num(batch.y[idx]))
+        m = jnp.asarray(batch.mask[idx])
+        tr.append(np.asarray(
+            metrics.smape(y, fc["yhat"], m.at[:, split:].set(0.0))
+        )[:n_real])
+        ho.append(np.asarray(
+            metrics.smape(y, fc["yhat"], m.at[:, :split].set(0.0))
+        )[:n_real])
+    return np.concatenate(tr), np.concatenate(ho), fit_s
 
 
 def _delta_dist(deltas: np.ndarray) -> Dict:
@@ -171,7 +204,8 @@ def run_parity(scale: float = 0.01) -> Dict:
 
 
 def run_config3_at_scale(
-    n_series: int = 30490, oracle_n: int = 512, seed: int = 0
+    n_series: int = 30490, oracle_n: int = 512, seed: int = 0,
+    chunk_size: int = 2048, iter_segment: int = 24,
 ) -> Dict:
     """Bench-scale parity for eval config 3: the batched solver fits the FULL
     series batch; the scipy oracle (the cost bound — a per-series Python
@@ -183,7 +217,10 @@ def run_config3_at_scale(
     """
     cfg, solver = _config3()
     batch = datasets.m5_like(n_series=n_series)
-    tr_tpu, ho_tpu, s_tpu = _smape_per_series(cfg, solver, batch, "tpu")
+    tr_tpu, ho_tpu, s_tpu = _smape_per_series(
+        cfg, solver, batch, "tpu",
+        chunk_size=chunk_size, iter_segment=iter_segment,
+    )
     rng = np.random.default_rng(seed)
     idx = np.sort(rng.choice(n_series, size=min(oracle_n, n_series),
                              replace=False))
