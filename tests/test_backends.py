@@ -200,3 +200,25 @@ def test_cpu_backend_components():
     comps = bk.components(state, ds)
     assert set(comps) == {"trend", "weekly"}
     assert np.asarray(comps["weekly"]).shape == (2, n)
+
+
+def test_on_segment_liveness_hook_fires():
+    """The per-dispatch liveness hook (bench's stall-watchdog feed) must
+    fire once per completed segment."""
+    from tsspark_tpu.backends.registry import get_backend
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+
+    calls = []
+    bk = get_backend(
+        "tpu",
+        ProphetConfig(seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+                      n_changepoints=2),
+        SolverConfig(max_iters=40),
+        iter_segment=8, on_segment=lambda: calls.append(1),
+    )
+    rng = np.random.default_rng(0)
+    n = 120
+    y = (3 + np.sin(2 * np.pi * np.arange(n) / 7)
+         + rng.normal(0, 0.5, (2, n))).astype(np.float32)
+    bk.fit(jnp.arange(n, dtype=jnp.float32), jnp.asarray(y))
+    assert 1 <= len(calls) <= 5  # one per dispatched segment
