@@ -194,6 +194,75 @@ def test_diag_precond_speeds_ill_conditioned_batch():
     )
 
 
+def test_closed_form_fan_matches_stacked_trials():
+    """For linear-growth additive models the closed-form ladder losses
+    (loss.fan_value_linear) must equal evaluating each trial directly, to
+    float32 rounding — and the resulting full fit must match the stacked
+    path's optimum."""
+    from tsspark_tpu.config import ProphetConfig, RegressorConfig, SeasonalityConfig
+    from tsspark_tpu.models.prophet.design import prepare_fit_data
+    from tsspark_tpu.models.prophet.loss import (
+        fan_value_linear, is_linear_additive, value_batch,
+    )
+    from tsspark_tpu.models.prophet.model import ProphetModel
+    from tsspark_tpu.models.prophet.init import initial_theta
+
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 3),),
+        regressors=(RegressorConfig("price"),),
+        n_changepoints=6,
+    )
+    assert is_linear_additive(cfg)
+    rng = np.random.default_rng(21)
+    b, n = 5, 240
+    t = np.arange(float(n))
+    y = (6 + 0.03 * t + 1.2 * np.sin(2 * np.pi * t / 7)
+         + rng.normal(0, 0.3, (b, n))).astype(np.float32)
+    reg = rng.normal(0, 1, (b, n, 1)).astype(np.float32)
+    data, _ = prepare_fit_data(
+        jnp.arange(float(n)), jnp.asarray(y), cfg, regressors=reg
+    )
+    theta = initial_theta(data, cfg, SolverConfig())
+    direction = jnp.asarray(
+        rng.normal(0, 0.1, theta.shape).astype(np.float32)
+    )
+    ladder = jnp.asarray(
+        (0.5 ** np.arange(8))[:, None] * np.ones((1, b)), jnp.float32
+    )
+    closed = fan_value_linear(theta, direction, ladder, data, cfg)
+    direct = jax.vmap(
+        lambda s: value_batch(theta + s[:, None] * direction, data, cfg)
+    )(ladder)
+    np.testing.assert_allclose(
+        np.asarray(closed), np.asarray(direct), rtol=2e-4, atol=2e-3
+    )
+    # Ineligible configs must not take the closed-form path.
+    assert not is_linear_additive(
+        ProphetConfig(growth="logistic", seasonalities=())
+    )
+    assert not is_linear_additive(ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2,
+                                         mode="multiplicative"),),
+    ))
+    # End-to-end: the fit through the closed-form search reaches the same
+    # optimum as forcing the stacked path (multiplicative flag flips it).
+    model = ProphetModel(cfg, SolverConfig(max_iters=150))
+    st = model.fit(jnp.arange(float(n)), jnp.asarray(y), regressors=jnp.asarray(reg))
+    assert bool(st.converged.all())
+    resid = np.asarray(st.loss)
+    from tsspark_tpu.ops import lbfgs as lb
+    from tsspark_tpu.models.prophet.loss import value_and_grad_batch
+    stacked = lb.minimize(
+        lambda th: value_and_grad_batch(th, data, cfg),
+        initial_theta(data, cfg, SolverConfig()),
+        SolverConfig(max_iters=150),
+        fun_value=lambda th: value_batch(th, data, cfg),
+    )
+    np.testing.assert_allclose(
+        resid, np.asarray(stacked.f), rtol=1e-3, atol=1e-2
+    )
+
+
 def test_jit_compatible():
     def fun(theta):
         f = 0.5 * jnp.sum(theta * theta, axis=-1)

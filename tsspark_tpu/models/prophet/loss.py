@@ -49,7 +49,13 @@ def _smooth_abs(x: jnp.ndarray, eps: float = _HUBER_EPS) -> jnp.ndarray:
 def neg_log_posterior(
     theta: jnp.ndarray, data: FitData, config: ProphetConfig
 ) -> jnp.ndarray:
-    """Per-series negative log posterior, shape (B,)."""
+    """Per-series negative log posterior, shape (B,).
+
+    NOTE: ``fan_value_linear`` re-derives every term below in closed form
+    along a search ray — any change here (new prior, likelihood tweak)
+    must be mirrored there or linear-additive fits will line-search
+    against a stale objective.
+    """
     p = unpack(theta, config)
     yhat, _ = model_yhat(theta, data, config)
     sigma = _SIGMA_FLOOR + jnp.exp(p.log_sigma)
@@ -81,6 +87,86 @@ def value_batch(theta: jnp.ndarray, data: FitData, config: ProphetConfig):
     the loss; skipping the vjp there roughly halves the cost of each trial.
     """
     return neg_log_posterior(theta, data, config)
+
+
+def is_linear_additive(config: ProphetConfig) -> bool:
+    """True when yhat is LINEAR in every parameter it depends on: linear
+    growth and purely additive features.  The line-search fan then has a
+    closed form (fan_value_linear)."""
+    return config.growth == "linear" and not any(config.feature_modes())
+
+
+def fan_value_linear(
+    theta: jnp.ndarray,      # (B, P) current point
+    direction: jnp.ndarray,  # (B, P) search direction
+    ladder: jnp.ndarray,     # (K, B) candidate step sizes
+    data: FitData,
+    config: ProphetConfig,
+) -> jnp.ndarray:
+    """Closed-form losses (K, B) for the whole Armijo step ladder.
+
+    For linear growth with additive features ``yhat`` is a LINEAR map of
+    the parameters (sigma enters only the likelihood), so along a search
+    ray ``theta + s*d``:
+
+        yhat(theta + s d) = yhat(theta) + s * yhat(d)
+
+    and the masked sum of squares expands into THREE reductions computed
+    once (S0, S1, S2 below); every Gaussian prior is quadratic in ``s``
+    (three more scalars), sigma terms are exact per step, and only the
+    smoothed Laplace prior needs a per-step evaluation — over (K, B, n_cp),
+    a few thousandths of the (B, T) grid.  The entire K-step line search
+    costs TWO model evaluations instead of K+1: this is the difference
+    between the solver being line-search-bound and gradient-bound, and it
+    is exact (same float32 noise floor as evaluating each trial directly —
+    validated against the stacked fan in tests/test_lbfgs.py).
+    """
+    p0 = unpack(theta, config)
+    pd = unpack(direction, config)
+    yhat0, _ = model_yhat(theta, data, config)
+    ydir, _ = model_yhat(direction, data, config)  # linear map of d
+
+    mask = data.mask
+    r = (data.y - yhat0) * mask
+    dirm = ydir * mask
+    s0 = jnp.sum(r * r, axis=-1)        # (B,)
+    s1 = jnp.sum(r * dirm, axis=-1)
+    s2 = jnp.sum(dirm * dirm, axis=-1)
+    n_obs = mask.sum(axis=-1)
+
+    s = ladder                           # (K, B)
+    sigma = _SIGMA_FLOOR + jnp.exp(p0.log_sigma[None] + s * pd.log_sigma[None])
+    # The true sum of squares is >= 0 by construction; the expanded form
+    # can go slightly negative from f32 cancellation when a step nearly
+    # zeroes the residual, and 1/sigma^2 would amplify that into a falsely
+    # negative loss the direct evaluation could never produce.
+    ssr = jnp.maximum(
+        s0[None] - 2.0 * s * s1[None] + s * s * s2[None], 0.0
+    )
+    nll = 0.5 * ssr / (sigma * sigma) + n_obs[None] * jnp.log(sigma)
+
+    # Gaussian priors: 0.5*((a + s b)/c)^2 summed -> quadratic in s.
+    def quad(a, b, c):
+        return (
+            0.5 * jnp.sum((a / c) ** 2, axis=-1)[None]
+            + s * jnp.sum(a * b / (c * c), axis=-1)[None]
+            + 0.5 * s * s * jnp.sum((b / c) ** 2, axis=-1)[None]
+        )
+
+    k_scale = jnp.asarray([config.k_prior_scale, config.m_prior_scale],
+                          theta.dtype)
+    prior = quad(
+        jnp.stack([p0.k, p0.m], -1), jnp.stack([pd.k, pd.m], -1), k_scale
+    )
+    if config.num_features:
+        prior = prior + quad(p0.beta, pd.beta, data.prior_scales)
+    prior = prior + 0.5 * (sigma / config.sigma_prior_scale) ** 2
+    if config.n_changepoints:
+        delta_s = p0.delta[None] + s[..., None] * pd.delta[None]  # (K, B, C)
+        prior = prior + jnp.sum(
+            _smooth_abs(delta_s) / config.changepoint_prior_scale, axis=-1
+        )
+    return nll + prior
 
 
 def value_and_grad_batch(theta: jnp.ndarray, data: FitData, config: ProphetConfig):

@@ -24,7 +24,12 @@ from tsspark_tpu.models.prophet.design import (
     prepare_fit_data,
 )
 from tsspark_tpu.models.prophet.init import curvature_diag, initial_theta
-from tsspark_tpu.models.prophet.loss import value_and_grad_batch, value_batch
+from tsspark_tpu.models.prophet.loss import (
+    fan_value_linear,
+    is_linear_additive,
+    value_and_grad_batch,
+    value_batch,
+)
 from tsspark_tpu.ops import hmc, lbfgs
 
 
@@ -63,8 +68,10 @@ def fit_core(
                if solver_config.precond == "gn_diag" else None)
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
+    fan = (lambda th, d, s: fan_value_linear(th, d, s, data, config)) \
+        if is_linear_additive(config) else None
     return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval,
-                          precond=precond)
+                          precond=precond, fan_value=fan)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "solver_config"))
@@ -100,7 +107,10 @@ def fit_segment_core(
     — the knob TpuBackend(iter_segment=...) exposes."""
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
-    return lbfgs.run_segment(fun, state, solver_config, num_iters, fun_value=fval)
+    fan = (lambda th, d, s: fan_value_linear(th, d, s, data, config)) \
+        if is_linear_additive(config) else None
+    return lbfgs.run_segment(fun, state, solver_config, num_iters,
+                             fun_value=fval, fan_value=fan)
 
 
 class McmcState(NamedTuple):

@@ -184,6 +184,7 @@ def run_segment(
     config: SolverConfig,
     num_iters: Optional[int] = None,
     fun_value: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
+    fan_value=None,
 ) -> LbfgsState:
     """Advance the solver by up to ``num_iters`` iterations (bounded by
     ``config.max_iters`` overall).
@@ -194,6 +195,13 @@ def run_segment(
     lets a driver split one logical solve into several short XLA executions
     (bounded per-dispatch time for fragile runtimes, preemption points for
     elastic schedulers) without changing the mathematics.
+
+    ``fan_value``: optional ``(theta, direction, ladder (K, B)) -> (K, B)``
+    losses for the whole step ladder in one call.  When the objective is
+    linear in its parameters along a ray (Prophet linear-growth additive
+    models: loss.fan_value_linear) this replaces K stacked model
+    evaluations with closed-form reductions — the trial LOSSES are
+    identical to the stacked path up to float32 rounding.
     """
     if fun_value is None:
         fun_value = lambda th: fun(th)[0]
@@ -239,25 +247,41 @@ def run_segment(
         tiny = 1e-3 / jnp.maximum(gnorm, 1.0)
         fb_theta = state.theta - tiny[:, None] * pgrad
 
-        trials = jnp.concatenate(
-            [
-                state.theta[None] + ladder[:, :, None] * direction[None],
-                fb_theta[None],
-            ],
-            axis=0,
-        )  # (K+1, B, P)
-        f_all = jax.vmap(fun_value)(trials)  # (K+1, B)
-        f_trials, fb_f = f_all[:k_steps], f_all[k_steps]
+        if fan_value is not None:
+            # Closed-form ladder (linear-in-parameters objectives): no
+            # (K, B, P) trial stack is ever materialized; the fallback row
+            # is one direct evaluation, skipped entirely in the common
+            # all-accepted case.
+            f_trials = fan_value(state.theta, direction, ladder)  # (K, B)
+            fb_f = None
+        else:
+            trials = jnp.concatenate(
+                [
+                    state.theta[None] + ladder[:, :, None] * direction[None],
+                    fb_theta[None],
+                ],
+                axis=0,
+            )  # (K+1, B, P)
+            f_all = jax.vmap(fun_value)(trials)  # (K+1, B)
+            f_trials, fb_f = f_all[:k_steps], f_all[k_steps]
 
         ok = jnp.isfinite(f_trials) & (
             f_trials <= state.f[None] + config.ls_armijo_c1 * ladder * dg[None]
         )  # (K, B)
         accepted = jnp.any(ok, axis=0)
+        if fb_f is None:
+            fb_f = jax.lax.cond(
+                jnp.all(accepted | state.converged),
+                lambda: jnp.full_like(state.f, jnp.inf),
+                lambda: fun_value(fb_theta),
+            )
         first = jnp.argmax(ok, axis=0)  # first True = largest accepted step
         bidx = jnp.arange(b)
         step_out = ladder[first, bidx]
         new_theta = jnp.where(
-            accepted[:, None], trials[first, bidx], state.theta
+            accepted[:, None],
+            state.theta + step_out[:, None] * direction,
+            state.theta,
         )
         new_f = jnp.where(accepted, f_trials[first, bidx], state.f)
 
@@ -348,6 +372,7 @@ def minimize(
     config: SolverConfig = SolverConfig(),
     fun_value: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
     precond: Optional[jnp.ndarray] = None,
+    fan_value=None,
 ) -> LbfgsResult:
     """Minimize a batch of independent objectives with shared compute.
 
@@ -357,6 +382,7 @@ def minimize(
       fun_value: optional value-only objective for line-search trials
         (defaults to ``fun(th)[0]``, which wastes the gradient).
       precond: optional (B, P) inverse-curvature diagonal (initial metric).
+      fan_value: optional closed-form ladder evaluator (see run_segment).
 
     Returns:
       LbfgsResult with per-series optimum, loss, grad inf-norm, convergence
@@ -365,6 +391,6 @@ def minimize(
     return to_result(
         run_segment(
             fun, init_state(fun, theta0, config, precond), config,
-            fun_value=fun_value,
+            fun_value=fun_value, fan_value=fan_value,
         )
     )
