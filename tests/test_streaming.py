@@ -154,13 +154,18 @@ class _FakeConsumer:
     def __init__(self, batches):
         self._batches = list(batches)
         self.poll_kwargs = []
+        self.events = []  # interleaved "poll"/"commit" order
 
     def poll(self, timeout_ms=None, max_records=None):
         self.poll_kwargs.append((timeout_ms, max_records))
+        self.events.append("poll")
         if not self._batches:
             return {}
         rows = self._batches.pop(0)
         return {("topic", 0): [_FakeMsg(r) for r in rows]}
+
+    def commit(self):
+        self.events.append("commit")
 
 
 def test_kafka_source_fake_consumer_drives_streaming():
@@ -182,6 +187,10 @@ def test_kafka_source_fake_consumer_drives_streaming():
     assert src.poll() is None                # drained
     fc = sf.forecast(["k0"], horizon=7, num_samples=0)
     assert np.isfinite(fc.yhat.to_numpy()).all()
+    # At-least-once contract: the driver commits offsets AFTER each applied
+    # refit — one commit for the one batch sf.run processed, and none for
+    # the empty terminating poll.
+    assert consumer.events == ["poll", "poll", "commit", "poll", "poll"]
 
 
 def test_param_store_meta_float64_hourly_precision():

@@ -135,6 +135,9 @@ class StreamingForecaster:
         n = 0
         for batch in source:
             self.process(batch)
+            # At-least-once: acknowledge offsets only once the refit has
+            # landed in the store (see MicroBatchSource.commit).
+            source.commit()
             n += 1
             if max_batches is not None and n >= max_batches:
                 break

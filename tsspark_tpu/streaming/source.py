@@ -25,6 +25,16 @@ class MicroBatchSource(abc.ABC):
     def poll(self) -> Optional[pd.DataFrame]:
         """Next micro-batch, or None if the stream is (currently) dry."""
 
+    def commit(self) -> None:
+        """Acknowledge the most recent ``poll``'s batch as durably applied.
+
+        The streaming driver calls this AFTER the refit has landed in the
+        parameter store, giving at-least-once delivery: a crash between
+        poll and commit replays the batch, and replays are idempotent
+        (history appends dedup by (series, ds); the refit recomputes the
+        same parameters).  Default no-op for sources with no offsets.
+        """
+
     def __iter__(self):
         while (batch := self.poll()) is not None:
             yield batch
@@ -88,3 +98,10 @@ class KafkaSource(MicroBatchSource):
         if not rows:
             return None
         return pd.DataFrame(rows)
+
+    def commit(self) -> None:
+        """Commit consumer offsets for everything polled so far (the
+        driver invokes this only after the refit is durably applied)."""
+        commit = getattr(self._consumer, "commit", None)
+        if commit is not None:
+            commit()
