@@ -12,14 +12,22 @@ buffers over ~64 MB kill it, and the envelope shrinks after a crash).  A
 dead worker takes the whole JAX client with it, so the benchmark is split
 into processes:
 
-  parent (this file, no JAX)  — generates data once to .npy files, spawns
-                                fit workers, retries crashed ranges with a
-                                halved chunk size, resumes from completed
-                                per-chunk result files, then runs a CPU eval
+  parent (this file, no JAX)  — caches generated data across runs keyed by
+                                shape, spawns fit workers, retries crashed
+                                ranges (halving the chunk only when a
+                                phase-1 attempt made zero progress), resumes
+                                from completed per-chunk result files,
+                                watches per-dispatch heartbeats so long
+                                compiles / the chunk-less phase-2 pass are
+                                not killed as stalls, then runs a CPU eval
                                 worker and prints the ONE summary JSON line.
-  --_fit child (TPU)          — fits [lo, hi) in chunks, saving each chunk's
-                                FitState + timing to disk the moment it
-                                completes, so a crash loses at most a chunk.
+  --_fit child (TPU)          — phase 1: every chunk at a short lockstep
+                                depth (prefetching the next chunk's host
+                                prep), saved as it lands; phase 2: the
+                                unconverged tail across ALL chunks is
+                                compacted into one batch, finished at full
+                                depth with the GN-diagonal metric, and the
+                                chunk files patched in place (idempotent).
   --_eval child (CPU)         — in-sample sMAPE on a subsample from the
                                 saved states (accuracy gate, not the metric).
 
