@@ -75,8 +75,8 @@ def test_plot_cross_validation_metric(tmp_path):
     n = 60
     cv = pd.DataFrame({
         "series_id": "s0",
-        "ds": np.tile(np.arange(10.0, 10.0 + n / 3), 3),
-        "cutoff": np.repeat([9.0, 8.0, 7.0], n / 3),
+        "ds": np.tile(np.arange(10.0, 10.0 + n // 3), 3),
+        "cutoff": np.repeat([9.0, 8.0, 7.0], n // 3),
         "y": rng.normal(10, 1, n),
         "yhat": rng.normal(10, 1, n),
         "yhat_lower": np.full(n, 5.0),
