@@ -124,3 +124,20 @@ def test_regressor_coefficients_recover_known_effect():
     assert set(out.columns) == {"series_id", "regressor", "mode", "coef"}
     assert out.shape[0] == 1
     np.testing.assert_allclose(out["coef"].iloc[0], 2.5, rtol=0.05)
+
+
+def test_fit_prophet_compat_namespace():
+    """The reference's module path survives the rename: tsspark.fit.prophet
+    -> tsspark_tpu.fit.prophet (BASELINE.json:5)."""
+    from tsspark_tpu.fit import prophet
+
+    assert prophet.ProphetModel is not None
+    rng = np.random.default_rng(0)
+    n = 80
+    model = prophet.ProphetModel(
+        prophet.ProphetConfig(seasonalities=(), n_changepoints=2),
+        prophet.SolverConfig(max_iters=30),
+    )
+    y = (5 + 0.1 * np.arange(n) + rng.normal(0, 0.2, (1, n))).astype(np.float32)
+    state = model.fit(jnp.arange(float(n)), jnp.asarray(y))
+    assert np.isfinite(float(state.loss[0]))

@@ -1,0 +1,6 @@
+"""Compatibility namespace mirroring the reference's ``tsspark.fit`` package
+path (the driver north star names ``tsspark.fit.prophet`` as the module a
+reference user knows; BASELINE.json:5).  Everything here is an alias onto
+the canonical modules under ``tsspark_tpu.models.prophet``."""
+
+from tsspark_tpu.fit import prophet  # noqa: F401
