@@ -150,3 +150,31 @@ def test_cli_forecast_and_backtest(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     pm = pd.read_csv(tmp_path / "pm.csv")
     assert {"horizon", "smape", "rmse"} <= set(pm.columns)
+
+
+@pytest.mark.slow
+def test_cli_auto_seasonality_flag(tmp_path):
+    # 100 daily points: the auto rule resolves to WEEKLY ONLY, which differs
+    # from the CLI's yearly+weekly default — a silently ignored flag would
+    # produce a different (larger) fitted config, caught below.
+    rng = np.random.default_rng(4)
+    n = 100
+    t = np.arange(n, dtype=float)
+    df = pd.DataFrame({
+        "series_id": "s0",
+        "ds": pd.date_range("2020-01-01", periods=n, freq="D"),
+        "y": 7 + 2 * np.sin(2 * np.pi * t / 7) + rng.normal(0, 0.1, n),
+    })
+    df.to_csv(tmp_path / "input.csv", index=False)
+    r = _run_cli([
+        "fit", "--input", "input.csv", "--auto-seasonality",
+        "--n-changepoints", "5", "--max-iters", "60",
+        "--model", "model.npz",
+    ], tmp_path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    from tsspark_tpu.utils import checkpoint
+
+    fc = checkpoint.load_forecaster(str(tmp_path / "model.npz"))
+    assert tuple(s.name for s in fc.config.seasonalities) == ("weekly",)
+    out = fc.predict(horizon=7)
+    assert len(out) == 7 and np.isfinite(out["yhat"]).all()

@@ -83,6 +83,7 @@ def _build_forecaster(args, df=None):
         regressor_cols=tuple(args.regressor),
         cap_col="cap" if args.growth == "logistic" else None,
         solver_config=SolverConfig(max_iters=args.max_iters),
+        auto_seasonality=args.auto_seasonality,
     )
 
 
@@ -103,6 +104,9 @@ def _add_model_args(p: argparse.ArgumentParser) -> None:
                    help="repeatable external regressor column name")
     p.add_argument("--country-holidays", default=None, metavar="CC",
                    help="ISO country code for a computed holiday calendar")
+    p.add_argument("--auto-seasonality", action="store_true",
+                   help="choose yearly/weekly/daily from the observed "
+                        "calendar at fit time (overrides --seasonality)")
     p.add_argument("--max-iters", type=int, default=200)
 
 

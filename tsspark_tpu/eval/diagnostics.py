@@ -69,6 +69,9 @@ def cross_validation(
         floor_col=fc.floor_col, regressor_cols=fc.regressor_cols,
     )
     b = batch.y.shape[0]
+    # auto_seasonality resolves from the full observed calendar, exactly as
+    # a fit() on this frame would (the per-cutoff fits below share a config).
+    fc._resolve_auto_seasonality(batch.ds)
     reg = fc._combined_regressors(batch.ds, batch.regressors, b)
 
     cv = backtest.cross_validation(

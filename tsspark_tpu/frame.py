@@ -225,16 +225,7 @@ class Forecaster:
         self._train_ds = batch.ds
         diffs = np.diff(batch.ds)
         self._freq_days = float(np.median(diffs)) if len(diffs) else 1.0
-        if self.auto_seasonality:
-            from tsspark_tpu.models.prophet import seasonality as seas_mod
-            import dataclasses as _dc
-
-            self.config = _dc.replace(
-                self.config,
-                seasonalities=seas_mod.auto_seasonalities(batch.ds),
-            )
-            name, solver, kwargs = self._backend_ctor
-            self.backend = get_backend(name, self.config, solver, **kwargs)
+        self._resolve_auto_seasonality(batch.ds)
         reg, conditions = self._split_conditions(batch.regressors, cond_names)
         reg = self._combined_regressors(
             batch.ds, reg, len(batch.series_ids)
@@ -260,6 +251,25 @@ class Forecaster:
                 **fit_kw,
             )
         return self
+
+    def _resolve_auto_seasonality(self, ds_days) -> None:
+        """Apply Prophet's auto-seasonality rule to the observed calendar
+        and rebuild the backend with the resolved config.  Called by fit()
+        AND by eval.diagnostics.cross_validation (which fits per-cutoff
+        models from the config directly) so the flag means the same model
+        everywhere."""
+        if not self.auto_seasonality:
+            return
+        import dataclasses as _dc
+
+        from tsspark_tpu.models.prophet import seasonality as seas_mod
+
+        self.config = _dc.replace(
+            self.config,
+            seasonalities=seas_mod.auto_seasonalities(ds_days),
+        )
+        name, solver, kwargs = self._backend_ctor
+        self.backend = get_backend(name, self.config, solver, **kwargs)
 
     def _split_conditions(self, reg, cond_names):
         """Separate pivoted condition columns (appended after the user's
