@@ -98,3 +98,32 @@ def test_warm_start_beats_cold_under_budget():
     tol = 8 * np.finfo(np.float32).eps * abs(float(full.loss[0])) + 1e-4
     assert float(warm.loss[0]) <= float(full.loss[0]) + tol
     assert float(warm.loss[0]) <= float(cold.loss[0]) + tol
+
+
+def test_logistic_fit_with_floor_saturates_in_band():
+    """Logistic growth with a nonzero floor: the fitted curve and forecasts
+    must live in [floor, cap] and track a saturating series."""
+    rng = np.random.default_rng(11)
+    n = 300
+    t = np.arange(float(n))
+    floor, cap = 200.0, 1000.0
+    true = floor + (cap - floor) / (1.0 + np.exp(-0.03 * (t - 120)))
+    y = (true + rng.normal(0, 10.0, n)).astype(np.float32)
+
+    cfg = ProphetConfig(growth="logistic", seasonalities=(), n_changepoints=5)
+    model = ProphetModel(cfg, SolverConfig(max_iters=200))
+    state = model.fit(
+        jnp.asarray(t), jnp.asarray(y[None, :]),
+        cap=jnp.full((1, n), cap), floor=jnp.asarray([floor]),
+    )
+    fut = np.arange(float(n), float(n) + 60)
+    fc = model.predict(state, jnp.asarray(fut), cap=jnp.full((1, 60), cap))
+    yhat = np.asarray(fc["yhat"])[0]
+    assert np.all(yhat >= floor - 25.0) and np.all(yhat <= cap + 25.0)
+    # Far future approaches the cap (the series saturated during training).
+    assert yhat[-1] > 0.9 * cap
+    # In-sample accuracy near the noise level.
+    ins = np.asarray(model.predict(
+        state, jnp.asarray(t), cap=jnp.full((1, n), cap)
+    )["yhat"])[0]
+    assert np.abs(ins - true).mean() < 25.0
