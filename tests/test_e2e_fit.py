@@ -92,7 +92,7 @@ def test_warm_start_beats_cold_under_budget():
     cold = budget.fit(batch.ds, y)
     # Armijo acceptance means warm can only improve on the converged loss —
     # up to a few float32 ulps of the objective: the closed-form ladder
-    # (loss.fan_value_linear) reports accepted losses that can differ from
+    # (loss.fan_value_closed_form) reports accepted losses that can differ from
     # direct evaluation by ~1-2 ulps, and at |loss| ~ 2000 one ulp is
     # ~1.2e-4, so a fixed 1e-4 margin is BELOW representational noise.
     tol = 8 * np.finfo(np.float32).eps * abs(float(full.loss[0])) + 1e-4

@@ -196,13 +196,13 @@ def test_diag_precond_speeds_ill_conditioned_batch():
 
 def test_closed_form_fan_matches_stacked_trials():
     """For linear-growth additive models the closed-form ladder losses
-    (loss.fan_value_linear) must equal evaluating each trial directly, to
+    (loss.fan_value_closed_form) must equal evaluating each trial directly, to
     float32 rounding — and the resulting full fit must match the stacked
     path's optimum."""
     from tsspark_tpu.config import ProphetConfig, RegressorConfig, SeasonalityConfig
     from tsspark_tpu.models.prophet.design import prepare_fit_data
     from tsspark_tpu.models.prophet.loss import (
-        fan_value_linear, is_linear_additive, value_batch,
+        fan_value_closed_form, has_closed_form_fan, value_batch,
     )
     from tsspark_tpu.models.prophet.model import ProphetModel
     from tsspark_tpu.models.prophet.init import initial_theta
@@ -212,7 +212,7 @@ def test_closed_form_fan_matches_stacked_trials():
         regressors=(RegressorConfig("price"),),
         n_changepoints=6,
     )
-    assert is_linear_additive(cfg)
+    assert has_closed_form_fan(cfg)
     rng = np.random.default_rng(21)
     b, n = 5, 240
     t = np.arange(float(n))
@@ -229,23 +229,42 @@ def test_closed_form_fan_matches_stacked_trials():
     ladder = jnp.asarray(
         (0.5 ** np.arange(8))[:, None] * np.ones((1, b)), jnp.float32
     )
-    closed = fan_value_linear(theta, direction, ladder, data, cfg)
+    closed = fan_value_closed_form(theta, direction, ladder, data, cfg)
     direct = jax.vmap(
         lambda s: value_batch(theta + s[:, None] * direction, data, cfg)
     )(ladder)
     np.testing.assert_allclose(
         np.asarray(closed), np.asarray(direct), rtol=2e-4, atol=2e-3
     )
-    # Ineligible configs must not take the closed-form path.
-    assert not is_linear_additive(
-        ProphetConfig(growth="logistic", seasonalities=())
-    )
-    assert not is_linear_additive(ProphetConfig(
+    # Multiplicative features stay eligible (quadratic-in-step closed form);
+    # non-linear growth does not.
+    cfg_m = ProphetConfig(
         seasonalities=(SeasonalityConfig("weekly", 7.0, 2,
                                          mode="multiplicative"),),
-    ))
+        n_changepoints=4,
+    )
+    assert has_closed_form_fan(cfg_m)
+    data_m, _ = prepare_fit_data(jnp.arange(float(n)), jnp.asarray(y), cfg_m)
+    theta_m = initial_theta(data_m, cfg_m, SolverConfig())
+    dir_m = jnp.asarray(
+        rng.normal(0, 0.1, theta_m.shape).astype(np.float32)
+    )
+    lad_m = jnp.asarray(
+        (0.5 ** np.arange(6))[:, None] * np.ones((1, b)), jnp.float32
+    )
+    closed_m = fan_value_closed_form(theta_m, dir_m, lad_m, data_m, cfg_m)
+    direct_m = jax.vmap(
+        lambda sv: value_batch(theta_m + sv[:, None] * dir_m, data_m, cfg_m)
+    )(lad_m)
+    np.testing.assert_allclose(
+        np.asarray(closed_m), np.asarray(direct_m), rtol=2e-4, atol=2e-3
+    )
+    assert not has_closed_form_fan(
+        ProphetConfig(growth="logistic", seasonalities=())
+    )
     # End-to-end: the fit through the closed-form search reaches the same
-    # optimum as forcing the stacked path (multiplicative flag flips it).
+    # optimum as the stacked path (forced by calling minimize without
+    # fan_value).
     model = ProphetModel(cfg, SolverConfig(max_iters=150))
     st = model.fit(jnp.arange(float(n)), jnp.asarray(y), regressors=jnp.asarray(reg))
     assert bool(st.converged.all())

@@ -28,7 +28,12 @@ import jax
 import jax.numpy as jnp
 
 from tsspark_tpu.config import ProphetConfig
-from tsspark_tpu.models.prophet.design import FitData, model_yhat
+from tsspark_tpu.models.prophet.design import (
+    FitData,
+    model_yhat,
+    seasonal_split,
+    trend_fn,
+)
 from tsspark_tpu.models.prophet.params import unpack
 
 _HUBER_EPS = 1e-4
@@ -51,7 +56,7 @@ def neg_log_posterior(
 ) -> jnp.ndarray:
     """Per-series negative log posterior, shape (B,).
 
-    NOTE: ``fan_value_linear`` re-derives every term below in closed form
+    NOTE: ``fan_value_closed_form`` re-derives every term below in closed form
     along a search ray — any change here (new prior, likelihood tweak)
     must be mirrored there or linear-additive fits will line-search
     against a stale objective.
@@ -89,14 +94,16 @@ def value_batch(theta: jnp.ndarray, data: FitData, config: ProphetConfig):
     return neg_log_posterior(theta, data, config)
 
 
-def is_linear_additive(config: ProphetConfig) -> bool:
-    """True when yhat is LINEAR in every parameter it depends on: linear
-    growth and purely additive features.  The line-search fan then has a
-    closed form (fan_value_linear)."""
-    return config.growth == "linear" and not any(config.feature_modes())
+def has_closed_form_fan(config: ProphetConfig) -> bool:
+    """True when the line-search fan has a closed form along a ray: linear
+    growth (any feature modes — additive features make yhat linear in the
+    step, multiplicative ones quadratic; both are exactly summable, see
+    fan_value_closed_form).  Logistic/flat growth is not polynomial in the
+    trend parameters, so those configs use the stacked fan."""
+    return config.growth == "linear"
 
 
-def fan_value_linear(
+def fan_value_closed_form(
     theta: jnp.ndarray,      # (B, P) current point
     direction: jnp.ndarray,  # (B, P) search direction
     ladder: jnp.ndarray,     # (K, B) candidate step sizes
@@ -105,43 +112,60 @@ def fan_value_linear(
 ) -> jnp.ndarray:
     """Closed-form losses (K, B) for the whole Armijo step ladder.
 
-    For linear growth with additive features ``yhat`` is a LINEAR map of
+    For linear growth the trend and both feature totals are LINEAR maps of
     the parameters (sigma enters only the likelihood), so along a search
-    ray ``theta + s*d``:
+    ray ``theta + s*d`` the model mean is an exact polynomial in ``s``:
 
-        yhat(theta + s d) = yhat(theta) + s * yhat(d)
+        yhat(theta + s d) = (g0 + s gd) * (1 + m0 + s md) + a0 + s ad
+                          = c0 + s c1 + s^2 c2,   c2 = gd * md
 
-    and the masked sum of squares expands into THREE reductions computed
-    once (S0, S1, S2 below); every Gaussian prior is quadratic in ``s``
-    (three more scalars), sigma terms are exact per step, and only the
-    smoothed Laplace prior needs a per-step evaluation — over (K, B, n_cp),
-    a few thousandths of the (B, T) grid.  The entire K-step line search
-    costs TWO model evaluations instead of K+1: this is the difference
-    between the solver being line-search-bound and gradient-bound, and it
-    is exact (same float32 noise floor as evaluating each trial directly —
-    validated against the stacked fan in tests/test_lbfgs.py).
+    (purely additive configs have m0 = md = 0, collapsing to the linear
+    case).  The masked sum of squares then expands into SIX reductions
+    computed once; every Gaussian prior is quadratic in ``s``, sigma terms
+    are exact per step, and only the smoothed Laplace prior needs per-step
+    work — over (K, B, n_cp), a few thousandths of the (B, T) grid.  The
+    entire K-step line search costs TWO model evaluations instead of K+1:
+    this is the difference between the solver being line-search-bound and
+    gradient-bound, and it matches evaluating each trial directly to
+    float32 rounding (tests/test_lbfgs.py).
     """
     p0 = unpack(theta, config)
     pd = unpack(direction, config)
-    yhat0, _ = model_yhat(theta, data, config)
-    ydir, _ = model_yhat(direction, data, config)  # linear map of d
+    g0 = trend_fn(p0, data, config)
+    gd = trend_fn(pd, data, config)        # linear map of d's trend block
+    a0, m0 = seasonal_split(theta, data, config)
+    ad, md = seasonal_split(direction, data, config)
 
     mask = data.mask
-    r = (data.y - yhat0) * mask
-    dirm = ydir * mask
-    s0 = jnp.sum(r * r, axis=-1)        # (B,)
-    s1 = jnp.sum(r * dirm, axis=-1)
-    s2 = jnp.sum(dirm * dirm, axis=-1)
+    c0 = g0 * (1.0 + m0) + a0
+    c1 = gd * (1.0 + m0) + g0 * md + ad
+    c2 = gd * md
+    r0 = (data.y - c0) * mask
+    c1m = c1 * mask
+    c2m = c2 * mask
+    s00 = jnp.sum(r0 * r0, axis=-1)       # (B,)
+    s01 = jnp.sum(r0 * c1m, axis=-1)
+    s02 = jnp.sum(r0 * c2m, axis=-1)
+    s11 = jnp.sum(c1m * c1m, axis=-1)
+    s12 = jnp.sum(c1m * c2m, axis=-1)
+    s22 = jnp.sum(c2m * c2m, axis=-1)
     n_obs = mask.sum(axis=-1)
 
-    s = ladder                           # (K, B)
+    s = ladder                             # (K, B)
+    s2_ = s * s
     sigma = _SIGMA_FLOOR + jnp.exp(p0.log_sigma[None] + s * pd.log_sigma[None])
-    # The true sum of squares is >= 0 by construction; the expanded form
-    # can go slightly negative from f32 cancellation when a step nearly
-    # zeroes the residual, and 1/sigma^2 would amplify that into a falsely
-    # negative loss the direct evaluation could never produce.
+    # Sum of squares of (r0 - s c1 - s^2 c2): exact polynomial in s.  The
+    # true value is >= 0 by construction; the expanded form can go slightly
+    # negative from f32 cancellation when a step nearly zeroes the residual,
+    # and 1/sigma^2 would amplify that into a falsely negative loss the
+    # direct evaluation could never produce.
     ssr = jnp.maximum(
-        s0[None] - 2.0 * s * s1[None] + s * s * s2[None], 0.0
+        s00[None]
+        - 2.0 * s * s01[None]
+        + s2_ * (s11[None] - 2.0 * s02[None])
+        + 2.0 * s * s2_ * s12[None]
+        + s2_ * s2_ * s22[None],
+        0.0,
     )
     nll = 0.5 * ssr / (sigma * sigma) + n_obs[None] * jnp.log(sigma)
 

@@ -197,10 +197,10 @@ def run_segment(
     elastic schedulers) without changing the mathematics.
 
     ``fan_value``: optional ``(theta, direction, ladder (K, B)) -> (K, B)``
-    losses for the whole step ladder in one call.  When the objective is
-    linear in its parameters along a ray (Prophet linear-growth additive
-    models: loss.fan_value_linear) this replaces K stacked model
-    evaluations with closed-form reductions — the trial LOSSES are
+    losses for the whole step ladder in one call.  When the model mean is
+    polynomial in the step along a ray (Prophet linear-growth models of
+    any feature mode: loss.fan_value_closed_form) this replaces K stacked
+    model evaluations with closed-form reductions — the trial LOSSES are
     identical to the stacked path up to float32 rounding.
     """
     if fun_value is None:

@@ -32,8 +32,8 @@ from tsspark_tpu.config import ProphetConfig, ShardingConfig, SolverConfig
 from tsspark_tpu.models.prophet.design import FitData
 from tsspark_tpu.models.prophet.init import curvature_diag, initial_theta
 from tsspark_tpu.models.prophet.loss import (
-    fan_value_linear,
-    is_linear_additive,
+    fan_value_closed_form,
+    has_closed_form_fan,
     value_and_grad_batch,
     value_batch,
 )
@@ -83,8 +83,8 @@ def _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg):
                if solver_config.precond == "gn_diag" else None)
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
-    fan = (lambda th, d, s: fan_value_linear(th, d, s, data, config)) \
-        if is_linear_additive(config) else None
+    fan = (lambda th, d, s: fan_value_closed_form(th, d, s, data, config)) \
+        if has_closed_form_fan(config) else None
     return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval,
                           precond=precond, fan_value=fan)
 
