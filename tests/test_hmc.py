@@ -72,7 +72,7 @@ def test_prophet_mcmc_posterior_predictive():
     assert state.samples.shape[:2] == (200, y.shape[0])
     assert np.all(np.asarray(state.accept_rate) > 0.4)
 
-    horizon = jnp.arange(160, 200, dtype=jnp.float64)
+    horizon = np.arange(160, 200, dtype=np.float64)
     out = model.predict_mcmc(state, horizon, max_draws=100)
     yhat = np.asarray(out["yhat"])
     lo, hi = np.asarray(out["yhat_lower"]), np.asarray(out["yhat_upper"])
