@@ -172,8 +172,9 @@ def fit_worker(args) -> int:
         chunk_size=args.chunk, iter_segment=args.segment or None,
         on_segment=heartbeat,
     )
-    phase1 = backend if not args.phase1_iters \
-        else backend._phase1(args.phase1_iters)
+    # phase1 depth >= full depth degenerates to a single-phase run.
+    two_phase = 0 < args.phase1_iters < args.max_iters
+    phase1 = backend._phase1(args.phase1_iters) if two_phase else backend
 
     # Phase 1 drives the model layer directly with a one-deep prefetch:
     # chunk N+1's host-side design build (~1.4 s of numpy) runs while chunk
@@ -225,7 +226,7 @@ def fit_worker(args) -> int:
                 }) + "\n")
 
     # ---- phase 2: compacted straggler pass over the whole series range ----
-    if not args.phase1_iters:
+    if not two_phase:
         return 0
     done = _completed_ranges(args.out)
     if _missing_ranges(done, args.series):
@@ -735,8 +736,11 @@ def main() -> None:
     check_tunnel = os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
     while True:
         missing = _missing_ranges(_completed_ranges(args._out_dir), args.series)
-        phase2_pending = args.phase1_iters and not os.path.exists(
-            os.path.join(args._out_dir, "phase2_done")
+        phase2_pending = (
+            0 < args.phase1_iters < args.max_iters
+            and not os.path.exists(
+                os.path.join(args._out_dir, "phase2_done")
+            )
         )
         if not missing and not phase2_pending:
             break
