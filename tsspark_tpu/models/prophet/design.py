@@ -9,7 +9,8 @@ and evaluate the model as a handful of large fused ops:
   * seasonal component — ``(B, Fs) @ (Fs, T)`` matmul (MXU) when the batch
     shares a calendar grid, batched matmul otherwise;
   * regressor component — small batched einsum (per-series covariates);
-  * trend — cumsum + gather (see trend.py), VPU-bound, O(B*T).
+  * trend — fused compare-multiply-reduce over the changepoint axis (see
+    trend.py; gather-free), VPU-bound, O(B*T) HBM traffic.
 
 Everything is a NamedTuple of arrays so it jits, vmaps, and shards cleanly.
 """
