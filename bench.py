@@ -5,6 +5,9 @@ sMAPE parity vs CPU".  Target: all 30,490 series in < 60 s on a TPU v5e-8
 (BASELINE.json:5).  This machine exposes ONE v5e chip, so the printed
 ``vs_baseline`` is target_seconds / measured_seconds on a single chip —
 values >= 1.0 mean the 8-chip target is beaten with 1/8th of the hardware.
+``extra.vs_chip_seconds_budget`` additionally reports the chip-second
+framing (480 chip-s budget / single-chip seconds spent) — an extrapolation
+over the embarrassingly-parallel series axis, kept out of the headline.
 
 Resilience: the single TPU chip sits behind an experimental stdio-tunneled
 relay whose worker can crash on large programs (observed: single input
@@ -54,7 +57,8 @@ from typing import Optional
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
-TARGET_S = 60.0
+TARGET_S = 60.0        # driver target: 60 s on a v5e-8 (BASELINE.json:5)
+TARGET_CHIPS = 8       # ... which is a 480 chip-second budget
 MIN_CHUNK = 512
 # Total wall budget.  The driver harness kills the whole process on ITS
 # timeout (observed ~20 min); staying under it is the only way the summary
@@ -149,8 +153,8 @@ def fit_worker(args) -> int:
     from tsspark_tpu.backends.registry import get_backend
     from tsspark_tpu.backends.tpu import patch_state
     from tsspark_tpu.config import SolverConfig
-    from tsspark_tpu.models.prophet.design import ScalingMeta
-    from tsspark_tpu.models.prophet.model import FitState
+    from tsspark_tpu.models.prophet.design import ScalingMeta, pack_fit_data
+    from tsspark_tpu.models.prophet.model import FitState, fit_core_packed
 
     ds = np.load(os.path.join(args.data, "ds.npy"))
     y = np.load(os.path.join(args.data, "y.npy"), mmap_mode="r")
@@ -176,15 +180,36 @@ def fit_worker(args) -> int:
     two_phase = 0 < args.phase1_iters < args.max_iters
     phase1 = backend._phase1(args.phase1_iters) if two_phase else backend
 
-    # Phase 1 drives the model layer directly with a one-deep prefetch:
-    # chunk N+1's host-side design build (~1.4 s of numpy) runs while chunk
-    # N occupies the device, taking prep off the critical path.  Chunks are
-    # padded to the full chunk size with inert all-masked rows (same
-    # convention as TpuBackend._fit_padded) so every fit hits one compiled
-    # shape.
+    # Phase 1 drives the model layer directly with a bounded prefetch pool:
+    # upcoming chunks' host-side design builds (~0.6-1.4 s of numpy each)
+    # run while earlier chunks occupy the device.  Device time per chunk is
+    # now ~0.6 s (gather-free trend), so a one-deep prefetch left prep on
+    # the critical path every other chunk (measured alternating 0.6 s /
+    # 2.2 s chunk walls); two prep workers and a three-deep window keep the
+    # device continuously fed while bounding buffered chunks (~60 MB each).
+    # Chunks are padded to the full chunk size with inert all-masked rows
+    # (same convention as TpuBackend._fit_padded) so every fit hits one
+    # compiled shape.
     from concurrent.futures import ThreadPoolExecutor
 
     model = phase1._model
+
+    # Segmented mode (--segment < phase-1 depth) keeps the FitData path:
+    # per-segment dispatches with a heartbeat after each, for runs where
+    # bounding single-dispatch time matters more than transfer bytes.
+    # Default mode runs each chunk as ONE packed-transfer program.
+    segmented = bool(
+        phase1.iter_segment
+        and phase1.iter_segment < model.solver_config.max_iters
+    )
+    # Indicator-column split for the packed path, decided ONCE on the full
+    # dataset: per-chunk auto-detection would let a chunk whose continuous
+    # column is coincidentally all-0/1 flip the static argument and
+    # silently recompile mid-run.
+    u8_cols = tuple(
+        j for j in range(reg.shape[-1])
+        if bool(np.all((reg[..., j] == 0.0) | (reg[..., j] == 1.0)))
+    )
 
     def prep(lo: int, hi: int):
         b_real = hi - lo
@@ -194,8 +219,18 @@ def fit_worker(args) -> int:
         y_c[:b_real] = y[lo:hi]
         m_c[:b_real] = mask[lo:hi]
         r_c[:b_real] = reg[lo:hi]
-        data, meta = model.prepare(ds, y_c, mask=m_c, regressors=r_c)
-        return lo, hi, b_real, data, meta
+        # as_numpy: a prep thread must not issue device transfers — on the
+        # single-chip tunnel they queue behind the in-flight fit program
+        # and re-serialize the pipeline the prefetch exists to overlap.
+        # pack_fit_data then cuts the shipped bytes ~2.5x (uint8 mask,
+        # device-side t reconstruction, elided cap; design.PackedFitData).
+        data, meta = model.prepare(
+            ds, y_c, mask=m_c, regressors=r_c, as_numpy=True
+        )
+        if segmented:
+            return lo, hi, b_real, data, meta
+        packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols)
+        return lo, hi, b_real, packed, meta
 
     todo = []
     for lo in range(args.lo, args.hi, args.chunk):
@@ -204,24 +239,65 @@ def fit_worker(args) -> int:
             os.path.join(args.out, f"chunk_{lo:06d}_{hi:06d}.npz")
         ):
             todo.append((lo, hi))
-    with ThreadPoolExecutor(max_workers=1) as pool:
-        fut = pool.submit(prep, *todo[0]) if todo else None
+    prefetch_depth = 3
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        futs = {
+            j: pool.submit(prep, *todo[j])
+            for j in range(min(prefetch_depth, len(todo)))
+        }
         for i in range(len(todo)):
             t0 = time.time()
-            lo, hi, b_real, data, meta = fut.result()
-            fut = pool.submit(prep, *todo[i + 1]) if i + 1 < len(todo) \
-                else None
-            state = model._fit_prepared(
-                data, meta, None, phase1.iter_segment,
-                on_segment=heartbeat,
-            )
-            jax.block_until_ready(state.theta)
-            state = jax.tree.map(lambda a: np.asarray(a)[:b_real], state)
+            lo, hi, b_real, payload, meta = futs.pop(i).result()
+            t_wait = time.time() - t0
+            nxt = i + prefetch_depth
+            if nxt < len(todo):
+                futs[nxt] = pool.submit(prep, *todo[nxt])
+            t1 = time.time()
+            payload = jax.tree.map(jax.device_put, payload)
+            jax.block_until_ready(jax.tree.leaves(payload))
+            t_put = time.time() - t1
+            t1 = time.time()
+            if segmented:
+                state = model._fit_prepared(
+                    payload, meta, None, phase1.iter_segment,
+                    on_segment=heartbeat,
+                )
+                jax.block_until_ready(state.theta)
+                t_dev = time.time() - t1
+                t1 = time.time()
+                state = jax.tree.map(
+                    lambda a: np.asarray(a)[:b_real], state
+                )
+            else:
+                theta, stats = fit_core_packed(
+                    payload, None, model.config, model.solver_config,
+                    reg_u8_cols=u8_cols,
+                )
+                jax.block_until_ready(theta)
+                heartbeat()
+                t_dev = time.time() - t1
+                t1 = time.time()
+                theta = np.asarray(theta)[:b_real]
+                stats = np.asarray(stats)[:, :b_real]
+                state = FitState(
+                    theta=theta,
+                    meta=jax.tree.map(
+                        lambda a: np.asarray(a)[:b_real], meta
+                    ),
+                    loss=stats[0],
+                    grad_norm=stats[1],
+                    converged=stats[2].astype(bool),
+                    n_iters=stats[3].astype(np.int32),
+                    status=stats[4].astype(np.int32),
+                )
             fit_s = time.time() - t0
             _save_chunk_atomic(args.out, lo, hi, state)
             with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
                 fh.write(json.dumps({
                     "lo": lo, "hi": hi, "fit_s": round(fit_s, 3),
+                    "wait_s": round(t_wait, 3), "put_s": round(t_put, 3),
+                    "dev_s": round(t_dev, 3),
+                    "read_s": round(time.time() - t1, 3),
                     "chunk": args.chunk, "device": str(jax.devices()[0]),
                 }) + "\n")
 
@@ -609,6 +685,19 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None):
     }
     if note:
         extra["note"] = note
+    # vs_baseline keeps the STRICT round-1/2 definition — 60 s target /
+    # measured single-chip seconds, i.e. >= 1.0 means the whole 8-chip
+    # target is beaten on one chip — so the headline stays conservative
+    # and comparable across rounds.  The chip-second framing (the 60 s
+    # v5e-8 target = 480 chip-seconds; the workload is embarrassingly
+    # parallel over series chunks, multi-chip path exercised by
+    # tests/test_sharding.py + dryrun_multichip) is reported alongside in
+    # ``extra`` — it is an extrapolation this one-chip machine cannot
+    # measure, so it must not be the headline ratio.
+    extra["chip_seconds_budget"] = TARGET_S * TARGET_CHIPS
+    extra["vs_chip_seconds_budget"] = (
+        round(TARGET_S * TARGET_CHIPS / projected, 3) if projected else 0.0
+    )
     return {
         "metric": f"m5_{args.series}x{args.days}_fit_wall_clock",
         "value": round(fit_s, 3),

@@ -136,3 +136,94 @@ def test_init_theta_reasonable():
     np.testing.assert_allclose(float(p.k[0]), 0.6, atol=1e-3)
     np.testing.assert_allclose(float(p.m[0]), 0.4, atol=1e-3)
     assert np.asarray(p.delta).shape == (1, 4)
+
+
+def _mixed_batch(b=6, t_len=120):
+    """Shared-grid batch with binary + continuous regressors and a masked-out
+    tail on one series (exercises every packed-transfer special case)."""
+    rng = np.random.default_rng(3)
+    ds = np.arange(t_len, dtype=np.float64) + 19000.0
+    promo = (rng.random((b, t_len, 1)) < 0.2).astype(np.float64)
+    price = rng.normal(3.0, 1.0, (b, t_len, 1))
+    reg = np.concatenate([promo, price], axis=-1)
+    y = 10 + 0.05 * np.arange(t_len) + 2 * promo[..., 0] + rng.normal(
+        0, 0.2, (b, t_len)
+    )
+    mask = np.ones((b, t_len))
+    mask[0, t_len // 2:] = 0.0
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        regressors=(
+            RegressorConfig("promo", standardize=False),
+            RegressorConfig("price"),
+        ),
+        n_changepoints=5,
+    )
+    return cfg, ds, y, mask, reg
+
+
+def test_packed_fit_data_roundtrip():
+    """pack_fit_data -> unpack_fit_data reproduces the prepared FitData:
+    bit-for-bit except t (reconstructed on device from per-series scalars,
+    allowed a few f32 ulps)."""
+    import jax
+
+    from tsspark_tpu.models.prophet.design import (
+        pack_fit_data,
+        unpack_fit_data,
+    )
+
+    cfg, ds, y, mask, reg = _mixed_batch()
+    data, meta = prepare_fit_data(
+        ds, y, cfg, mask=mask, regressors=reg, as_numpy=True
+    )
+    packed, u8_cols = pack_fit_data(data, meta, ds)
+    # Binary promo column (index 0) travels as uint8, continuous price as f32.
+    assert u8_cols == (0,)
+    assert packed.X_reg_u8.shape[-1] == 1
+    assert packed.X_reg.shape[-1] == 1
+    assert packed.mask_u8.dtype == np.uint8
+    assert packed.cap.shape[-1] == 1  # linear growth: cap not shipped
+
+    un = jax.jit(
+        unpack_fit_data, static_argnames=("reg_u8_cols",)
+    )(jax.tree.map(jnp.asarray, packed), reg_u8_cols=u8_cols)
+    for name in data._fields:
+        a = np.asarray(getattr(data, name))
+        b_ = np.asarray(getattr(un, name))
+        assert a.shape == b_.shape, name
+        tol = 5e-7 if name == "t" else 0.0
+        np.testing.assert_allclose(a, b_, atol=tol, err_msg=name)
+
+
+def test_fit_core_packed_matches_plain():
+    """The packed fit program lands on the same optima as the plain one
+    (identical inputs up to 1 ulp of t -> same in-sample accuracy; exact
+    per-iterate equality is not required of a chaotic 12-step solver)."""
+    import jax
+
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.models.prophet.design import pack_fit_data
+    from tsspark_tpu.models.prophet.model import (
+        fit_core,
+        fit_core_packed,
+    )
+
+    cfg, ds, y, mask, reg = _mixed_batch()
+    solver = SolverConfig(max_iters=60)
+    data, meta = prepare_fit_data(
+        ds, y, cfg, mask=mask, regressors=reg, as_numpy=True
+    )
+    packed, u8_cols = pack_fit_data(data, meta, ds)
+    theta_p, stats = fit_core_packed(
+        packed, None, cfg, solver, reg_u8_cols=u8_cols
+    )
+    res = fit_core(jax.tree.map(jnp.asarray, data), None, cfg, solver)
+    # Same objective value per series within float32 solver noise.
+    scale = np.maximum(np.abs(np.asarray(res.f)), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(stats[0]) / scale, np.asarray(res.f) / scale, atol=2e-3
+    )
+    # Packed stats rows carry exactly what LbfgsResult carries.
+    assert stats.shape == (5, y.shape[0])
+    assert set(np.asarray(stats[4]).astype(int).tolist()) <= {0, 1, 2, 3, 4}

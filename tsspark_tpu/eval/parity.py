@@ -269,7 +269,13 @@ def main():
                          "(full TPU batch vs oracle subsample)")
     ap.add_argument("--oracle-n", type=int, default=512)
     args = ap.parse_args()
-    result = {"scale": args.scale, "configs": run_parity(args.scale)}
+    import jax
+
+    result = {
+        "platform": str(jax.devices()[0]),
+        "scale": args.scale,
+        "configs": run_parity(args.scale),
+    }
     if args.config3_full:
         result["config3_bench_scale"] = run_config3_at_scale(
             oracle_n=args.oracle_n

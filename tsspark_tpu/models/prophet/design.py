@@ -63,6 +63,160 @@ class FitData(NamedTuple):
     mult_mask: jnp.ndarray    # (F,) 1.0 where the feature is multiplicative
 
 
+class PackedFitData(NamedTuple):
+    """Transfer-optimized FitData for shared-calendar batches.
+
+    On a tunneled single-chip runtime the host->device copy is the dominant
+    per-chunk cost once the fit itself is fast (measured round 3: ~56 MB and
+    0.7-1.4 s per 1024x1941 chunk vs 0.22 s of fit).  This form ships the
+    same information in ~40% of the bytes:
+
+      * ``mask_u8``: the 0/1 validity mask as uint8 (4x smaller; cast back
+        to f32 on device);
+      * ``t`` is not shipped at all — the (B, T) scaled-time grid is an
+        affine map of the SHARED calendar, reconstructed on device from the
+        (T,) relative grid and two (B,) per-series scalars (error ~1e-6 in
+        [0, 1] scaled units, far below the daily grid spacing ~5e-4);
+      * ``cap`` collapses to (B, 1) for non-logistic growth (it is all-ones
+        and unused by the trend there).
+
+    ``unpack_fit_data`` runs INSIDE the fit program, so the expansion costs
+    no extra dispatch and the expanded tensors never cross the tunnel.
+    """
+
+    y: jnp.ndarray            # (B, T) f32 scaled observations
+    mask_u8: jnp.ndarray      # (B, T) uint8 validity
+    ds_rel: jnp.ndarray       # (T,) f32 shared grid minus grid[0]
+    t_off: jnp.ndarray        # (B,) f32: (ds_start - grid[0]) / ds_span
+    t_inv_span: jnp.ndarray   # (B,) f32: 1 / ds_span
+    s: jnp.ndarray            # (B, n_cp) f32 changepoints (scaled time)
+    cap: jnp.ndarray          # (B, 1) f32, or (B, T) f32 for logistic
+    X_season: jnp.ndarray     # (T, Fs) or (B, T, Fs) f32
+    X_reg: jnp.ndarray        # (B, T, R - K) f32 non-indicator columns
+    X_reg_u8: jnp.ndarray     # (B, T, K) uint8 exact-0/1 indicator columns
+    prior_scales: jnp.ndarray
+    mult_mask: jnp.ndarray
+
+
+def _indicator_reg_cols(x_reg: np.ndarray) -> Tuple[int, ...]:
+    """Columns of (B, T, R) whose every value is exactly 0.0 or 1.0 —
+    holiday / promo style indicators that survive a uint8 round trip
+    bit-for-bit (unstandardized: prepare_fit_data's auto rule never rescales
+    binary columns, so post-prep values are still exact 0/1)."""
+    return tuple(
+        j for j in range(x_reg.shape[-1])
+        if bool(np.all((x_reg[..., j] == 0.0) | (x_reg[..., j] == 1.0)))
+    )
+
+
+def pack_fit_data(
+    data: FitData,
+    meta: ScalingMeta,
+    ds: np.ndarray,
+    reg_u8_cols: Optional[Tuple[int, ...]] = None,
+) -> Tuple[PackedFitData, Tuple[int, ...]]:
+    """Host-side (numpy) packing of an ``as_numpy=True`` prepared batch.
+
+    ``ds`` is the shared (T,) calendar grid in absolute days (float64: the
+    ds - ds[0] subtraction must happen before the f32 cast, same rationale
+    as ScalingMeta).  Requires a shared grid and an exact 0/1 mask (the
+    uint8 transit would silently DROP fractionally-weighted observations
+    instead of down-weighting them); batches violating either keep the
+    plain FitData path.
+
+    ``reg_u8_cols``: which X_reg columns travel as uint8.  None
+    auto-detects exact-0/1 columns — fine for a one-shot fit, but chunked
+    pipelines must detect ONCE on the full dataset and pass the result
+    here: the tuple is a static argument of the jitted consumer, and a
+    chunk whose continuous column coincidentally lands in {0, 1} would
+    otherwise flip it and silently recompile mid-run.
+
+    Returns (packed, reg_u8_cols): pass the tuple to the jitted consumer
+    as a static arg so ``unpack_fit_data`` can reassemble X_reg in its
+    original column order.
+    """
+    ds64 = np.asarray(ds, np.float64)
+    if ds64.ndim != 1:
+        raise ValueError("pack_fit_data requires a shared (T,) grid")
+    mask_np = np.asarray(data.mask)
+    if not np.all((mask_np == 0.0) | (mask_np == 1.0)):
+        raise ValueError(
+            "pack_fit_data requires an exact 0/1 mask; fractional "
+            "observation weights need the plain FitData path"
+        )
+    f32 = np.float32
+    cap = np.asarray(data.cap)
+    if cap.shape[-1] != 1 and np.all(cap == cap[..., :1]):
+        cap = cap[..., :1]
+    x_reg = np.asarray(data.X_reg, f32)
+    u8_cols = (
+        _indicator_reg_cols(x_reg) if reg_u8_cols is None
+        else tuple(reg_u8_cols)
+    )
+    if reg_u8_cols is not None:
+        bad = [
+            j for j in u8_cols
+            if not np.all((x_reg[..., j] == 0.0) | (x_reg[..., j] == 1.0))
+        ]
+        if bad:
+            raise ValueError(
+                f"reg_u8_cols {bad} contain non-0/1 values in this batch; "
+                "the uint8 transit would corrupt them"
+            )
+    f32_cols = tuple(j for j in range(x_reg.shape[-1]) if j not in u8_cols)
+    packed = PackedFitData(
+        y=np.asarray(data.y, f32),
+        mask_u8=np.asarray(data.mask, np.uint8),
+        ds_rel=(ds64 - ds64[0]).astype(f32),
+        t_off=((meta.ds_start - ds64[0]) / meta.ds_span).astype(f32),
+        t_inv_span=(1.0 / meta.ds_span).astype(f32),
+        s=np.asarray(data.s, f32),
+        cap=cap.astype(f32),
+        X_season=np.asarray(data.X_season, f32),
+        X_reg=np.ascontiguousarray(x_reg[..., f32_cols]),
+        X_reg_u8=np.ascontiguousarray(x_reg[..., u8_cols]).astype(np.uint8),
+        prior_scales=np.asarray(data.prior_scales, f32),
+        mult_mask=np.asarray(data.mult_mask, f32),
+    )
+    return packed, u8_cols
+
+
+def unpack_fit_data(
+    packed: PackedFitData, reg_u8_cols: Tuple[int, ...] = ()
+) -> FitData:
+    """Rebuild FitData on device (traced; runs inside the fit program)."""
+    t = (
+        packed.ds_rel[None, :] * packed.t_inv_span[:, None]
+        - packed.t_off[:, None]
+    )
+    mask = packed.mask_u8.astype(packed.y.dtype)
+    cap = packed.cap
+    if cap.shape[-1] == 1:
+        cap = jnp.broadcast_to(cap, packed.y.shape)
+    r = packed.X_reg.shape[-1] + packed.X_reg_u8.shape[-1]
+    f32_cols = tuple(j for j in range(r) if j not in reg_u8_cols)
+    cols = [None] * r
+    for i, j in enumerate(f32_cols):
+        cols[j] = packed.X_reg[..., i]
+    for i, j in enumerate(reg_u8_cols):
+        cols[j] = packed.X_reg_u8[..., i].astype(packed.y.dtype)
+    x_reg = (
+        jnp.stack(cols, axis=-1) if cols
+        else jnp.zeros(packed.y.shape + (0,), packed.y.dtype)
+    )
+    return FitData(
+        t=t,
+        y=packed.y,
+        mask=mask,
+        s=packed.s,
+        cap=cap,
+        X_season=packed.X_season,
+        X_reg=x_reg,
+        prior_scales=packed.prior_scales,
+        mult_mask=packed.mult_mask,
+    )
+
+
 def _component(beta: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """beta (B, F) times features (T, F) or (B, T, F) -> (B, T)."""
     if x.shape[-1] == 0:
@@ -124,6 +278,7 @@ def prepare_fit_data(
     regressors: Optional[jnp.ndarray] = None,
     conditions=None,
     dtype: jnp.dtype = jnp.float32,
+    as_numpy: bool = False,
 ) -> Tuple[FitData, ScalingMeta]:
     """Scale, mask, and assemble a padded batch for fitting.
 
@@ -136,6 +291,12 @@ def prepare_fit_data(
       regressors: (B, T, R) raw external regressor values.
       conditions: dict condition_name -> (B, T) truthy values, required when
         any seasonality has a condition_name (seasonality.apply_conditions).
+      as_numpy: keep the FitData leaves as host numpy arrays instead of
+        device arrays.  For prefetch pipelines on a single-device tunnel:
+        a background prep thread must NOT issue device transfers (they
+        queue behind the in-flight fit program and serialize the whole
+        pipeline); the jitted fit call transfers numpy leaves itself at
+        dispatch time on the caller's thread.
 
     Returns:
       (FitData, ScalingMeta).
@@ -248,17 +409,19 @@ def prepare_fit_data(
         mean_eff = np.zeros((b, 0))
         std_eff = np.ones((b, 0))
 
+    xp_cast = (lambda a: np.asarray(a, dtype)) if as_numpy \
+        else (lambda a: jnp.asarray(a, dtype))
     data = FitData(
-        t=jnp.asarray(t, dtype),
-        y=jnp.asarray(y_s, dtype),
-        mask=jnp.asarray(mask_np, dtype),
-        s=s,
-        cap=jnp.asarray(cap_s, dtype),
+        t=xp_cast(t),
+        y=xp_cast(y_s),
+        mask=xp_cast(mask_np),
+        s=np.asarray(s, dtype) if as_numpy else jnp.asarray(s, dtype),
+        cap=xp_cast(cap_s),
         X_season=x_season,
-        X_reg=jnp.asarray(x_reg, dtype),
-        prior_scales=jnp.asarray(config.feature_prior_scales(), dtype),
-        mult_mask=jnp.asarray(
-            [1.0 if m else 0.0 for m in config.feature_modes()], dtype
+        X_reg=xp_cast(x_reg),
+        prior_scales=xp_cast(config.feature_prior_scales()),
+        mult_mask=xp_cast(
+            [1.0 if m else 0.0 for m in config.feature_modes()]
         ),
     )
     meta = ScalingMeta(

@@ -74,6 +74,41 @@ def fit_core(
                           precond=precond, fan_value=fan)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("config", "solver_config", "reg_u8_cols")
+)
+def fit_core_packed(
+    packed,
+    theta0: Optional[jnp.ndarray],
+    config: ProphetConfig,
+    solver_config: SolverConfig,
+    reg_u8_cols: Tuple[int, ...] = (),
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """fit_core over a transfer-optimized PackedFitData (design.py).
+
+    The unpack (t reconstruction, mask cast, cap broadcast) is traced into
+    the SAME program as the solve, so the expanded (B, T) tensors never
+    cross the host<->device link in either direction.  The result is packed
+    too: (theta (B, P), stats (5, B) f32 rows = loss, grad_norm, converged,
+    n_iters, status) — two readbacks instead of six (each device->host
+    buffer is a separate ~40 ms round trip on the tunneled runtime).
+    """
+    from tsspark_tpu.models.prophet.design import unpack_fit_data
+
+    res = fit_core(
+        unpack_fit_data(packed, reg_u8_cols), theta0, config, solver_config
+    )
+    f32 = res.f.dtype
+    stats = jnp.stack([
+        res.f,
+        res.grad_norm,
+        res.converged.astype(f32),
+        res.n_iters.astype(f32),
+        res.status.astype(f32),
+    ])
+    return res.theta, stats
+
+
 @functools.partial(jax.jit, static_argnames=("config", "solver_config"))
 def fit_init_core(
     data: FitData,
