@@ -153,7 +153,9 @@ def fit_worker(args) -> int:
     from tsspark_tpu.backends.registry import get_backend
     from tsspark_tpu.backends.tpu import patch_state
     from tsspark_tpu.config import SolverConfig
-    from tsspark_tpu.models.prophet.design import ScalingMeta, pack_fit_data
+    from tsspark_tpu.models.prophet.design import (
+        ScalingMeta, _indicator_reg_cols, pack_fit_data,
+    )
     from tsspark_tpu.models.prophet.model import FitState, fit_core_packed
 
     ds = np.load(os.path.join(args.data, "ds.npy"))
@@ -206,10 +208,7 @@ def fit_worker(args) -> int:
     # dataset: per-chunk auto-detection would let a chunk whose continuous
     # column is coincidentally all-0/1 flip the static argument and
     # silently recompile mid-run.
-    u8_cols = tuple(
-        j for j in range(reg.shape[-1])
-        if bool(np.all((reg[..., j] == 0.0) | (reg[..., j] == 1.0)))
-    )
+    u8_cols = _indicator_reg_cols(reg)
 
     def prep(lo: int, hi: int):
         b_real = hi - lo
@@ -329,13 +328,28 @@ def fit_worker(args) -> int:
         idx = np.asarray(straggler_idx)
         # Stragglers get the GN-diagonal initial metric (ill-conditioned
         # tail; see SolverConfig.precond / TpuBackend._straggler_backend).
+        # Pad the compacted batch to the fixed phase-1 chunk size: the
+        # straggler count varies run to run, and letting the backend pick a
+        # next-pow2 bucket would compile (and persistent-cache) a different
+        # program shape each time.  Inert all-masked rows cost ~nothing.
+        n_s = len(straggler_idx)
+        pad = (-n_s) % args.chunk
+        pad_rows = lambda a: np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+        ) if pad else a
+        mask_p = pad_rows(np.ascontiguousarray(mask[idx], np.float32))
         state2 = backend._straggler_backend().fit(
             ds,
-            np.ascontiguousarray(y[idx]),
-            mask=np.ascontiguousarray(mask[idx]),
-            regressors=np.ascontiguousarray(reg[idx]),
-            init=np.concatenate(straggler_theta, axis=0),
+            pad_rows(np.ascontiguousarray(y[idx], np.float32)),
+            mask=mask_p,
+            regressors=pad_rows(
+                np.ascontiguousarray(reg[idx], np.float32)
+            ),
+            init=pad_rows(
+                np.concatenate(straggler_theta, axis=0).astype(np.float32)
+            ),
         )
+        state2 = jax.tree.map(lambda a: np.asarray(a)[:n_s], state2)
         jax.block_until_ready(state2.theta)
         for (lo, hi), z in files.items():
             if z.get("phase2") is not None:

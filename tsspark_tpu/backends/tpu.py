@@ -21,14 +21,23 @@ import numpy as np
 
 from tsspark_tpu.backends.registry import ForecastBackend, register_backend
 from tsspark_tpu.models.prophet import predict as predict_mod
+from tsspark_tpu.models.prophet.design import _indicator_reg_cols
 from tsspark_tpu.models.prophet.model import FitState, ProphetModel
 
 
 def _pad_batch(arr, b_pad):
-    if arr is None or arr.shape[0] == b_pad:
+    """Host-side (numpy) zero-padding along the batch axis.
+
+    The whole pre-fit pipeline stays on host numpy: device arrays here
+    would mean shipping the full batch over the link just to slice it
+    back per chunk (and the padding .at[].set ops would each dispatch)."""
+    if arr is None:
+        return None
+    arr = np.asarray(arr)
+    if arr.shape[0] == b_pad:
         return arr
     pad = [(0, b_pad - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
-    return jnp.pad(arr, pad)
+    return np.pad(arr, pad)
 
 
 def _slice_state(state: FitState, lo: int, hi: int) -> FitState:
@@ -71,62 +80,78 @@ class TpuBackend(ForecastBackend):
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
             init=None, conditions=None):
-        y = jnp.asarray(y)
-        ds = jnp.asarray(ds)
+        # Host numpy end-to-end until each chunk's single fit dispatch:
+        # a device array here would ship the whole batch over the link only
+        # for prepare_fit_data to pull it back for the numpy prep.
+        y = np.asarray(y)
+        ds = np.asarray(ds)
         b = y.shape[0]
         c = min(self.chunk_size, _next_pow2(b))
+        # Indicator-column split for the packed transfer path, decided ONCE
+        # for the whole call: it is a static argument of the jitted fit, so
+        # a per-chunk decision could flip and recompile mid-stream.  Skipped
+        # when the packed path is unreachable (segmented solves) — the
+        # detection is a full O(B*T*R) host scan.
+        u8 = None
+        segmented = bool(
+            self.iter_segment
+            and self.iter_segment < self.solver_config.max_iters
+        )
+        if regressors is not None and not segmented:
+            u8 = _indicator_reg_cols(np.asarray(regressors))
         if b <= c:
             return self._fit_padded(
-                ds, y, mask, cap, floor, regressors, init, conditions, c
+                ds, y, mask, cap, floor, regressors, init, conditions, c, u8
             )
 
         states = []
         for lo in range(0, b, c):
             hi = min(lo + c, b)
-            sl = lambda a: None if a is None else a[lo:hi]
+            sl = lambda a: None if a is None else np.asarray(a)[lo:hi]
             slc = lambda d: None if d is None else {
-                k: v[lo:hi] for k, v in d.items()
+                k: np.asarray(v)[lo:hi] for k, v in d.items()
             }
             states.append(
                 self._fit_padded(
                     ds if ds.ndim == 1 else ds[lo:hi],
                     y[lo:hi], sl(mask), sl(cap), sl(floor), sl(regressors),
-                    sl(init), slc(conditions), c,
+                    sl(init), slc(conditions), c, u8,
                 )
             )
         return _concat_states(states)
 
     def _fit_padded(self, ds, y, mask, cap, floor, regressors, init,
-                    conditions, c):
+                    conditions, c, reg_u8_cols=None):
         b = y.shape[0]
         if b < c:
             if ds.ndim == 2:
                 # Dummy rows reuse the first series' grid (inert: mask == 0).
-                ds = jnp.concatenate(
-                    [ds, jnp.broadcast_to(ds[:1], (c - b,) + ds.shape[1:])]
+                ds = np.concatenate(
+                    [ds, np.broadcast_to(ds[:1], (c - b,) + ds.shape[1:])]
                 )
             # Dummy series: all-masked, y=0. Their loss is priors-only and
             # converges immediately; results are sliced away below.
             y = _pad_batch(y, c)
             mask = _pad_batch(
-                mask if mask is not None else jnp.ones_like(y).at[b:].set(0.0), c
-            )
-            mask = mask.at[b:].set(0.0)
+                mask if mask is not None else np.isfinite(y), c
+            ).astype(y.dtype).copy()
+            mask[b:] = 0.0
             cap = _pad_batch(cap, c) if cap is not None else None
             if cap is not None:
-                cap = cap.at[b:].set(1.0)  # keep logistic cap positive
+                cap = cap.copy()
+                cap[b:] = 1.0  # keep logistic cap positive
             floor = _pad_batch(floor, c) if floor is not None else None
             regressors = _pad_batch(regressors, c) if regressors is not None else None
             init = _pad_batch(init, c) if init is not None else None
             if conditions is not None:
                 conditions = {
-                    k: _pad_batch(jnp.asarray(v), c)
-                    for k, v in conditions.items()
+                    k: _pad_batch(v, c) for k, v in conditions.items()
                 }
         state = self._model.fit(
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
             init=init, iter_segment=self.iter_segment,
             on_segment=self.on_segment, conditions=conditions,
+            reg_u8_cols=reg_u8_cols,
         )
         return _slice_state(state, 0, b)
 

@@ -11,16 +11,18 @@ in, MAP parameters out.
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tsspark_tpu.config import McmcConfig, ProphetConfig, SolverConfig
 from tsspark_tpu.models.prophet import predict as predict_mod
 from tsspark_tpu.models.prophet.design import (
     FitData,
     ScalingMeta,
+    pack_fit_data,
     prepare_fit_data,
 )
 from tsspark_tpu.models.prophet.init import curvature_diag, initial_theta
@@ -235,6 +237,7 @@ class ProphetModel:
         iter_segment: Optional[int] = None,
         on_segment=None,
         conditions=None,
+        reg_u8_cols: Optional[Tuple[int, ...]] = None,
     ) -> FitState:
         """Fit every series in the (B, T) batch.
 
@@ -252,11 +255,48 @@ class ProphetModel:
         dispatch — a liveness hook for external watchdogs that cannot tell a
         long-running solve from a wedged runtime (the bench orchestrator's
         stall detector is the motivating consumer).
+
+        Transfer path: shared-grid batches with an exact 0/1 mask run as
+        ONE packed-transfer program (design.PackedFitData — ~40% of the
+        bytes over the host<->device link, unpack fused into the fit);
+        segmented solves, per-series grids, and fractional masks keep the
+        plain FitData path.  ``reg_u8_cols`` pins which regressor columns
+        travel as uint8 (chunked callers must decide once per dataset —
+        see pack_fit_data).
         """
         data, meta = prepare_fit_data(
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
-            regressors=regressors, conditions=conditions,
+            regressors=regressors, conditions=conditions, as_numpy=True,
         )
+        mask_np = np.asarray(data.mask)
+        packable = (
+            np.asarray(ds).ndim == 1
+            and not (iter_segment and iter_segment < self.solver_config.max_iters)
+            and bool(np.all((mask_np == 0.0) | (mask_np == 1.0)))
+        )
+        if packable:
+            # Not guarded by try/except: pack_fit_data's remaining failure
+            # mode (reg_u8_cols naming a non-0/1 column) is a caller
+            # contract violation that must surface, not silently fall back.
+            packed, u8 = pack_fit_data(
+                data, meta, ds, reg_u8_cols=reg_u8_cols
+            )
+            theta, stats = fit_core_packed(
+                packed, init, self.config, self.solver_config,
+                reg_u8_cols=u8,
+            )
+            if on_segment is not None:
+                on_segment()
+            stats = np.asarray(stats)
+            return FitState(
+                theta=theta,
+                meta=meta,
+                loss=stats[0],
+                grad_norm=stats[1],
+                converged=stats[2].astype(bool),
+                n_iters=stats[3].astype(np.int32),
+                status=stats[4].astype(np.int32),
+            )
         return self._fit_prepared(data, meta, init, iter_segment, on_segment)
 
     def _fit_prepared(
@@ -271,6 +311,10 @@ class ProphetModel:
         theta0 = init
         solver = self.solver_config
         if iter_segment and iter_segment < solver.max_iters:
+            # Transfer once: numpy FitData leaves would be re-uploaded on
+            # EVERY segment dispatch (jit device_puts numpy args per call,
+            # no cross-call caching — ~56 MB per re-ship at bench shape).
+            data = jax.tree.map(jnp.asarray, data)
             ls = fit_init_core(data, theta0, self.config, solver)
             for _ in range(-(-solver.max_iters // iter_segment)):
                 ls = fit_segment_core(
