@@ -116,3 +116,31 @@ def test_forecaster_recovers_holiday_effect():
     on = s0.loc[pd.Timestamp("2024-07-04"), "yhat"]
     off = s0.loc[pd.Timestamp("2024-07-10"), "yhat"]
     assert on - off == pytest.approx(effect, abs=0.75)
+
+
+def test_new_country_calendars_known_dates():
+    """Spot-check one movable and one fixed holiday per added country
+    against published 2023 dates."""
+    import datetime as dt
+
+    def dates(country, name, year=2023):
+        return [
+            dt.date(1970, 1, 1) + dt.timedelta(days=int(d))
+            for h in hol.country_holidays(country, [year]) if h.name == name
+            for d in h.dates
+        ]
+
+    # 2023: Easter Sunday = April 9.
+    assert dates("FR", "Ascension") == [dt.date(2023, 5, 18)]
+    assert dates("FR", "Fete nationale") == [dt.date(2023, 7, 14)]
+    assert dates("IT", "Lunedi dell'Angelo") == [dt.date(2023, 4, 10)]
+    assert dates("ES", "Viernes Santo") == [dt.date(2023, 4, 7)]
+    assert dates("BR", "Carnaval") == [dt.date(2023, 2, 21)]
+    assert dates("BR", "Corpus Christi") == [dt.date(2023, 6, 8)]
+    assert dates("JP", "Coming of Age Day") == [dt.date(2023, 1, 9)]
+    assert dates("JP", "Respect for the Aged Day") == [dt.date(2023, 9, 18)]
+    assert dates("IN", "Republic Day") == [dt.date(2023, 1, 26)]
+    # Every registered country yields a parsable calendar for a decade.
+    for c in ("US", "CA", "GB", "DE", "FR", "IT", "ES", "BR", "JP", "IN"):
+        hs = hol.country_holidays(c, range(2015, 2025))
+        assert len(hs) >= 4, c

@@ -190,7 +190,102 @@ def _de(year: int):
     yield "Zweiter Weihnachtstag", _dt.date(year, 12, 26)
 
 
-_COUNTRIES = {"US": _us, "CA": _ca, "GB": _gb, "UK": _gb, "DE": _de}
+def _fr(year: int):
+    easter = _easter(year)
+    yield "Jour de l'an", _dt.date(year, 1, 1)
+    yield "Lundi de Paques", easter + _dt.timedelta(days=1)
+    yield "Fete du Travail", _dt.date(year, 5, 1)
+    yield "Victoire 1945", _dt.date(year, 5, 8)
+    yield "Ascension", easter + _dt.timedelta(days=39)
+    yield "Lundi de Pentecote", easter + _dt.timedelta(days=50)
+    yield "Fete nationale", _dt.date(year, 7, 14)
+    yield "Assomption", _dt.date(year, 8, 15)
+    yield "Toussaint", _dt.date(year, 11, 1)
+    yield "Armistice 1918", _dt.date(year, 11, 11)
+    yield "Noel", _dt.date(year, 12, 25)
+
+
+def _it(year: int):
+    easter = _easter(year)
+    yield "Capodanno", _dt.date(year, 1, 1)
+    yield "Epifania", _dt.date(year, 1, 6)
+    yield "Lunedi dell'Angelo", easter + _dt.timedelta(days=1)
+    yield "Festa della Liberazione", _dt.date(year, 4, 25)
+    yield "Festa del Lavoro", _dt.date(year, 5, 1)
+    yield "Festa della Repubblica", _dt.date(year, 6, 2)
+    yield "Ferragosto", _dt.date(year, 8, 15)
+    yield "Tutti i Santi", _dt.date(year, 11, 1)
+    yield "Immacolata Concezione", _dt.date(year, 12, 8)
+    yield "Natale", _dt.date(year, 12, 25)
+    yield "Santo Stefano", _dt.date(year, 12, 26)
+
+
+def _es(year: int):
+    easter = _easter(year)
+    yield "Ano Nuevo", _dt.date(year, 1, 1)
+    yield "Epifania del Senor", _dt.date(year, 1, 6)
+    yield "Viernes Santo", easter - _dt.timedelta(days=2)
+    yield "Fiesta del Trabajo", _dt.date(year, 5, 1)
+    yield "Asuncion de la Virgen", _dt.date(year, 8, 15)
+    yield "Fiesta Nacional", _dt.date(year, 10, 12)
+    yield "Todos los Santos", _dt.date(year, 11, 1)
+    yield "Dia de la Constitucion", _dt.date(year, 12, 6)
+    yield "Inmaculada Concepcion", _dt.date(year, 12, 8)
+    yield "Navidad", _dt.date(year, 12, 25)
+
+
+def _br(year: int):
+    easter = _easter(year)
+    yield "Confraternizacao Universal", _dt.date(year, 1, 1)
+    yield "Carnaval", easter - _dt.timedelta(days=47)  # Shrove Tuesday
+    yield "Sexta-feira Santa", easter - _dt.timedelta(days=2)
+    yield "Tiradentes", _dt.date(year, 4, 21)
+    yield "Dia do Trabalhador", _dt.date(year, 5, 1)
+    yield "Corpus Christi", easter + _dt.timedelta(days=60)
+    yield "Independencia", _dt.date(year, 9, 7)
+    yield "Nossa Senhora Aparecida", _dt.date(year, 10, 12)
+    yield "Finados", _dt.date(year, 11, 2)
+    yield "Proclamacao da Republica", _dt.date(year, 11, 15)
+    yield "Natal", _dt.date(year, 12, 25)
+
+
+def _jp(year: int):
+    # Fixed-date subset (equinox days and Happy-Monday shifts post-2000
+    # are approximated by their statutory rules below).
+    yield "New Year's Day", _dt.date(year, 1, 1)
+    if year >= 2000:
+        yield "Coming of Age Day", _nth_weekday(year, 1, 0, 2)
+    yield "National Foundation Day", _dt.date(year, 2, 11)
+    yield "Showa Day", _dt.date(year, 4, 29)
+    yield "Constitution Day", _dt.date(year, 5, 3)
+    yield "Greenery Day", _dt.date(year, 5, 4)
+    yield "Children's Day", _dt.date(year, 5, 5)
+    if year >= 2003:
+        yield "Marine Day", _nth_weekday(year, 7, 0, 3)
+    if year >= 2016:
+        yield "Mountain Day", _dt.date(year, 8, 11)
+    if year >= 2003:
+        yield "Respect for the Aged Day", _nth_weekday(year, 9, 0, 3)
+    if year >= 2000:
+        yield "Health and Sports Day", _nth_weekday(year, 10, 0, 2)
+    yield "Culture Day", _dt.date(year, 11, 3)
+    yield "Labour Thanksgiving Day", _dt.date(year, 11, 23)
+
+
+def _in(year: int):
+    # Pan-India gazetted fixed-date holidays (movable religious holidays
+    # follow lunar calendars and need an external table — pass them via
+    # holidays_from_df / Holiday.from_dates).
+    yield "Republic Day", _dt.date(year, 1, 26)
+    yield "Independence Day", _dt.date(year, 8, 15)
+    yield "Gandhi Jayanti", _dt.date(year, 10, 2)
+    yield "Christmas Day", _dt.date(year, 12, 25)
+
+
+_COUNTRIES = {
+    "US": _us, "CA": _ca, "GB": _gb, "UK": _gb, "DE": _de,
+    "FR": _fr, "IT": _it, "ES": _es, "BR": _br, "JP": _jp, "IN": _in,
+}
 
 
 def country_holidays(
