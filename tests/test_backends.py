@@ -222,3 +222,44 @@ def test_on_segment_liveness_hook_fires():
          + rng.normal(0, 0.5, (2, n))).astype(np.float32)
     bk.fit(jnp.arange(n, dtype=jnp.float32), jnp.asarray(y))
     assert 1 <= len(calls) <= 5  # one per dispatched segment
+
+
+def test_predict_chunked_matches_unchunked():
+    """Series-axis predict chunking (the (S, B, T) sample tensor must not
+    scale with the full batch) reproduces the unchunked deterministic
+    outputs exactly and keeps interval ordering."""
+    import numpy as np
+
+    from tsspark_tpu.backends.tpu import TpuBackend
+    from tsspark_tpu.config import (
+        ProphetConfig, SeasonalityConfig, SolverConfig,
+    )
+
+    rng = np.random.default_rng(7)
+    b, t_len = 37, 90  # deliberately not a multiple of the chunk
+    ds = np.arange(t_len, dtype=np.float64)
+    y = (
+        5.0
+        + 0.02 * ds[None, :]
+        + np.sin(2 * np.pi * ds[None, :] / 7.0)
+        + rng.normal(0, 0.1, (b, t_len))
+    )
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=4,
+    )
+    backend = TpuBackend(cfg, SolverConfig(max_iters=30), chunk_size=16)
+    state = backend.fit(ds, y)
+    fut = np.arange(t_len, t_len + 14, dtype=np.float64)
+
+    chunked = backend.predict(state, fut, seed=0)
+    whole = backend._model.predict(state, fut, seed=0)
+    for k in ("yhat", "trend", "additive", "multiplicative"):
+        np.testing.assert_allclose(
+            np.asarray(chunked[k]), np.asarray(whole[k]), atol=1e-5,
+            err_msg=k,
+        )
+    assert np.all(
+        np.asarray(chunked["yhat_lower"]) <= np.asarray(chunked["yhat_upper"])
+    )
+    assert np.asarray(chunked["yhat"]).shape == (b, 14)

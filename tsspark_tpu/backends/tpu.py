@@ -44,6 +44,20 @@ def _slice_state(state: FitState, lo: int, hi: int) -> FitState:
     return jax.tree.map(lambda a: a[lo:hi], state)
 
 
+def _slice_repeat_pad(a, lo: int, hi: int, c: int):
+    """Batch-axis slice [lo:hi], padded to exactly ``c`` rows by repeating
+    the first row (valid dummy data whose outputs are discarded) so every
+    chunk hits one compiled shape.  Zero-padding (fit's policy, _pad_batch)
+    is wrong here: there is no mask input on the predict path to make
+    zero rows inert."""
+    if a is None:
+        return None
+    a = np.asarray(a)[lo:hi]
+    if hi - lo < c:
+        a = np.concatenate([a, np.repeat(a[:1], c - (hi - lo), axis=0)])
+    return a
+
+
 def _concat_states(states) -> FitState:
     # Host numpy leaves (ScalingMeta, float64) concatenate as numpy;
     # jnp.concatenate would silently downcast them to f32.
@@ -211,12 +225,72 @@ class TpuBackend(ForecastBackend):
         precond), while the fast majority never pays for it."""
         return self._derived(precond="gn_diag")
 
+    # Memory bound for one predictive-sampling program: the trend
+    # simulation materializes an (S, B_chunk, T) float32 tensor, so the
+    # series chunk must shrink with samples x grid length (30,490 series x
+    # 2,000 grid points x 1,000 samples would be ~244 GB unchunked).
+    _PREDICT_ELEMS = 1 << 28  # ~1 GB of f32 per sample tensor
+
     def predict(self, state, ds, cap=None, regressors=None, seed=0,
                 num_samples=None, conditions=None):
-        return self._model.predict(
-            state, ds, cap=cap, regressors=regressors, seed=seed,
-            num_samples=num_samples, conditions=conditions,
+        b = np.asarray(state.theta).shape[0]
+        ds_np = np.asarray(ds)
+        t_len = ds_np.shape[-1]
+        n_s = (
+            self.config.uncertainty_samples if num_samples is None
+            else num_samples
+        ) or 1
+        # Round DOWN to a power of two: rounding up would let the sample
+        # tensor overshoot the element budget by up to 2x.
+        c = max(64, self._PREDICT_ELEMS // max(n_s * t_len, 1))
+        c = min(_next_pow2(c + 1) // 2, self.chunk_size, _next_pow2(b))
+        if b <= c:
+            return self._model.predict(
+                state, ds, cap=cap, regressors=regressors, seed=seed,
+                num_samples=num_samples, conditions=conditions,
+            )
+        # One device->host pull up front; per-chunk slicing then stays on
+        # host views (the fit path's rule: never re-ship the batch).
+        # Scalar / shared-(T,) cap and condition inputs are normalized to
+        # per-series (B, T) views first — the unchunked path accepts them
+        # via broadcasting, and slicing them along axis 0 would otherwise
+        # cut the TIME axis.
+        state = jax.tree.map(np.asarray, state)
+        bt = lambda a: None if a is None else np.broadcast_to(
+            np.asarray(a), (b, t_len)
         )
+        cap = bt(cap)
+        conditions = None if conditions is None else {
+            k: bt(v) for k, v in conditions.items()
+        }
+        regressors = None if regressors is None else np.asarray(regressors)
+        outs = []
+        for ci, lo in enumerate(range(0, b, c)):
+            hi = min(lo + c, b)
+            sl = lambda a: _slice_repeat_pad(a, lo, hi, c)
+            outs.append(self._model.predict(
+                jax.tree.map(sl, state),
+                ds_np if ds_np.ndim == 1 else sl(ds_np),
+                cap=sl(cap), regressors=sl(regressors),
+                # Independent, well-mixed draws per chunk: integer seed
+                # arithmetic (seed + lo) would collide across predict
+                # calls whose user seeds differ by less than the batch.
+                seed=int(
+                    np.random.SeedSequence((seed, ci)).generate_state(1)[0]
+                ),
+                num_samples=num_samples,
+                conditions=None if conditions is None else {
+                    k: sl(v) for k, v in conditions.items()
+                },
+            ))
+            if hi - lo < c:
+                outs[-1] = {
+                    k: np.asarray(v)[: hi - lo] for k, v in outs[-1].items()
+                }
+        return {
+            k: np.concatenate([np.asarray(o[k]) for o in outs], axis=0)
+            for k in outs[0]
+        }
 
     def components(self, state, ds, cap=None, regressors=None,
                    conditions=None):

@@ -363,6 +363,13 @@ class Forecaster:
         """
         if self.state is None:
             raise RuntimeError("fit before predict")
+        if horizon is not None and not isinstance(horizon, (int, np.integer)):
+            # A DataFrame passed positionally lands here and would otherwise
+            # surface as an inscrutable pandas arithmetic error downstream.
+            raise TypeError(
+                f"horizon must be an int, got {type(horizon).__name__}; "
+                "pass a frame as predict(future_df=...)"
+            )
         if future_df is not None:
             grid, cap, reg, conditions = self._align_future(future_df)
         else:
