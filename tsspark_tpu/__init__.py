@@ -46,7 +46,7 @@ from tsspark_tpu.models.holidays import (
 from tsspark_tpu.models.prophet.model import FitState, McmcState, ProphetModel
 from tsspark_tpu.models.prophet.seasonality import auto_seasonalities
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "DAILY",
