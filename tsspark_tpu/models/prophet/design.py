@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from tsspark_tpu.config import ProphetConfig
@@ -217,13 +218,21 @@ def unpack_fit_data(
     )
 
 
+# Full-f32 accumulation for every model matmul/einsum: TPU MXU contractions
+# on f32 inputs default to single-pass bfloat16 (~4e-3 relative error),
+# which measurably moves optima vs the f64-free CPU oracle (the parity
+# criterion, BASELINE.json:2).  These contractions are bandwidth-bound at
+# our shapes, so the extra MXU passes are effectively free.
+_PREC = jax.lax.Precision.HIGHEST
+
+
 def _component(beta: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """beta (B, F) times features (T, F) or (B, T, F) -> (B, T)."""
     if x.shape[-1] == 0:
         return jnp.zeros(beta.shape[:-1] + x.shape[-2:-1], beta.dtype)
     if x.ndim == 2:
-        return beta @ x.T
-    return jnp.einsum("bf,btf->bt", beta, x)
+        return jnp.einsum("bf,tf->bt", beta, x, precision=_PREC)
+    return jnp.einsum("bf,btf->bt", beta, x, precision=_PREC)
 
 
 def trend_fn(

@@ -51,8 +51,8 @@ def _ridge_solve(
     """Batched masked ridge: argmin ||mask*(y - phi w)||^2/sigma2 + w'Λw."""
     phi_m = phi * mask[..., None]
     # (B, Q, Q) Gram and (B, Q) moment — batched matmuls, MXU-friendly.
-    gram = jnp.einsum("btp,btq->bpq", phi_m, phi)
-    rhs = jnp.einsum("btp,bt->bp", phi_m, y)
+    gram = jnp.einsum("btp,btq->bpq", phi_m, phi, precision=jax.lax.Precision.HIGHEST)
+    rhs = jnp.einsum("btp,bt->bp", phi_m, y, precision=jax.lax.Precision.HIGHEST)
     q = phi.shape[-1]
     lam = prior_prec[None, :] * sigma2[:, None] + 1e-6
     a = gram + jnp.eye(q, dtype=phi.dtype)[None] * lam[:, :, None]
@@ -117,7 +117,7 @@ def ridge_init(data, config: ProphetConfig) -> jnp.ndarray:
         k0, m0 = w[:, 0], w[:, 1]
         delta0 = w[:, 2 : 2 + n_cp]
         beta0 = w[:, 2 + n_cp :]
-        yhat = jnp.einsum("btq,bq->bt", phi, w)
+        yhat = jnp.einsum("btq,bq->bt", phi, w, precision=jax.lax.Precision.HIGHEST)
     else:
         # Non-linear growth: endpoint heuristic for (k, m); ridge only
         # for the feature betas against the de-trended target.
@@ -133,7 +133,7 @@ def ridge_init(data, config: ProphetConfig) -> jnp.ndarray:
             phi = feats[0]
             w = _ridge_solve(phi, y - g0, mask, feat_prec, sigma2_0)
             beta0 = w
-            yhat = g0 + jnp.einsum("btq,bq->bt", phi, w)
+            yhat = g0 + jnp.einsum("btq,bq->bt", phi, w, precision=jax.lax.Precision.HIGHEST)
         else:
             beta0 = jnp.zeros((b, 0), dtype)
             yhat = g0
@@ -185,7 +185,7 @@ def curvature_diag(data, config: ProphetConfig, theta0: jnp.ndarray
     parts = [h_k[:, None], h_m[:, None], h_sig[:, None]]
     if config.n_changepoints:
         relu = jnp.maximum(t[:, :, None] - data.s[:, None, :], 0.0)
-        h_delta = jnp.einsum("bt,btc->bc", w, relu * relu)
+        h_delta = jnp.einsum("bt,btc->bc", w, relu * relu, precision=jax.lax.Precision.HIGHEST)
         # Laplace(0, b) moment-matched to Normal(0, sqrt(2) b), like the
         # ridge init: the kink curvature (1/(b*eps_huber), ~1e5) would be
         # honest at delta=0 but freezes changepoints the data wants to move.
@@ -193,7 +193,7 @@ def curvature_diag(data, config: ProphetConfig, theta0: jnp.ndarray
         parts.append(h_delta)
     if config.num_features:
         x = _feature_matrix(data, b)
-        h_beta = jnp.einsum("bt,btf->bf", w, x * x)
+        h_beta = jnp.einsum("bt,btf->bf", w, x * x, precision=jax.lax.Precision.HIGHEST)
         h_beta = h_beta + (
             1.0 / jnp.asarray(config.feature_prior_scales(), dtype) ** 2
         )

@@ -63,7 +63,8 @@ def step_weighted_sum(
     if s.shape[-1] == 0:
         return jnp.zeros(t.shape, t.dtype)
     active = (t[..., :, None] >= s[..., None, :]).astype(t.dtype)
-    return jnp.einsum("...tc,...c->...t", active, values)
+    return jnp.einsum("...tc,...c->...t", active, values,
+                      precision=jax.lax.Precision.HIGHEST)
 
 
 def piecewise_linear(
@@ -89,7 +90,8 @@ def piecewise_linear(
     if s.shape[-1] == 0:
         return base
     hinge = jnp.maximum(t[..., :, None] - s[..., None, :], 0.0)
-    return base + jnp.einsum("...tc,...c->...t", hinge, delta)
+    return base + jnp.einsum("...tc,...c->...t", hinge, delta,
+                             precision=jax.lax.Precision.HIGHEST)
 
 
 def _logistic_gamma(
