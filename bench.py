@@ -68,6 +68,22 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
 RESERVE_S = 150.0
 
 
+def _code_fingerprint() -> str:
+    """Hash of every source file that can affect bench results — keys the
+    resumable scratch dir so results never leak across code versions."""
+    import hashlib
+
+    h = hashlib.md5()
+    files = sorted(
+        glob.glob(os.path.join(REPO, "tsspark_tpu", "**", "*.py"),
+                  recursive=True)
+    ) + [os.path.abspath(__file__)]
+    for f in files:
+        with open(f, "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()[:10]
+
+
 def _model_config():
     from tsspark_tpu.config import (
         ProphetConfig,
@@ -693,6 +709,7 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None):
             (t["device"] for t in reversed(times) if "device" in t), None
         ),
         "chunk_final": chunk,
+        "resumed": bool(getattr(args, "_resumed", False)),
         "worker_retries": retries,
         "max_iters": args.max_iters,
         "phase1_iters": args.phase1_iters,
@@ -769,12 +786,32 @@ def main() -> None:
 
     from tsspark_tpu.data import datasets
 
-    scratch = tempfile.mkdtemp(prefix="tsbench_", dir="/tmp")
+    # Persistent, code-fingerprinted scratch: a run killed by the harness
+    # timeout (or a wedged tunnel) resumes from its completed chunk files on
+    # the next invocation instead of starting over — per-chunk saves and the
+    # phase-2 marker are already idempotent.  Any source change rotates the
+    # fingerprint so stale results can never leak across code versions.
+    scratch = os.path.join(
+        "/tmp",
+        f"tsbench_run_{args.series}x{args.days}_c{args.chunk}"
+        f"_p{args.phase1_iters}_{_code_fingerprint()}",
+    )
     args._out_dir = os.path.join(scratch, "out")
-    os.makedirs(args._out_dir)
+    resumed = os.path.isdir(args._out_dir) and bool(
+        glob.glob(os.path.join(args._out_dir, "chunk_*.npz"))
+    )
+    args._resumed = resumed
+    if resumed:
+        print(f"[bench] resuming from {args._out_dir}", file=sys.stderr)
+    # Stale scratch dirs (other fingerprints / shapes) have no resume value.
+    for d in glob.glob("/tmp/tsbench_run_*"):
+        if os.path.abspath(d) != os.path.abspath(scratch):
+            shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(args._out_dir, exist_ok=True)
 
     # From here on a SIGTERM/SIGINT (harness timeout) still produces the one
-    # summary line from whatever chunks have landed.
+    # summary line from whatever chunks have landed; the scratch dir is
+    # KEPT on signal so the next run resumes.
     state = {"chunk": args.chunk, "retries": 0, "gen_s": 0.0}
 
     def _on_signal(signum, frame):
@@ -785,8 +822,6 @@ def main() -> None:
                 pass
         _emit(_build_summary(args, t_wall0, state["gen_s"], state["chunk"],
                              state["retries"], note=f"signal {signum}"))
-        if not args.keep:
-            shutil.rmtree(scratch, ignore_errors=True)
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_signal)
@@ -911,9 +946,13 @@ def main() -> None:
         _spawn("--_eval", args, ["--n-eval", str(min(512, n_done))],
                timeout=eval_budget)
 
-    _emit(_build_summary(args, t_wall0, gen_s, state["chunk"],
-                         state["retries"], note=note))
-    if not args.keep:
+    summary = _build_summary(args, t_wall0, gen_s, state["chunk"],
+                             state["retries"], note=note)
+    _emit(summary)
+    # Remove the scratch only after a COMPLETE run: partial results are the
+    # resume state for the next invocation (fingerprint-keyed, so a code
+    # change invalidates them anyway).
+    if not args.keep and summary["extra"].get("complete"):
         shutil.rmtree(scratch, ignore_errors=True)
 
 
