@@ -172,7 +172,9 @@ def fit_worker(args) -> int:
     from tsspark_tpu.models.prophet.design import (
         ScalingMeta, _indicator_reg_cols, pack_fit_data,
     )
-    from tsspark_tpu.models.prophet.model import FitState, fit_core_packed
+    from tsspark_tpu.models.prophet.model import (
+        FitState, fit_core_packed, fitstate_from_packed,
+    )
 
     ds = np.load(os.path.join(args.data, "ds.npy"))
     y = np.load(os.path.join(args.data, "y.npy"), mmap_mode="r")
@@ -210,7 +212,15 @@ def fit_worker(args) -> int:
     # compiled shape.
     from concurrent.futures import ThreadPoolExecutor
 
-    model = phase1._model
+    # The packed mode drives ONE compiled program for both phases: the
+    # static solver carries the full depth, while the per-phase differences
+    # (solve depth, GN-metric switch, warm-start-vs-ridge-init) are TRACED
+    # scalars (fit_core's *_dynamic args).  Phase 2 previously compiled and
+    # warmed a second program (different static solver + init presence) at
+    # ~10 s per run through the tunnel.
+    model = backend._model
+    n_params = model.config.num_params
+    zeros_theta = np.zeros((args.chunk, n_params), np.float32)
 
     # Segmented mode (--segment < phase-1 depth) keeps the FitData path:
     # per-segment dispatches with a heartbeat after each, for runs where
@@ -218,7 +228,7 @@ def fit_worker(args) -> int:
     # Default mode runs each chunk as ONE packed-transfer program.
     segmented = bool(
         phase1.iter_segment
-        and phase1.iter_segment < model.solver_config.max_iters
+        and phase1.iter_segment < phase1._model.solver_config.max_iters
     )
     # Indicator-column split for the packed path, decided ONCE on the full
     # dataset: per-chunk auto-detection would let a chunk whose continuous
@@ -273,7 +283,7 @@ def fit_worker(args) -> int:
             t_put = time.time() - t1
             t1 = time.time()
             if segmented:
-                state = model._fit_prepared(
+                state = phase1._model._fit_prepared(
                     payload, meta, None, phase1.iter_segment,
                     on_segment=heartbeat,
                 )
@@ -285,25 +295,22 @@ def fit_worker(args) -> int:
                 )
             else:
                 theta, stats = fit_core_packed(
-                    payload, None, model.config, model.solver_config,
+                    payload, zeros_theta, model.config, model.solver_config,
                     reg_u8_cols=u8_cols,
+                    max_iters_dynamic=np.int32(
+                        args.phase1_iters if two_phase else args.max_iters
+                    ),
+                    gn_precond_dynamic=np.bool_(False),
+                    use_theta0_dynamic=np.bool_(False),
                 )
                 jax.block_until_ready(theta)
                 heartbeat()
                 t_dev = time.time() - t1
                 t1 = time.time()
-                theta = np.asarray(theta)[:b_real]
-                stats = np.asarray(stats)[:, :b_real]
-                state = FitState(
-                    theta=theta,
-                    meta=jax.tree.map(
-                        lambda a: np.asarray(a)[:b_real], meta
-                    ),
-                    loss=stats[0],
-                    grad_norm=stats[1],
-                    converged=stats[2].astype(bool),
-                    n_iters=stats[3].astype(np.int32),
-                    status=stats[4].astype(np.int32),
+                state = fitstate_from_packed(
+                    np.asarray(theta)[:b_real],
+                    np.asarray(stats)[:, :b_real],
+                    jax.tree.map(lambda a: np.asarray(a)[:b_real], meta),
                 )
             fit_s = time.time() - t0
             _save_chunk_atomic(args.out, lo, hi, state)
@@ -343,30 +350,58 @@ def fit_worker(args) -> int:
         heartbeat()  # phase 2 starts: reset the stall clock
         idx = np.asarray(straggler_idx)
         # Stragglers get the GN-diagonal initial metric (ill-conditioned
-        # tail; see SolverConfig.precond / TpuBackend._straggler_backend).
-        # Pad the compacted batch to the fixed phase-1 chunk size: the
-        # straggler count varies run to run, and letting the backend pick a
-        # next-pow2 bucket would compile (and persistent-cache) a different
-        # program shape each time.  Inert all-masked rows cost ~nothing.
+        # tail; see SolverConfig.precond) and the full solve depth, through
+        # THE SAME compiled program as phase 1: the batch is padded to the
+        # fixed phase-1 chunk size (inert all-masked rows) and the phase
+        # differences ride the traced *_dynamic args, so no second program
+        # is ever compiled or warmed.
         n_s = len(straggler_idx)
         pad = (-n_s) % args.chunk
         pad_rows = lambda a: np.concatenate(
             [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
         ) if pad else a
-        mask_p = pad_rows(np.ascontiguousarray(mask[idx], np.float32))
-        state2 = backend._straggler_backend().fit(
-            ds,
-            pad_rows(np.ascontiguousarray(y[idx], np.float32)),
-            mask=mask_p,
-            regressors=pad_rows(
-                np.ascontiguousarray(reg[idx], np.float32)
-            ),
-            init=pad_rows(
-                np.concatenate(straggler_theta, axis=0).astype(np.float32)
-            ),
+        y_s = pad_rows(np.ascontiguousarray(y[idx], np.float32))
+        m_s = pad_rows(np.ascontiguousarray(mask[idx], np.float32))
+        r_s = pad_rows(np.ascontiguousarray(reg[idx], np.float32))
+        init_s = pad_rows(
+            np.concatenate(straggler_theta, axis=0).astype(np.float32)
         )
-        state2 = jax.tree.map(lambda a: np.asarray(a)[:n_s], state2)
-        jax.block_until_ready(state2.theta)
+        if segmented:
+            # Bounded-dispatch mode: phase 2 keeps --segment's short
+            # per-segment dispatches (the reason segmented mode exists),
+            # via the static straggler backend.
+            state2 = backend._straggler_backend().fit(
+                ds, y_s, mask=m_s, regressors=r_s, init=init_s,
+            )
+            state2 = jax.tree.map(lambda a: np.asarray(a)[:n_s], state2)
+            jax.block_until_ready(jax.tree.leaves(state2)[0])
+        else:
+            subs = []
+            for lo2 in range(0, n_s + pad, args.chunk):
+                hi2 = lo2 + args.chunk
+                data2, meta2 = model.prepare(
+                    ds, y_s[lo2:hi2], mask=m_s[lo2:hi2],
+                    regressors=r_s[lo2:hi2], as_numpy=True,
+                )
+                packed2, _ = pack_fit_data(
+                    data2, meta2, ds, reg_u8_cols=u8_cols
+                )
+                theta2, stats2 = fit_core_packed(
+                    packed2, init_s[lo2:hi2], model.config,
+                    model.solver_config,
+                    reg_u8_cols=u8_cols,
+                    max_iters_dynamic=np.int32(args.max_iters),
+                    gn_precond_dynamic=np.bool_(True),
+                    use_theta0_dynamic=np.bool_(True),
+                )
+                jax.block_until_ready(theta2)
+                heartbeat()
+                subs.append(fitstate_from_packed(
+                    np.asarray(theta2), stats2, meta2
+                ))
+            state2 = jax.tree.map(
+                lambda *xs: np.concatenate(xs, axis=0)[:n_s], *subs
+            )
         for (lo, hi), z in files.items():
             if z.get("phase2") is not None:
                 continue

@@ -290,3 +290,58 @@ def test_jit_compatible():
     jitted = jax.jit(lambda t0: lbfgs.minimize(fun, t0))
     res = jitted(jnp.ones((4, 3)))
     np.testing.assert_allclose(np.asarray(res.theta), 0.0, atol=1e-4)
+
+
+def test_dynamic_depth_matches_static():
+    """fit_core's traced depth/metric/init switches reproduce the static
+    configuration exactly: a full-depth static solver driven with
+    max_iters_dynamic=K, gn flag off, and ridge-init selected dynamically
+    lands bit-close to a static max_iters=K solver (ones preconditioner,
+    theta0=None).  This is the invariant that lets the bench's two phases
+    share ONE compiled program."""
+    import numpy as np
+
+    from tsspark_tpu.config import (
+        ProphetConfig, SeasonalityConfig, SolverConfig,
+    )
+    from tsspark_tpu.models.prophet.design import prepare_fit_data
+    from tsspark_tpu.models.prophet.model import fit_core
+
+    rng = np.random.default_rng(11)
+    b, t_len = 16, 150
+    ds = np.arange(t_len, dtype=np.float64)
+    y = 4 + 0.03 * ds[None] + np.sin(2 * np.pi * ds[None] / 7.0) \
+        + rng.normal(0, 0.15, (b, t_len))
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=5,
+    )
+    data, _ = prepare_fit_data(ds, y, cfg)
+
+    res_static = fit_core(data, None, cfg, SolverConfig(max_iters=9))
+    res_dyn = fit_core(
+        data,
+        np.zeros_like(np.asarray(res_static.theta)),  # ignored: flag off
+        cfg,
+        SolverConfig(max_iters=120),
+        max_iters_dynamic=np.int32(9),
+        gn_precond_dynamic=np.bool_(False),
+        use_theta0_dynamic=np.bool_(False),
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_dyn.theta), np.asarray(res_static.theta), atol=1e-6
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_dyn.n_iters), np.asarray(res_static.n_iters)
+    )
+    # Warm-start selection: flag ON continues from the given thetas.
+    res_warm = fit_core(
+        data,
+        np.asarray(res_static.theta),
+        cfg,
+        SolverConfig(max_iters=120),
+        max_iters_dynamic=np.int32(120),
+        gn_precond_dynamic=np.bool_(True),
+        use_theta0_dynamic=np.bool_(True),
+    )
+    assert bool(np.all(np.asarray(res_warm.f) <= np.asarray(res_static.f) + 1e-5))

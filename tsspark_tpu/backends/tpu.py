@@ -93,7 +93,8 @@ class TpuBackend(ForecastBackend):
         self._model = ProphetModel(self.config, self.solver_config)
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
-            init=None, conditions=None):
+            init=None, conditions=None, max_iters_dynamic=None,
+            gn_precond_dynamic=None, use_init_dynamic=None):
         # Host numpy end-to-end until each chunk's single fit dispatch:
         # a device array here would ship the whole batch over the link only
         # for prepare_fit_data to pull it back for the numpy prep.
@@ -113,9 +114,15 @@ class TpuBackend(ForecastBackend):
         )
         if regressors is not None and not segmented:
             u8 = _indicator_reg_cols(np.asarray(regressors))
+        dyn = dict(
+            max_iters_dynamic=max_iters_dynamic,
+            gn_precond_dynamic=gn_precond_dynamic,
+            use_init_dynamic=use_init_dynamic,
+        )
         if b <= c:
             return self._fit_padded(
-                ds, y, mask, cap, floor, regressors, init, conditions, c, u8
+                ds, y, mask, cap, floor, regressors, init, conditions, c,
+                u8, dyn,
             )
 
         states = []
@@ -129,13 +136,13 @@ class TpuBackend(ForecastBackend):
                 self._fit_padded(
                     ds if ds.ndim == 1 else ds[lo:hi],
                     y[lo:hi], sl(mask), sl(cap), sl(floor), sl(regressors),
-                    sl(init), slc(conditions), c, u8,
+                    sl(init), slc(conditions), c, u8, dyn,
                 )
             )
         return _concat_states(states)
 
     def _fit_padded(self, ds, y, mask, cap, floor, regressors, init,
-                    conditions, c, reg_u8_cols=None):
+                    conditions, c, reg_u8_cols=None, dyn=None):
         b = y.shape[0]
         if b < c:
             if ds.ndim == 2:
@@ -165,7 +172,7 @@ class TpuBackend(ForecastBackend):
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
             init=init, iter_segment=self.iter_segment,
             on_segment=self.on_segment, conditions=conditions,
-            reg_u8_cols=reg_u8_cols,
+            reg_u8_cols=reg_u8_cols, **(dyn or {}),
         )
         return _slice_state(state, 0, b)
 
@@ -181,27 +188,71 @@ class TpuBackend(ForecastBackend):
         more than ``phase1_iters``.  Phase 1 fits everything with a
         ``phase1_iters`` cap; phase 2 gathers the unconverged series into
         one small compacted batch and continues only those (warm-started
-        from their phase-1 parameters) at the full ``max_iters`` depth.
-        Device work drops from O(B * max_iters) to
-        O(B * phase1_iters + stragglers * max_iters).
+        from their phase-1 parameters, with the GN-diagonal initial metric
+        — stragglers are by construction the ill-conditioned tail) at the
+        full ``max_iters`` depth.  Device work drops from
+        O(B * max_iters) to O(B * phase1_iters + stragglers * max_iters).
+
+        Both phases ride the TRACED phase controls (fit_core's *_dynamic
+        args), so on the packed path they share ONE compiled program; the
+        straggler batch is additionally padded to phase 1's chunk size so
+        no second program shape is compiled either.  Segmented solves fall
+        back to per-phase static configs (bounded dispatches win there).
         """
-        state = self._phase1(phase1_iters).fit(
-            ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
-            init=init, conditions=conditions,
-        )
+        if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
+            phase1_state = self._phase1(phase1_iters).fit(
+                ds, y, mask=mask, cap=cap, floor=floor,
+                regressors=regressors, init=init, conditions=conditions,
+            )
+        else:
+            phase1_state = self.fit(
+                ds, y, mask=mask, cap=cap, floor=floor,
+                regressors=regressors, init=init, conditions=conditions,
+                max_iters_dynamic=np.int32(phase1_iters),
+                gn_precond_dynamic=np.bool_(False),
+                use_init_dynamic=np.bool_(init is not None),
+            )
+        state = phase1_state
         idx = np.flatnonzero(~np.asarray(state.converged))
         if idx.size == 0:
             return state
-        sub = lambda a: None if a is None else np.asarray(a)[idx]
-        state2 = self._straggler_backend().fit(
-            ds if np.asarray(ds).ndim == 1 else np.asarray(ds)[idx],
-            np.asarray(y)[idx], mask=sub(mask), cap=sub(cap),
-            floor=sub(floor), regressors=sub(regressors),
-            init=np.asarray(state.theta)[idx],
+        b = np.asarray(y).shape[0]
+        c = min(self.chunk_size, _next_pow2(b))
+        pad = (-idx.size) % c
+
+        def sub(a, fill=0.0):
+            if a is None:
+                return None
+            a = np.asarray(a)[idx]
+            return np.concatenate(
+                [a, np.full((pad,) + a.shape[1:], fill, a.dtype)]
+            ) if pad else a
+
+        if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
+            fit2 = self._straggler_backend().fit
+            dyn2 = {}
+        else:
+            fit2 = self.fit
+            dyn2 = dict(
+                max_iters_dynamic=np.int32(self.solver_config.max_iters),
+                gn_precond_dynamic=np.bool_(True),
+                use_init_dynamic=np.bool_(True),
+            )
+        state2 = fit2(
+            ds if np.asarray(ds).ndim == 1 else sub(np.asarray(ds)),
+            sub(y), mask=sub(mask if mask is not None
+                             else np.isfinite(np.asarray(y))
+                             .astype(np.float32)),
+            cap=sub(cap, fill=1.0), floor=sub(floor),
+            regressors=sub(regressors),
+            init=sub(np.asarray(state.theta)),
             conditions=None if conditions is None else {
-                k: np.asarray(v)[idx] for k, v in conditions.items()
+                k: sub(v) for k, v in conditions.items()
             },
+            **dyn2,
         )
+        if pad:
+            state2 = _slice_state(state2, 0, idx.size)
         return patch_state(state, idx, state2)
 
     def _derived(self, **solver_overrides) -> "TpuBackend":

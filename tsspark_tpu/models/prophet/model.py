@@ -10,6 +10,7 @@ in, MAP parameters out.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Dict, NamedTuple, Optional, Tuple
 
@@ -58,22 +59,45 @@ def fit_core(
     theta0: Optional[jnp.ndarray],
     config: ProphetConfig,
     solver_config: SolverConfig,
+    max_iters_dynamic: Optional[jnp.ndarray] = None,
+    gn_precond_dynamic: Optional[jnp.ndarray] = None,
+    use_theta0_dynamic: Optional[jnp.ndarray] = None,
 ) -> lbfgs.LbfgsResult:
     """The jitted batched MAP solve: the whole fit is one XLA program.
 
     ``theta0=None`` computes the warm start (closed-form ridge by default,
     init.py) inside the same program — no extra dispatch, no host round-trip.
+
+    ``max_iters_dynamic`` / ``gn_precond_dynamic`` / ``use_theta0_dynamic``:
+    optional TRACED solve depth, GN-diagonal-metric switch, and
+    warm-start-vs-ridge-init switch.  Passing these (instead of baking them
+    into the static ``solver_config`` / the static presence of ``theta0``)
+    lets callers drive shallow ridge-initialized passes AND deep
+    warm-started preconditioned passes through ONE compiled program — the
+    bench's two phases share a single executable this way.  When
+    ``gn_precond_dynamic`` is given, the curvature diagonal is always
+    computed (a few (B, T) passes) and blended to ones where the flag is
+    off; when ``use_theta0_dynamic`` is given, the ridge init is always
+    computed and ``theta0`` (required) is selected where the flag is on.
     """
-    if theta0 is None:
+    if use_theta0_dynamic is not None:
+        ridge = initial_theta(data, config, solver_config)
+        theta0 = jnp.where(use_theta0_dynamic, theta0, ridge)
+    elif theta0 is None:
         theta0 = initial_theta(data, config, solver_config)
-    precond = (curvature_diag(data, config, theta0)
-               if solver_config.precond == "gn_diag" else None)
+    if gn_precond_dynamic is not None:
+        diag = curvature_diag(data, config, theta0)
+        precond = jnp.where(gn_precond_dynamic, diag, jnp.ones_like(diag))
+    else:
+        precond = (curvature_diag(data, config, theta0)
+                   if solver_config.precond == "gn_diag" else None)
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
     fan = (lambda th, d, s: fan_value_closed_form(th, d, s, data, config)) \
         if has_closed_form_fan(config) else None
     return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval,
-                          precond=precond, fan_value=fan)
+                          precond=precond, fan_value=fan,
+                          max_iters_dynamic=max_iters_dynamic)
 
 
 @functools.partial(
@@ -85,6 +109,9 @@ def fit_core_packed(
     config: ProphetConfig,
     solver_config: SolverConfig,
     reg_u8_cols: Tuple[int, ...] = (),
+    max_iters_dynamic: Optional[jnp.ndarray] = None,
+    gn_precond_dynamic: Optional[jnp.ndarray] = None,
+    use_theta0_dynamic: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """fit_core over a transfer-optimized PackedFitData (design.py).
 
@@ -94,11 +121,17 @@ def fit_core_packed(
     too: (theta (B, P), stats (5, B) f32 rows = loss, grad_norm, converged,
     n_iters, status) — two readbacks instead of six (each device->host
     buffer is a separate ~40 ms round trip on the tunneled runtime).
+
+    ``max_iters_dynamic`` / ``gn_precond_dynamic``: traced depth / metric
+    switch (see fit_core) — one compiled program for both bench phases.
     """
     from tsspark_tpu.models.prophet.design import unpack_fit_data
 
     res = fit_core(
-        unpack_fit_data(packed, reg_u8_cols), theta0, config, solver_config
+        unpack_fit_data(packed, reg_u8_cols), theta0, config, solver_config,
+        max_iters_dynamic=max_iters_dynamic,
+        gn_precond_dynamic=gn_precond_dynamic,
+        use_theta0_dynamic=use_theta0_dynamic,
     )
     f32 = res.f.dtype
     stats = jnp.stack([
@@ -148,6 +181,20 @@ def fit_segment_core(
         if has_closed_form_fan(config) else None
     return lbfgs.run_segment(fun, state, solver_config, num_iters,
                              fun_value=fval, fan_value=fan)
+
+
+def fitstate_from_packed(theta, stats, meta: ScalingMeta) -> "FitState":
+    """FitState from fit_core_packed's (theta, (5, B) stats) result."""
+    stats = np.asarray(stats)
+    return FitState(
+        theta=theta,
+        meta=meta,
+        loss=stats[0],
+        grad_norm=stats[1],
+        converged=stats[2].astype(bool),
+        n_iters=stats[3].astype(np.int32),
+        status=stats[4].astype(np.int32),
+    )
 
 
 class McmcState(NamedTuple):
@@ -238,6 +285,9 @@ class ProphetModel:
         on_segment=None,
         conditions=None,
         reg_u8_cols: Optional[Tuple[int, ...]] = None,
+        max_iters_dynamic=None,
+        gn_precond_dynamic=None,
+        use_init_dynamic=None,
     ) -> FitState:
         """Fit every series in the (B, T) batch.
 
@@ -263,6 +313,13 @@ class ProphetModel:
         plain FitData path.  ``reg_u8_cols`` pins which regressor columns
         travel as uint8 (chunked callers must decide once per dataset —
         see pack_fit_data).
+
+        ``max_iters_dynamic`` / ``gn_precond_dynamic`` / ``use_init_dynamic``:
+        TRACED phase controls (see fit_core) letting a two-phase caller
+        drive shallow ridge-initialized and deep warm-started solves
+        through one compiled program.  On the non-packable fallback they
+        are honored semantically (folded into an equivalent static solver
+        config), just without the shared-program benefit.
         """
         data, meta = prepare_fit_data(
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
@@ -274,6 +331,7 @@ class ProphetModel:
             and not (iter_segment and iter_segment < self.solver_config.max_iters)
             and bool(np.all((mask_np == 0.0) | (mask_np == 1.0)))
         )
+        dynamic = max_iters_dynamic is not None
         if packable:
             # Not guarded by try/except: pack_fit_data's remaining failure
             # mode (reg_u8_cols naming a non-0/1 column) is a caller
@@ -281,21 +339,37 @@ class ProphetModel:
             packed, u8 = pack_fit_data(
                 data, meta, ds, reg_u8_cols=reg_u8_cols
             )
+            theta0 = init
+            if dynamic and theta0 is None:
+                # use_init flag off: the array is never selected, but the
+                # traced program needs a concrete operand.
+                theta0 = np.zeros(
+                    (np.asarray(data.y).shape[0], self.config.num_params),
+                    np.float32,
+                )
             theta, stats = fit_core_packed(
-                packed, init, self.config, self.solver_config,
+                packed, theta0, self.config, self.solver_config,
                 reg_u8_cols=u8,
+                max_iters_dynamic=max_iters_dynamic,
+                gn_precond_dynamic=gn_precond_dynamic,
+                use_theta0_dynamic=use_init_dynamic,
             )
             if on_segment is not None:
                 on_segment()
-            stats = np.asarray(stats)
-            return FitState(
-                theta=theta,
-                meta=meta,
-                loss=stats[0],
-                grad_norm=stats[1],
-                converged=stats[2].astype(bool),
-                n_iters=stats[3].astype(np.int32),
-                status=stats[4].astype(np.int32),
+            return fitstate_from_packed(theta, stats, meta)
+        if dynamic:
+            # Fallback path: fold the traced phase controls into an
+            # equivalent static solver (semantics preserved; the
+            # shared-program benefit only exists on the packed path).
+            solver = dataclasses.replace(
+                self.solver_config,
+                max_iters=int(max_iters_dynamic),
+                precond="gn_diag" if bool(gn_precond_dynamic) else "none",
+            )
+            fallback = ProphetModel(self.config, solver)
+            theta0 = init if bool(use_init_dynamic) else None
+            return fallback._fit_prepared(
+                data, meta, theta0, iter_segment, on_segment
             )
         return self._fit_prepared(data, meta, init, iter_segment, on_segment)
 

@@ -185,6 +185,7 @@ def run_segment(
     num_iters: Optional[int] = None,
     fun_value: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
     fan_value=None,
+    max_iters_dynamic: Optional[jnp.ndarray] = None,
 ) -> LbfgsState:
     """Advance the solver by up to ``num_iters`` iterations (bounded by
     ``config.max_iters`` overall).
@@ -202,14 +203,25 @@ def run_segment(
     any feature mode: loss.fan_value_closed_form) this replaces K stacked
     model evaluations with closed-form reductions — the trial LOSSES are
     identical to the stacked path up to float32 rounding.
+
+    ``max_iters_dynamic``: optional TRACED scalar overriding
+    ``config.max_iters`` as the total-iteration cap (still clamped by it).
+    Because ``lax.while_loop`` takes dynamic trip counts, callers can run
+    shallow and deep solves through ONE compiled program instead of one
+    program per static depth (config.max_iters is part of the jit static
+    key) — the bench's two-phase fit shares a single program this way.
     """
     if fun_value is None:
         fun_value = lambda th: fun(th)[0]
     b, p = state.theta.shape
     m = config.history
+    cap = (
+        config.max_iters if max_iters_dynamic is None
+        else jnp.minimum(max_iters_dynamic, config.max_iters)
+    )
     stop_at = jnp.minimum(
         state.iteration + (config.max_iters if num_iters is None else num_iters),
-        config.max_iters,
+        cap,
     )
 
     def cond(state: LbfgsState):
@@ -373,6 +385,7 @@ def minimize(
     fun_value: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
     precond: Optional[jnp.ndarray] = None,
     fan_value=None,
+    max_iters_dynamic: Optional[jnp.ndarray] = None,
 ) -> LbfgsResult:
     """Minimize a batch of independent objectives with shared compute.
 
@@ -383,6 +396,7 @@ def minimize(
         (defaults to ``fun(th)[0]``, which wastes the gradient).
       precond: optional (B, P) inverse-curvature diagonal (initial metric).
       fan_value: optional closed-form ladder evaluator (see run_segment).
+      max_iters_dynamic: optional traced iteration cap (see run_segment).
 
     Returns:
       LbfgsResult with per-series optimum, loss, grad inf-norm, convergence
@@ -392,5 +406,6 @@ def minimize(
         run_segment(
             fun, init_state(fun, theta0, config, precond), config,
             fun_value=fun_value, fan_value=fan_value,
+            max_iters_dynamic=max_iters_dynamic,
         )
     )
