@@ -8,7 +8,7 @@ import jax.numpy as jnp
 import pytest
 
 import tsspark_tpu as tt
-from tsspark_tpu.config import ProphetConfig, RegressorConfig, WEEKLY
+from tsspark_tpu.config import ProphetConfig, RegressorConfig, SolverConfig, WEEKLY
 from tsspark_tpu.frame import Forecaster, pivot_long
 from tsspark_tpu.models.prophet.design import prepare_fit_data
 
@@ -141,3 +141,40 @@ def test_fit_prophet_compat_namespace():
     y = (5 + 0.1 * np.arange(n) + rng.normal(0, 0.2, (1, n))).astype(np.float32)
     state = model.fit(jnp.arange(float(n)), jnp.asarray(y))
     assert np.isfinite(float(state.loss[0]))
+
+
+def test_make_future_frame_and_builders():
+    """Chainable config builders + make_future_frame edit-then-predict loop
+    (Prophet's add_regressor / make_future_dataframe workflow)."""
+    rng = np.random.default_rng(5)
+    ds = pd.date_range("2022-01-01", periods=200, freq="D")
+    promo = (rng.random(200) < 0.1).astype(float)
+    y = 10 + 0.02 * np.arange(200) + 2.0 * promo + rng.normal(0, 0.1, 200)
+    df = pd.DataFrame(
+        {"series_id": "a", "ds": ds, "y": y, "promo": promo}
+    )
+
+    cfg = (
+        ProphetConfig(seasonalities=(), n_changepoints=3)
+        .with_seasonality("weekly", 7.0, 2)
+        .with_regressor("promo", standardize=False)
+    )
+    assert [s.name for s in cfg.seasonalities] == ["weekly"]
+    assert [r.name for r in cfg.regressors] == ["promo"]
+    with pytest.raises(ValueError, match="duplicate"):
+        cfg.with_regressor("promo")
+
+    fc = Forecaster(cfg, SolverConfig(max_iters=60), backend="tpu").fit(df)
+    fut = fc.make_future_frame(horizon=14)
+    assert len(fut) == 14
+    assert fut["ds"].min() > df["ds"].max()
+    # Regressor models refuse bare horizon but accept the edited frame.
+    with pytest.raises(ValueError, match="future_df"):
+        fc.predict(horizon=14)
+    fut["promo"] = 1.0
+    hi = fc.predict(future_df=fut)
+    fut2 = fc.make_future_frame(horizon=14)
+    fut2["promo"] = 0.0
+    lo = fc.predict(future_df=fut2)
+    # The recovered promo effect separates the two futures.
+    assert float((hi.yhat - lo.yhat).mean()) > 1.0

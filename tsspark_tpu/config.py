@@ -117,6 +117,44 @@ class ProphetConfig:
         if len(names) != len(set(names)):
             raise ValueError(f"duplicate seasonality/regressor names: {names}")
 
+    # ---- chainable builders (Prophet's add_seasonality/add_regressor) --------
+
+    def with_seasonality(
+        self,
+        name: str,
+        period: float,
+        fourier_order: int,
+        prior_scale: float = 10.0,
+        mode: Optional[str] = None,
+        condition_name: Optional[str] = None,
+    ) -> "ProphetConfig":
+        """Config with one more seasonality — the immutable counterpart of
+        Prophet's ``m.add_seasonality(...)``.  ``mode=None`` inherits
+        ``seasonality_mode``.  Chainable; duplicate names raise via
+        __post_init__."""
+        s = SeasonalityConfig(
+            name, period, fourier_order, prior_scale=prior_scale,
+            mode=mode or self.seasonality_mode,
+            condition_name=condition_name,
+        )
+        return dataclasses.replace(
+            self, seasonalities=self.seasonalities + (s,)
+        )
+
+    def with_regressor(
+        self,
+        name: str,
+        prior_scale: float = 10.0,
+        standardize: bool = True,
+        mode: str = "additive",
+    ) -> "ProphetConfig":
+        """Config with one more external regressor (Prophet's
+        ``m.add_regressor(...)``).  Chainable."""
+        r = RegressorConfig(
+            name, prior_scale=prior_scale, standardize=standardize, mode=mode
+        )
+        return dataclasses.replace(self, regressors=self.regressors + (r,))
+
     # ---- static shape helpers -------------------------------------------------
 
     @property

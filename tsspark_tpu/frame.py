@@ -133,6 +133,12 @@ class Forecaster:
         then carry seasonality/regressor uncertainty from the posterior
         draws instead of the MAP trend simulation.  MCMC runs unchunked —
         intended for batches that fit on one device."""
+        # Prophet's add_regressor implies the input column is named after
+        # the regressor: when the config declares regressors and no
+        # explicit column mapping is given, default to the declared names
+        # (previously an error demanding regressor_cols).
+        if not regressor_cols and config.regressors:
+            regressor_cols = tuple(r.name for r in config.regressors)
         # Holidays are sugar over the regressor path: each (holiday, offset)
         # appends an unstandardized indicator column after the user's
         # regressor columns; the indicator values are computed from the
@@ -346,6 +352,24 @@ class Forecaster:
         last = self._train_ds[-1]
         fut = last + self._freq_days * np.arange(1, horizon + 1)
         return np.concatenate([self._train_ds, fut]) if include_history else fut
+
+    def make_future_frame(
+        self, horizon: int, include_history: bool = False
+    ) -> pd.DataFrame:
+        """Long (series_id, ds) frame continuing the training calendar —
+        Prophet's ``make_future_dataframe`` for the batched case.
+
+        The intended edit-then-predict loop for models that need future
+        covariates: add cap/regressor/condition columns to the returned
+        frame, then call ``predict(future_df=...)``.
+        """
+        grid = self.make_future_grid(horizon, include_history)
+        ds_rep = np.tile(grid, len(self.series_ids))
+        return pd.DataFrame({
+            self.id_col: np.repeat(list(self.series_ids), len(grid)),
+            self.ds_col: _days_to_ts(ds_rep) if self._was_datetime
+            else ds_rep,
+        })
 
     def predict(
         self,
