@@ -178,3 +178,84 @@ def test_make_future_frame_and_builders():
     lo = fc.predict(future_df=fut2)
     # The recovered promo effect separates the two futures.
     assert float((hi.yhat - lo.yhat).mean()) > 1.0
+
+
+def test_explicit_changepoints():
+    """Prophet's changepoints= arg: a known trend break at an explicit date
+    is recovered, and the config's grid is pinned to exactly those dates."""
+    rng = np.random.default_rng(9)
+    n = 300
+    ds = pd.date_range("2022-01-01", periods=n, freq="D")
+    t = np.arange(n)
+    brk = 150
+    y = 5 + 0.05 * t - 0.09 * np.maximum(t - brk, 0) + rng.normal(0, 0.1, n)
+    df = pd.DataFrame({"series_id": "a", "ds": ds, "y": y})
+
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(), changepoint_prior_scale=1.0),
+        SolverConfig(max_iters=80),
+        backend="tpu",
+        changepoints=[ds[brk]],
+    )
+    assert fc.config.n_changepoints == 1
+    fc.fit(df)
+    # Slope before vs after the break, from the fitted trend.
+    comp = fc.predict(future_df=df[["series_id", "ds"]])
+    trend = comp["trend"].to_numpy()
+    pre = np.polyfit(t[20:brk], trend[20:brk], 1)[0]
+    post = np.polyfit(t[brk + 20:], trend[brk + 20:], 1)[0]
+    assert pre - post > 0.05, (pre, post)
+
+
+def test_predictive_samples():
+    """Raw draw tensor: right shape, centered on yhat, in data units."""
+    df = _long_df(n_days=100, n_series=3)
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(WEEKLY,), n_changepoints=3),
+        SolverConfig(max_iters=40),
+        backend="tpu",
+    ).fit(df)
+    out = fc.predictive_samples(horizon=10, num_samples=64, seed=1)
+    s = out["yhat_samples"]
+    assert s.shape == (64, 3, 10)
+    assert out["ds"].shape == (10,)
+    point = fc.predict(horizon=10)
+    med = np.median(s, axis=0).ravel()
+    np.testing.assert_allclose(
+        med, point["yhat"].to_numpy(), atol=np.abs(med).mean() * 0.5 + 1.0
+    )
+
+
+def test_predictive_samples_guards_and_numeric_changepoints():
+    df = _long_df(n_days=80, n_series=2)
+    # Sampling disabled -> clear error, not KeyError.
+    fc0 = Forecaster(
+        ProphetConfig(seasonalities=(), n_changepoints=2,
+                      uncertainty_samples=0),
+        SolverConfig(max_iters=20), backend="cpu",
+    ).fit(df)
+    with pytest.raises(ValueError, match="uncertainty_samples"):
+        fc0.predictive_samples(horizon=5)
+    # Backend-independence: raw draws work through the scipy cpu backend.
+    out = fc0.predictive_samples(horizon=5, num_samples=16)
+    assert out["yhat_samples"].shape == (16, 2, 5)
+    # numpy-integer changepoints on a NUMERIC calendar stay in day units
+    # (pd.to_datetime would read them as nanoseconds).
+    dfn = df.copy()
+    dfn["ds"] = (
+        (pd.to_datetime(df["ds"]) - pd.Timestamp("1970-01-01"))
+        / pd.Timedelta(days=1)
+    )
+    day40 = float(dfn["ds"].iloc[40])
+    fcn = Forecaster(
+        ProphetConfig(seasonalities=()),
+        SolverConfig(max_iters=10), backend="cpu",
+        changepoints=np.array([int(day40)], dtype=np.int64),
+    )
+    assert fcn.config.changepoints == (float(int(day40)),)
+    # Out-of-span explicit changepoint warns instead of failing the batch.
+    with pytest.warns(UserWarning, match="outside their observed span"):
+        Forecaster(
+            ProphetConfig(seasonalities=()), SolverConfig(max_iters=5),
+            backend="cpu", changepoints=[df["ds"].max() + pd.Timedelta(days=400)],
+        ).fit(df)

@@ -89,6 +89,12 @@ class ProphetConfig:
     # regular grids, zero gathers).  "quantile": observed-timestamp order
     # statistics per series (Prophet's placement) — use for irregular grids.
     changepoint_placement: str = "uniform"
+    # Explicit changepoint locations in absolute days (Prophet's
+    # ``changepoints=`` constructor arg; Forecaster converts datetimes).
+    # When set, overrides placement and n_changepoints (forced to its
+    # length); locations are shared across the batch in absolute time and
+    # land at per-series scaled positions via each series' own span.
+    changepoints: Optional[Tuple[float, ...]] = None
     seasonalities: Tuple[SeasonalityConfig, ...] = (YEARLY, WEEKLY)
     regressors: Tuple[RegressorConfig, ...] = ()
     seasonality_mode: str = "additive"  # default mode for seasonalities
@@ -100,6 +106,10 @@ class ProphetConfig:
     sigma_prior_scale: float = 0.5  # half-normal scale on observation noise
 
     def __post_init__(self):
+        if self.changepoints is not None:
+            cps = tuple(sorted(float(c) for c in self.changepoints))
+            object.__setattr__(self, "changepoints", cps)
+            object.__setattr__(self, "n_changepoints", len(cps))
         if self.growth not in ("linear", "logistic", "flat"):
             raise ValueError(f"growth must be linear|logistic|flat, got {self.growth}")
         if self.changepoint_placement not in ("uniform", "quantile"):

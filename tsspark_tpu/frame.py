@@ -13,6 +13,7 @@ datetime64 resolution or plain numeric "days" column works.
 
 from __future__ import annotations
 
+import dataclasses
 import warnings
 from typing import Dict, NamedTuple, Optional, Sequence
 
@@ -123,6 +124,7 @@ class Forecaster:
         floor_col: Optional[str] = None,
         regressor_cols: Sequence[str] = (),
         holidays: Sequence[holidays_mod.Holiday] = (),
+        changepoints: Optional[Sequence] = None,
         mcmc_samples: int = 0,
         mcmc_config: Optional[McmcConfig] = None,
         auto_seasonality: bool = False,
@@ -133,6 +135,23 @@ class Forecaster:
         then carry seasonality/regressor uncertainty from the posterior
         draws instead of the MAP trend simulation.  MCMC runs unchunked —
         intended for batches that fit on one device."""
+        # Explicit changepoint dates (Prophet's ``changepoints=``):
+        # datetimes/strings/numbers accepted, converted to absolute days.
+        # Numeric covers numpy scalars too — np.int64 is not an `int`, and
+        # routing it through pd.to_datetime would read it as NANOSECONDS
+        # since epoch (a silently inert changepoint at day ~0).
+        if changepoints is not None:
+            cps = list(changepoints)
+            numeric = all(
+                isinstance(c, (int, float, np.integer, np.floating))
+                for c in cps
+            )
+            days = _ds_to_days(
+                pd.Series(cps if numeric else pd.to_datetime(cps))
+            )
+            config = dataclasses.replace(
+                config, changepoints=tuple(float(d) for d in days)
+            )
         # Prophet's add_regressor implies the input column is named after
         # the regressor: when the config declares regressors and no
         # explicit column mapping is given, default to the declared names
@@ -387,35 +406,9 @@ class Forecaster:
         """
         if self.state is None:
             raise RuntimeError("fit before predict")
-        if horizon is not None and not isinstance(horizon, (int, np.integer)):
-            # A DataFrame passed positionally lands here and would otherwise
-            # surface as an inscrutable pandas arithmetic error downstream.
-            raise TypeError(
-                f"horizon must be an int, got {type(horizon).__name__}; "
-                "pass a frame as predict(future_df=...)"
-            )
-        if future_df is not None:
-            grid, cap, reg, conditions = self._align_future(future_df)
-        else:
-            if horizon is None:
-                raise ValueError("give horizon or future_df")
-            if self.regressor_cols:
-                raise ValueError(
-                    "models with external regressors need future_df with "
-                    "future regressor values"
-                )
-            if self.config.condition_names:
-                raise ValueError(
-                    "models with conditional seasonalities need future_df "
-                    "with future condition values"
-                )
-            grid = self.make_future_grid(horizon, include_history)
-            cap = None
-            reg = None
-            conditions = None
-            if self.cap_col is not None:
-                raise ValueError("logistic models need future_df with cap")
-
+        grid, cap, reg, conditions = self._resolve_future(
+            horizon, future_df, include_history
+        )
         reg = self._combined_regressors(grid, reg, len(self.series_ids))
         cap_j = None if cap is None else jnp.asarray(np.nan_to_num(cap))
         reg_j = None if reg is None else jnp.asarray(reg)
@@ -432,6 +425,94 @@ class Forecaster:
                 seed=seed, num_samples=num_samples, conditions=conditions,
             )
         return self._to_long(grid, fc)
+
+    def _resolve_future(
+        self,
+        horizon: Optional[int],
+        future_df: Optional[pd.DataFrame],
+        include_history: bool,
+    ):
+        """Shared grid/cap/regressor/condition resolution for every
+        forecast-shaped entry point (predict, predictive_samples)."""
+        if horizon is not None and not isinstance(
+            horizon, (int, np.integer)
+        ):
+            # A DataFrame passed positionally lands here and would
+            # otherwise surface as an inscrutable pandas error downstream.
+            raise TypeError(
+                f"horizon must be an int, got {type(horizon).__name__}; "
+                "pass a frame as future_df=..."
+            )
+        if future_df is not None:
+            return self._align_future(future_df)
+        if horizon is None:
+            raise ValueError("give horizon or future_df")
+        if self.regressor_cols:
+            raise ValueError(
+                "models with external regressors need future_df with "
+                "future regressor values"
+            )
+        if self.config.condition_names:
+            raise ValueError(
+                "models with conditional seasonalities need future_df "
+                "with future condition values"
+            )
+        if self.cap_col is not None:
+            raise ValueError("logistic models need future_df with cap")
+        grid = self.make_future_grid(horizon, include_history)
+        return grid, None, None, None
+
+    def predictive_samples(
+        self,
+        horizon: Optional[int] = None,
+        future_df: Optional[pd.DataFrame] = None,
+        include_history: bool = False,
+        seed: int = 0,
+        num_samples: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """Raw posterior-predictive draws (Prophet's ``predictive_samples``).
+
+        Returns {"series_ids": (B,), "ds": (T,) grid,
+        "yhat_samples": (S, B, T) in data units}.  Runs UNCHUNKED — the
+        draws tensor is the product of samples x series x grid points;
+        budget ``num_samples`` accordingly for large batches.
+        """
+        if self.state is None:
+            raise RuntimeError("fit before predictive_samples")
+        if self.mcmc_state is not None:
+            raise NotImplementedError(
+                "predictive_samples for MCMC fits is not implemented; "
+                "predict() intervals already carry the posterior draws"
+            )
+        n_s = (
+            self.config.uncertainty_samples if num_samples is None
+            else num_samples
+        )
+        if not n_s:
+            raise ValueError(
+                "predictive_samples needs uncertainty_samples > 0 (config) "
+                "or num_samples > 0"
+            )
+        grid, cap, reg, conditions = self._resolve_future(
+            horizon, future_df, include_history
+        )
+        reg = self._combined_regressors(grid, reg, len(self.series_ids))
+        # Backend-independent: MAP sampling needs only the model layer and
+        # the fitted state (self.backend may be any registered backend).
+        model = ProphetModel(self.config, self.backend.solver_config)
+        fc = model.predict(
+            self.state, jnp.asarray(grid),
+            cap=None if cap is None else jnp.asarray(np.nan_to_num(cap)),
+            regressors=None if reg is None else jnp.asarray(reg),
+            seed=seed, num_samples=num_samples, conditions=conditions,
+            return_samples=True,
+        )
+        ds_out = _days_to_ts(grid) if self._was_datetime else grid
+        return {
+            "series_ids": np.asarray(self.series_ids),
+            "ds": np.asarray(ds_out),
+            "yhat_samples": np.asarray(fc["yhat_samples"]),
+        }
 
     def _align_future(self, future_df: pd.DataFrame):
         """Pivot a future frame and align its series order with training."""

@@ -368,7 +368,30 @@ def prepare_fit_data(
     # pay a tiny-XLA-compile + tunnel dispatch on the per-chunk fit path.
     # The chosen grid is recorded in ScalingMeta so prediction, warm-start
     # transfer, and checkpoint restore all reuse the FIT-time locations.
-    if config.changepoint_placement == "quantile":
+    if config.changepoints is not None:
+        # Explicit absolute-day locations (Prophet's ``changepoints=``):
+        # shared in absolute time, mapped into each series' scaled time.
+        cp = np.asarray(config.changepoints, np.float64)
+        s_f64 = (cp[None, :] - ds_start[:, None]) / ds_span[:, None]
+        # Upstream Prophet raises when a changepoint falls outside the
+        # training window; in a batched fit one shared date can be inside
+        # one series' span and outside another's, so warn (loudly, with
+        # counts) instead of failing the whole batch.  s < 0 is active
+        # from t=0 (perturbs the base slope's prior semantics); s > 1 is
+        # inert in-sample but kinks the forecast horizon.
+        out = (s_f64 <= 0.0) | (s_f64 >= 1.0)
+        if np.any(out):
+            import warnings
+
+            warnings.warn(
+                f"{int(out.any(axis=1).sum())} of {b} series have "
+                f"explicit changepoints outside their observed span "
+                f"({int(out.sum())} (series, changepoint) pairs); these "
+                "are inert or shift the base trend rather than kinking it",
+                stacklevel=2,
+            )
+        s = s_f64.astype(dtype)
+    elif config.changepoint_placement == "quantile":
         s = quantile_changepoints(
             t, mask_np, config.n_changepoints, config.changepoint_range
         ).astype(dtype)

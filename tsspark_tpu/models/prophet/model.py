@@ -465,6 +465,7 @@ class ProphetModel:
         seed: int = 0,
         num_samples: Optional[int] = None,
         conditions=None,
+        return_samples: bool = False,
     ) -> Dict[str, jnp.ndarray]:
         """Forecast on an arbitrary time grid (in-sample and/or future)."""
         data = predict_mod.prepare_predict_data(
@@ -474,7 +475,7 @@ class ProphetModel:
         key = jax.random.PRNGKey(seed)
         return predict_mod.forecast(
             state.theta, data, state.meta, self.config,
-            key=key, num_samples=num_samples,
+            key=key, num_samples=num_samples, return_samples=return_samples,
         )
 
     def predict_mcmc(

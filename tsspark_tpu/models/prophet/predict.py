@@ -266,12 +266,16 @@ def forecast(
     config: ProphetConfig,
     key: Optional[jax.Array] = None,
     num_samples: Optional[int] = None,
+    return_samples: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Point forecast + components + predictive intervals, in data units.
 
     Returns a dict with "yhat", "trend", "additive", "multiplicative",
     and (when sampling) "yhat_lower"/"yhat_upper"/"trend_lower"/"trend_upper",
-    all (B, T).
+    all (B, T).  ``return_samples`` additionally includes the raw
+    posterior-predictive draws as "yhat_samples" (S, B, T) — Prophet's
+    ``predictive_samples`` — sized S*B*T floats, the caller's memory to
+    budget.
     """
     p = unpack(theta, config)
     yhat_s, trend_s = model_yhat(theta, data, config)
@@ -300,4 +304,6 @@ def forecast(
         t_qs = jnp.quantile(trends, jnp.asarray([lo_q, hi_q]), axis=0)
         out["trend_lower"] = t_qs[0] * scale + floor
         out["trend_upper"] = t_qs[1] * scale + floor
+        if return_samples:
+            out["yhat_samples"] = samples * scale[None] + floor[None]
     return out
