@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tsspark_tpu.config import McmcConfig, ProphetConfig, SeasonalityConfig
+from tsspark_tpu.config import McmcConfig, ProphetConfig, SeasonalityConfig, SolverConfig
 from tsspark_tpu.models.prophet.model import ProphetModel
 from tsspark_tpu.ops import hmc
 
@@ -131,3 +131,33 @@ def test_forecaster_mcmc_samples_front_end():
     for sid in ("s0", "s1"):
         sub = out[out.series_id == sid]
         assert np.abs(sub["yhat"].to_numpy() - truth).mean() < 0.8
+
+
+def test_mcmc_predictive_samples():
+    """predictive_samples on an MCMC fit returns one trajectory per
+    retained draw, consistent with predict()'s posterior intervals."""
+    import pandas as pd
+
+    from tsspark_tpu.frame import Forecaster
+
+    rng = np.random.default_rng(2)
+    n = 120
+    ds = pd.date_range("2022-01-01", periods=n, freq="D")
+    frames = [
+        pd.DataFrame({
+            "series_id": f"s{i}",
+            "ds": ds,
+            "y": 5 + i + 0.02 * np.arange(n) + rng.normal(0, 0.2, n),
+        })
+        for i in range(2)
+    ]
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(), n_changepoints=3),
+        SolverConfig(max_iters=40),
+        backend="tpu",
+        mcmc_samples=24,
+        mcmc_config=McmcConfig(num_samples=24, num_warmup=24, num_leapfrog=8),
+    ).fit(pd.concat(frames, ignore_index=True))
+    out = fc.predictive_samples(horizon=7, num_samples=12)
+    assert out["yhat_samples"].shape == (12, 2, 7)
+    assert np.isfinite(out["yhat_samples"]).all()

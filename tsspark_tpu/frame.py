@@ -476,37 +476,48 @@ class Forecaster:
         "yhat_samples": (S, B, T) in data units}.  Runs UNCHUNKED — the
         draws tensor is the product of samples x series x grid points;
         budget ``num_samples`` accordingly for large batches.
+
+        MAP fits simulate future-changepoint trend paths + observation
+        noise (S = ``num_samples`` or ``config.uncertainty_samples``);
+        MCMC fits emit one trajectory per retained posterior draw
+        (``num_samples`` thins the chain), so seasonality/regressor
+        uncertainty rides along too.
         """
         if self.state is None:
             raise RuntimeError("fit before predictive_samples")
-        if self.mcmc_state is not None:
-            raise NotImplementedError(
-                "predictive_samples for MCMC fits is not implemented; "
-                "predict() intervals already carry the posterior draws"
+        if self.mcmc_state is None:
+            n_s = (
+                self.config.uncertainty_samples if num_samples is None
+                else num_samples
             )
-        n_s = (
-            self.config.uncertainty_samples if num_samples is None
-            else num_samples
-        )
-        if not n_s:
-            raise ValueError(
-                "predictive_samples needs uncertainty_samples > 0 (config) "
-                "or num_samples > 0"
-            )
+            if not n_s:
+                raise ValueError(
+                    "predictive_samples needs uncertainty_samples > 0 "
+                    "(config) or num_samples > 0"
+                )
         grid, cap, reg, conditions = self._resolve_future(
             horizon, future_df, include_history
         )
         reg = self._combined_regressors(grid, reg, len(self.series_ids))
-        # Backend-independent: MAP sampling needs only the model layer and
-        # the fitted state (self.backend may be any registered backend).
+        # Backend-independent: sampling needs only the model layer and the
+        # fitted state (self.backend may be any registered backend).
         model = ProphetModel(self.config, self.backend.solver_config)
-        fc = model.predict(
-            self.state, jnp.asarray(grid),
-            cap=None if cap is None else jnp.asarray(np.nan_to_num(cap)),
-            regressors=None if reg is None else jnp.asarray(reg),
-            seed=seed, num_samples=num_samples, conditions=conditions,
-            return_samples=True,
-        )
+        cap_j = None if cap is None else jnp.asarray(np.nan_to_num(cap))
+        reg_j = None if reg is None else jnp.asarray(reg)
+        if self.mcmc_state is not None:
+            # One draw trajectory per retained posterior sample — the
+            # sample count is the (possibly thinned) chain length.
+            fc = model.predict_mcmc(
+                self.mcmc_state, jnp.asarray(grid), cap=cap_j,
+                regressors=reg_j, seed=seed, max_draws=num_samples,
+                conditions=conditions, return_samples=True,
+            )
+        else:
+            fc = model.predict(
+                self.state, jnp.asarray(grid), cap=cap_j,
+                regressors=reg_j, seed=seed, num_samples=num_samples,
+                conditions=conditions, return_samples=True,
+            )
         ds_out = _days_to_ts(grid) if self._was_datetime else grid
         return {
             "series_ids": np.asarray(self.series_ids),

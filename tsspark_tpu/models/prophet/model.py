@@ -487,6 +487,7 @@ class ProphetModel:
         seed: int = 0,
         max_draws: Optional[int] = None,
         conditions=None,
+        return_samples: bool = False,
     ) -> Dict[str, jnp.ndarray]:
         """Posterior-predictive forecast from the MCMC draws."""
         data = predict_mod.prepare_predict_data(
@@ -498,7 +499,8 @@ class ProphetModel:
             idx = jnp.linspace(0, samples.shape[0] - 1, max_draws).astype(int)
             samples = samples[idx]
         return predict_mod.forecast_from_draws(
-            samples, data, state.meta, self.config, jax.random.PRNGKey(seed)
+            samples, data, state.meta, self.config, jax.random.PRNGKey(seed),
+            return_samples=return_samples,
         )
 
     def components(self, state: FitState, ds, cap=None, regressors=None,

@@ -207,6 +207,7 @@ def forecast_from_draws(
     config: ProphetConfig,
     key: jax.Array,
     interval_width: Optional[float] = None,
+    return_samples: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Posterior-predictive forecast from (S, B, P) MCMC draws.
 
@@ -256,6 +257,10 @@ def forecast_from_draws(
         "yhat_upper": qs[1] * scale + floor,
         "trend_lower": t_qs[0] * scale + floor,
         "trend_upper": t_qs[1] * scale + floor,
+        **(
+            {"yhat_samples": yhat_s * scale[None] + floor[None]}
+            if return_samples else {}
+        ),
     }
 
 
