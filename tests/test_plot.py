@@ -88,3 +88,31 @@ def test_plot_cross_validation_metric(tmp_path):
     assert ax2.get_ylabel() == "coverage"
     with pytest.raises(ValueError, match="unknown metric"):
         plot.plot_cross_validation_metric(cv, metric="nope")
+
+
+def test_add_changepoints_to_plot():
+    import pandas as pd
+
+    from tsspark_tpu.config import ProphetConfig, SolverConfig
+    from tsspark_tpu.frame import Forecaster
+    from tsspark_tpu import plot
+
+    rng = np.random.default_rng(3)
+    n = 200
+    ds = pd.date_range("2022-01-01", periods=n, freq="D")
+    t = np.arange(n)
+    y = 5 + 0.05 * t - 0.12 * np.maximum(t - 100, 0) + rng.normal(0, 0.1, n)
+    df = pd.DataFrame({"series_id": "a", "ds": ds, "y": y})
+    fc = Forecaster(
+        ProphetConfig(seasonalities=(), n_changepoints=8,
+                      changepoint_prior_scale=0.5),
+        SolverConfig(max_iters=60), backend="tpu",
+    ).fit(df)
+    cps = fc.changepoints_df()
+    assert len(cps) == 8 and (cps["ds"] > df["ds"].min()).all()
+    # The induced break is large; at least one changepoint is significant.
+    assert cps["abs_delta"].max() > 0.01
+    out = fc.predict(horizon=10)
+    ax = plot.plot_forecast(out, history_df=df)
+    plot.add_changepoints_to_plot(ax, fc)
+    assert len(ax.lines) > 1  # forecast line + at least one changepoint

@@ -364,6 +364,33 @@ class Forecaster:
             }))
         return pd.concat(rows, ignore_index=True)
 
+    def changepoints_df(self, series_id=None) -> pd.DataFrame:
+        """Fit-time changepoints for one series: ds (data units), the
+        fitted rate adjustment ``delta`` (scaled units, the scale
+        Prophet's 0.01 significance threshold applies to), and
+        ``abs_delta``.  Feeds plot.add_changepoints_to_plot."""
+        if self.state is None:
+            raise RuntimeError("fit before changepoints_df")
+        from tsspark_tpu.models.prophet.params import unpack
+
+        sid = series_id if series_id is not None else self.series_ids[0]
+        order = {s: i for i, s in enumerate(self.series_ids)}
+        if sid not in order:
+            raise ValueError(f"series {sid!r} was not fitted")
+        i = order[sid]
+        meta = self.state.meta
+        s = np.asarray(meta.changepoints, np.float64)[i]
+        days = s * np.asarray(meta.ds_span)[i] + np.asarray(meta.ds_start)[i]
+        delta = np.asarray(
+            unpack(np.asarray(self.state.theta), self.config).delta
+        )[i]
+        return pd.DataFrame({
+            self.id_col: sid,
+            "ds": _days_to_ts(days) if self._was_datetime else days,
+            "delta": delta,
+            "abs_delta": np.abs(delta),
+        })
+
     def make_future_grid(self, horizon: int, include_history: bool = False
                          ) -> np.ndarray:
         if self._train_ds is None:

@@ -76,6 +76,30 @@ def plot_forecast(
     return ax
 
 
+def add_changepoints_to_plot(
+    ax,
+    forecaster,
+    series_id: Optional[str] = None,
+    threshold: float = 0.01,
+    color: str = "r",
+):
+    """Overlay significant changepoints on a forecast axis (Prophet's
+    ``add_changepoints_to_plot``).
+
+    Draws a dashed vertical line at every fit-time changepoint whose rate
+    adjustment |delta| exceeds ``threshold`` for the given series.
+
+    Args:
+      ax: the axis returned by :func:`plot_forecast`.
+      forecaster: a fitted :class:`~tsspark_tpu.frame.Forecaster`.
+      series_id: which series (default: the first fitted one).
+    """
+    cps = forecaster.changepoints_df(series_id)
+    for _, row in cps[cps["abs_delta"] > threshold].iterrows():
+        ax.axvline(row["ds"], ls="--", lw=1, color=color, alpha=0.6)
+    return ax
+
+
 def plot_components(
     components: Dict[str, np.ndarray],
     ds,
