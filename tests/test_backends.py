@@ -263,3 +263,33 @@ def test_predict_chunked_matches_unchunked():
         np.asarray(chunked["yhat_lower"]) <= np.asarray(chunked["yhat_upper"])
     )
     assert np.asarray(chunked["yhat"]).shape == (b, 14)
+
+
+def test_components_chunked_matches_unchunked():
+    import numpy as np
+
+    from tsspark_tpu.backends.tpu import TpuBackend
+    from tsspark_tpu.config import (
+        ProphetConfig, SeasonalityConfig, SolverConfig,
+    )
+
+    rng = np.random.default_rng(17)
+    b, t_len = 37, 90
+    ds = np.arange(t_len, dtype=np.float64)
+    y = 5 + np.sin(2 * np.pi * ds[None, :] / 7.0) \
+        + rng.normal(0, 0.1, (b, t_len))
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=3,
+    )
+    backend = TpuBackend(cfg, SolverConfig(max_iters=25), chunk_size=16)
+    state = backend.fit(ds, y)
+    grid = np.arange(t_len + 14, dtype=np.float64)
+    chunked = backend.components(state, grid)
+    whole = backend._model.components(state, grid)
+    assert set(chunked) == set(whole)
+    for k in whole:
+        np.testing.assert_allclose(
+            np.asarray(chunked[k]), np.asarray(whole[k]), atol=1e-5,
+            err_msg=k,
+        )

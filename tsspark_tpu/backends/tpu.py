@@ -345,9 +345,47 @@ class TpuBackend(ForecastBackend):
 
     def components(self, state, ds, cap=None, regressors=None,
                    conditions=None):
-        return self._model.components(
-            state, ds, cap=cap, regressors=regressors, conditions=conditions
+        # Deterministic decomposition, but still a handful of (B, T)
+        # arrays per component block: chunk the series axis the same way
+        # predict does (without the samples factor in the budget).
+        b = np.asarray(state.theta).shape[0]
+        ds_np = np.asarray(ds)
+        t_len = ds_np.shape[-1]
+        c = max(64, self._PREDICT_ELEMS // max(t_len, 1))
+        c = min(_next_pow2(c + 1) // 2, self.chunk_size, _next_pow2(b))
+        if b <= c:
+            return self._model.components(
+                state, ds, cap=cap, regressors=regressors,
+                conditions=conditions,
+            )
+        state = jax.tree.map(np.asarray, state)
+        bt = lambda a: None if a is None else np.broadcast_to(
+            np.asarray(a), (b, t_len)
         )
+        cap = bt(cap)
+        conditions = None if conditions is None else {
+            k: bt(v) for k, v in conditions.items()
+        }
+        regressors = None if regressors is None else np.asarray(regressors)
+        outs = []
+        for lo in range(0, b, c):
+            hi = min(lo + c, b)
+            sl = lambda a: _slice_repeat_pad(a, lo, hi, c)
+            out = self._model.components(
+                jax.tree.map(sl, state),
+                ds_np if ds_np.ndim == 1 else sl(ds_np),
+                cap=sl(cap), regressors=sl(regressors),
+                conditions=None if conditions is None else {
+                    k: sl(v) for k, v in conditions.items()
+                },
+            )
+            outs.append({
+                k: np.asarray(v)[: hi - lo] for k, v in out.items()
+            })
+        return {
+            k: np.concatenate([o[k] for o in outs], axis=0)
+            for k in outs[0]
+        }
 
 
 def patch_state(state: FitState, idx: np.ndarray, sub: FitState) -> FitState:
