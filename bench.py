@@ -174,6 +174,7 @@ def fit_worker(args) -> int:
     )
     from tsspark_tpu.models.prophet.model import (
         FitState, fit_core_packed, fitstate_from_packed,
+        select_better_state,
     )
 
     ds = np.load(os.path.join(args.data, "ds.npy"))
@@ -386,19 +387,25 @@ def fit_worker(args) -> int:
                 packed2, _ = pack_fit_data(
                     data2, meta2, ds, reg_u8_cols=u8_cols
                 )
-                theta2, stats2 = fit_core_packed(
-                    packed2, init_s[lo2:hi2], model.config,
-                    model.solver_config,
-                    reg_u8_cols=u8_cols,
-                    max_iters_dynamic=np.int32(args.max_iters),
-                    gn_precond_dynamic=np.bool_(True),
-                    use_theta0_dynamic=np.bool_(True),
-                )
-                jax.block_until_ready(theta2)
-                heartbeat()
-                subs.append(fitstate_from_packed(
-                    np.asarray(theta2), stats2, meta2
-                ))
+                # Multi-start: warm-started from phase 1 AND fresh from
+                # the ridge init (same compiled program, only the traced
+                # use_init flag differs); keep each series' lower loss.
+                cands = []
+                for use_init in (True, False):
+                    th2, st2 = fit_core_packed(
+                        packed2, init_s[lo2:hi2], model.config,
+                        model.solver_config,
+                        reg_u8_cols=u8_cols,
+                        max_iters_dynamic=np.int32(args.max_iters),
+                        gn_precond_dynamic=np.bool_(True),
+                        use_theta0_dynamic=np.bool_(use_init),
+                    )
+                    jax.block_until_ready(th2)
+                    heartbeat()
+                    cands.append(fitstate_from_packed(
+                        np.asarray(th2), st2, meta2
+                    ))
+                subs.append(select_better_state(*cands))
             state2 = jax.tree.map(
                 lambda *xs: np.concatenate(xs, axis=0)[:n_s], *subs
             )

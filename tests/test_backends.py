@@ -293,3 +293,33 @@ def test_components_chunked_matches_unchunked():
             np.asarray(chunked[k]), np.asarray(whole[k]), atol=1e-5,
             err_msg=k,
         )
+
+
+def test_twophase_multistart_never_worse():
+    """The straggler multi-start keeps per-series argmin loss: the
+    two-phase result is never worse than either candidate alone would
+    allow, and select_better_state prefers finite losses."""
+    import numpy as np
+
+    from tsspark_tpu.models.prophet.model import (
+        FitState, select_better_state,
+    )
+
+    a = FitState(
+        theta=np.zeros((3, 2)), meta=None,
+        loss=np.asarray([1.0, np.nan, 5.0]),
+        grad_norm=np.asarray([0.1, 0.2, 0.3]),
+        converged=np.asarray([True, False, True]),
+        n_iters=np.asarray([3, 4, 5]), status=np.asarray([1, 0, 2]),
+    )
+    b = FitState(
+        theta=np.ones((3, 2)), meta=None,
+        loss=np.asarray([2.0, 7.0, 4.0]),
+        grad_norm=np.asarray([0.4, 0.5, 0.6]),
+        converged=np.asarray([True, True, True]),
+        n_iters=np.asarray([6, 7, 8]), status=np.asarray([1, 1, 1]),
+    )
+    out = select_better_state(a, b)
+    np.testing.assert_allclose(out.loss, [1.0, 7.0, 4.0])
+    np.testing.assert_allclose(out.theta[:, 0], [0.0, 1.0, 1.0])
+    assert list(out.n_iters) == [3, 7, 8]

@@ -22,7 +22,11 @@ import numpy as np
 from tsspark_tpu.backends.registry import ForecastBackend, register_backend
 from tsspark_tpu.models.prophet import predict as predict_mod
 from tsspark_tpu.models.prophet.design import _indicator_reg_cols
-from tsspark_tpu.models.prophet.model import FitState, ProphetModel
+from tsspark_tpu.models.prophet.model import (
+    FitState,
+    ProphetModel,
+    select_better_state,
+)
 
 
 def _pad_batch(arr, b_pad):
@@ -230,27 +234,39 @@ class TpuBackend(ForecastBackend):
 
         if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
             fit2 = self._straggler_backend().fit
-            dyn2 = {}
+            dyn_warm = [{}]
         else:
             fit2 = self.fit
-            dyn2 = dict(
+            # Multi-start for the ill-conditioned tail: continue from the
+            # phase-1 point AND solve fresh from the ridge init (a stuck
+            # phase-1 iterate can trap the warm start in a worse basin),
+            # then keep each series' lower loss.  Same compiled program
+            # both times — only the traced use_init flag differs — and the
+            # straggler batch is tiny, so the second solve is ~free.
+            base = dict(
                 max_iters_dynamic=np.int32(self.solver_config.max_iters),
                 gn_precond_dynamic=np.bool_(True),
-                use_init_dynamic=np.bool_(True),
             )
-        state2 = fit2(
-            ds if np.asarray(ds).ndim == 1 else sub(np.asarray(ds)),
-            sub(y), mask=sub(mask if mask is not None
-                             else np.isfinite(np.asarray(y))
-                             .astype(np.float32)),
+            dyn_warm = [
+                dict(base, use_init_dynamic=np.bool_(True)),
+                dict(base, use_init_dynamic=np.bool_(False)),
+            ]
+        kwargs = dict(
+            mask=sub(mask if mask is not None
+                     else np.isfinite(np.asarray(y)).astype(np.float32)),
             cap=sub(cap, fill=1.0), floor=sub(floor),
             regressors=sub(regressors),
             init=sub(np.asarray(state.theta)),
             conditions=None if conditions is None else {
                 k: sub(v) for k, v in conditions.items()
             },
-            **dyn2,
         )
+        ds2 = ds if np.asarray(ds).ndim == 1 else sub(np.asarray(ds))
+        state2 = fit2(ds2, sub(y), **kwargs, **dyn_warm[0])
+        for dyn in dyn_warm[1:]:
+            state2 = select_better_state(
+                state2, fit2(ds2, sub(y), **kwargs, **dyn)
+            )
         if pad:
             state2 = _slice_state(state2, 0, idx.size)
         return patch_state(state, idx, state2)

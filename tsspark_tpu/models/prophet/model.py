@@ -183,6 +183,35 @@ def fit_segment_core(
                              fun_value=fval, fan_value=fan)
 
 
+def select_better_state(a: "FitState", b: "FitState") -> "FitState":
+    """Per-series argmin-loss merge of two fits of the SAME data.
+
+    The multi-start selector: non-finite losses always lose; ties keep
+    ``a``.  Meta is identical by construction (same rows, deterministic
+    prep), so ``a``'s is carried.
+    """
+    la = np.asarray(a.loss)
+    lb = np.asarray(b.loss)
+    take_b = np.isfinite(lb) & (~np.isfinite(la) | (lb < la))
+
+    def pick(xa, xb):
+        if xa is None or xb is None:
+            return xa
+        xa, xb = np.asarray(xa), np.asarray(xb)
+        shaped = take_b.reshape(take_b.shape + (1,) * (xa.ndim - 1))
+        return np.where(shaped, xb, xa)
+
+    return FitState(
+        theta=pick(a.theta, b.theta),
+        meta=a.meta,
+        loss=pick(a.loss, b.loss),
+        grad_norm=pick(a.grad_norm, b.grad_norm),
+        converged=pick(a.converged, b.converged),
+        n_iters=pick(a.n_iters, b.n_iters),
+        status=pick(a.status, b.status),
+    )
+
+
 def fitstate_from_packed(theta, stats, meta: ScalingMeta) -> "FitState":
     """FitState from fit_core_packed's (theta, (5, B) stats) result."""
     stats = np.asarray(stats)
