@@ -266,6 +266,26 @@ def fit_worker(args) -> int:
         ):
             todo.append((lo, hi))
     prefetch_depth = 3
+    # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
+    # program, so it can change per chunk for free.  If the first chunk
+    # leaves too many stragglers (phase 2 cost scales with them), deepen;
+    # if virtually everything converges early, shallow out.  One adjustment
+    # after chunk 0 keeps runs predictable.
+    depth = {"v": args.phase1_iters if two_phase else args.max_iters,
+             "tuned": not two_phase}
+
+    def tune_depth(state, b_real):
+        if depth["tuned"]:
+            return
+        depth["tuned"] = True
+        frac_unconv = float(
+            (~np.asarray(state.converged)[:b_real]).mean()
+        )
+        if frac_unconv > 0.03:
+            depth["v"] = min(int(depth["v"]) * 2, args.max_iters)
+        elif frac_unconv < 0.005 and depth["v"] > 8:
+            depth["v"] = max(8, int(depth["v"]) * 2 // 3)
+
     with ThreadPoolExecutor(max_workers=2) as pool:
         futs = {
             j: pool.submit(prep, *todo[j])
@@ -298,9 +318,7 @@ def fit_worker(args) -> int:
                 theta, stats = fit_core_packed(
                     payload, zeros_theta, model.config, model.solver_config,
                     reg_u8_cols=u8_cols,
-                    max_iters_dynamic=np.int32(
-                        args.phase1_iters if two_phase else args.max_iters
-                    ),
+                    max_iters_dynamic=np.int32(depth["v"]),
                     gn_precond_dynamic=np.bool_(False),
                     use_theta0_dynamic=np.bool_(False),
                 )
@@ -313,6 +331,7 @@ def fit_worker(args) -> int:
                     np.asarray(stats)[:, :b_real],
                     jax.tree.map(lambda a: np.asarray(a)[:b_real], meta),
                 )
+                tune_depth(state, b_real)
             fit_s = time.time() - t0
             _save_chunk_atomic(args.out, lo, hi, state)
             with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
