@@ -255,7 +255,8 @@ def fit_worker(args) -> int:
         )
         if segmented:
             return lo, hi, b_real, data, meta
-        packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols)
+        packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols,
+                                  collapse_cap=True)
         return lo, hi, b_real, packed, meta
 
     todo = []
@@ -404,7 +405,8 @@ def fit_worker(args) -> int:
                     regressors=r_s[lo2:hi2], as_numpy=True,
                 )
                 packed2, _ = pack_fit_data(
-                    data2, meta2, ds, reg_u8_cols=u8_cols
+                    data2, meta2, ds, reg_u8_cols=u8_cols,
+                    collapse_cap=True,
                 )
                 # Multi-start: warm-started from phase 1 AND fresh from
                 # the ridge init (same compiled program, only the traced
@@ -864,9 +866,22 @@ def main() -> None:
     args._resumed = resumed
     if resumed:
         print(f"[bench] resuming from {args._out_dir}", file=sys.stderr)
-    # Stale scratch dirs (other fingerprints / shapes) have no resume value.
+    # Stale scratch dirs (other fingerprints / shapes) have no resume value
+    # — but only reap ones untouched for hours: a CONCURRENT bench with a
+    # different shape owns a freshly-modified dir, and deleting it would
+    # destroy that run's chunk files mid-flight.
     for d in glob.glob("/tmp/tsbench_run_*"):
-        if os.path.abspath(d) != os.path.abspath(scratch):
+        if os.path.abspath(d) == os.path.abspath(scratch):
+            continue
+        try:
+            newest = max(
+                (os.path.getmtime(p) for p in
+                 glob.glob(os.path.join(d, "**"), recursive=True)),
+                default=os.path.getmtime(d),
+            )
+        except OSError:
+            continue
+        if time.time() - newest > 6 * 3600:
             shutil.rmtree(d, ignore_errors=True)
     os.makedirs(args._out_dir, exist_ok=True)
 

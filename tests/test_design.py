@@ -177,7 +177,7 @@ def test_packed_fit_data_roundtrip():
     data, meta = prepare_fit_data(
         ds, y, cfg, mask=mask, regressors=reg, as_numpy=True
     )
-    packed, u8_cols = pack_fit_data(data, meta, ds)
+    packed, u8_cols = pack_fit_data(data, meta, ds, collapse_cap=True)
     # Binary promo column (index 0) travels as uint8, continuous price as f32.
     assert u8_cols == (0,)
     assert packed.X_reg_u8.shape[-1] == 1

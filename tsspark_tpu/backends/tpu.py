@@ -98,7 +98,8 @@ class TpuBackend(ForecastBackend):
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
             init=None, conditions=None, max_iters_dynamic=None,
-            gn_precond_dynamic=None, use_init_dynamic=None):
+            gn_precond_dynamic=None, use_init_dynamic=None,
+            reg_u8_cols=None):
         # Host numpy end-to-end until each chunk's single fit dispatch:
         # a device array here would ship the whole batch over the link only
         # for prepare_fit_data to pull it back for the numpy prep.
@@ -111,12 +112,12 @@ class TpuBackend(ForecastBackend):
         # a per-chunk decision could flip and recompile mid-stream.  Skipped
         # when the packed path is unreachable (segmented solves) — the
         # detection is a full O(B*T*R) host scan.
-        u8 = None
+        u8 = reg_u8_cols
         segmented = bool(
             self.iter_segment
             and self.iter_segment < self.solver_config.max_iters
         )
-        if regressors is not None and not segmented:
+        if u8 is None and regressors is not None and not segmented:
             u8 = _indicator_reg_cols(np.asarray(regressors))
         dyn = dict(
             max_iters_dynamic=max_iters_dynamic,
@@ -203,6 +204,14 @@ class TpuBackend(ForecastBackend):
         no second program shape is compiled either.  Segmented solves fall
         back to per-phase static configs (bounded dispatches win there).
         """
+        # Indicator-column pinning: phase 2 refits a SUBSET of rows, where
+        # a continuous column could coincidentally look binary and flip the
+        # jit-static u8 split — decide once on the full batch and thread
+        # the decision through every phase (and the multi-start refits).
+        u8 = (
+            _indicator_reg_cols(np.asarray(regressors))
+            if regressors is not None else None
+        )
         if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
             phase1_state = self._phase1(phase1_iters).fit(
                 ds, y, mask=mask, cap=cap, floor=floor,
@@ -215,6 +224,7 @@ class TpuBackend(ForecastBackend):
                 max_iters_dynamic=np.int32(phase1_iters),
                 gn_precond_dynamic=np.bool_(False),
                 use_init_dynamic=np.bool_(init is not None),
+                reg_u8_cols=u8,
             )
         state = phase1_state
         idx = np.flatnonzero(~np.asarray(state.converged))
@@ -260,6 +270,7 @@ class TpuBackend(ForecastBackend):
             conditions=None if conditions is None else {
                 k: sub(v) for k, v in conditions.items()
             },
+            reg_u8_cols=u8,
         )
         ds2 = ds if np.asarray(ds).ndim == 1 else sub(np.asarray(ds))
         state2 = fit2(ds2, sub(y), **kwargs, **dyn_warm[0])

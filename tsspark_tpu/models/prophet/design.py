@@ -115,6 +115,7 @@ def pack_fit_data(
     meta: ScalingMeta,
     ds: np.ndarray,
     reg_u8_cols: Optional[Tuple[int, ...]] = None,
+    collapse_cap: bool = False,
 ) -> Tuple[PackedFitData, Tuple[int, ...]]:
     """Host-side (numpy) packing of an ``as_numpy=True`` prepared batch.
 
@@ -147,7 +148,11 @@ def pack_fit_data(
         )
     f32 = np.float32
     cap = np.asarray(data.cap)
-    if cap.shape[-1] != 1 and np.all(cap == cap[..., :1]):
+    # Collapse is a STATIC (config-level) decision, not a data one: for
+    # non-logistic growth cap is always all-ones, so callers pass
+    # collapse_cap=True; deciding from chunk values would let one chunk
+    # with a time-varying cap flip the compiled input shape mid-stream.
+    if collapse_cap and cap.shape[-1] != 1:
         cap = cap[..., :1]
     x_reg = np.asarray(data.X_reg, f32)
     u8_cols = (

@@ -366,7 +366,8 @@ class ProphetModel:
             # mode (reg_u8_cols naming a non-0/1 column) is a caller
             # contract violation that must surface, not silently fall back.
             packed, u8 = pack_fit_data(
-                data, meta, ds, reg_u8_cols=reg_u8_cols
+                data, meta, ds, reg_u8_cols=reg_u8_cols,
+                collapse_cap=self.config.growth != "logistic",
             )
             theta0 = init
             if dynamic and theta0 is None:
@@ -396,7 +397,12 @@ class ProphetModel:
                 precond="gn_diag" if bool(gn_precond_dynamic) else "none",
             )
             fallback = ProphetModel(self.config, solver)
-            theta0 = init if bool(use_init_dynamic) else None
+            # use_init_dynamic None keeps the default semantics (honor a
+            # caller-supplied init), matching the packed path; only an
+            # explicit False drops it in favor of the ridge init.
+            theta0 = init if (
+                use_init_dynamic is None or bool(use_init_dynamic)
+            ) else None
             return fallback._fit_prepared(
                 data, meta, theta0, iter_segment, on_segment
             )
