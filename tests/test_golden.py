@@ -1,0 +1,183 @@
+"""Golden-value model-math tests: hand-derived constants, never code-derived.
+
+The CPU parity oracle (backends/cpu.py) optimizes the SAME loss code as the
+TPU path, so sMAPE parity validates the solver but not the model math
+(round-3 verdict, Missing #3).  These fixtures break that loop: every
+expected value below is derived by hand in the adjacent comment, directly
+from the public Prophet model definition the reference implements
+(``tsspark.fit.prophet``, BASELINE.json:5; source unavailable — SURVEY.md
+§0), and asserted against the code.  Nothing here calls the code under test
+to produce its own expectation.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import numpy.testing as npt
+
+from tsspark_tpu.config import ProphetConfig
+from tsspark_tpu.models.prophet import trend
+from tsspark_tpu.models.prophet.design import FitData
+from tsspark_tpu.models.prophet.loss import neg_log_posterior
+from tsspark_tpu.models.prophet.seasonality import fourier_features
+
+
+def test_piecewise_linear_golden():
+    # g(t) = k*t + m + sum_j delta_j * relu(t - s_j)
+    # k=0.5, m=1.0, delta=(0.2, -0.4), s=(0.3, 0.6):
+    #   t=0.00: 0.5*0.00 + 1 + 0        + 0           = 1.000
+    #   t=0.25: 0.5*0.25 + 1 + 0        + 0           = 1.125
+    #   t=0.50: 0.5*0.50 + 1 + 0.2*0.20 + 0           = 1.290
+    #   t=0.75: 0.5*0.75 + 1 + 0.2*0.45 - 0.4*0.15    = 1.405
+    #   t=1.00: 0.5*1.00 + 1 + 0.2*0.70 - 0.4*0.40    = 1.480
+    t = jnp.array([[0.0, 0.25, 0.5, 0.75, 1.0]])
+    g = trend.piecewise_linear(
+        t,
+        k=jnp.array([0.5]),
+        m=jnp.array([1.0]),
+        delta=jnp.array([[0.2, -0.4]]),
+        s=jnp.array([[0.3, 0.6]]),
+    )
+    npt.assert_allclose(
+        np.asarray(g)[0], [1.0, 1.125, 1.29, 1.405, 1.48], rtol=1e-6
+    )
+
+
+def test_step_weighted_sum_boundary_golden():
+    # sum_j v_j * 1[t >= s_j], v=(1, 10), s=(0.3, 0.6).  The changepoint is
+    # active AT its own timestamp (searchsorted side="right" convention):
+    #   t=0.29 -> 0;  t=0.30 -> 1;  t=0.59 -> 1;  t=0.60 -> 11
+    t = jnp.array([[0.29, 0.30, 0.59, 0.60]])
+    out = trend.step_weighted_sum(
+        jnp.array([[1.0, 10.0]]), t, jnp.array([[0.3, 0.6]])
+    )
+    npt.assert_allclose(np.asarray(out)[0], [0.0, 1.0, 1.0, 11.0], atol=1e-6)
+
+
+def test_fourier_features_golden():
+    # period=7, order=2; columns are [sin(2pi t/7), cos(2pi t/7),
+    # sin(4pi t/7), cos(4pi t/7)]:
+    #   t=0.00: [sin 0,      cos 0,      sin 0,    cos 0  ] = [ 0, 1,  0,  1]
+    #   t=1.75: [sin(pi/2),  cos(pi/2),  sin(pi),  cos(pi)] = [ 1, 0,  0, -1]
+    #   t=3.50: [sin(pi),    cos(pi),    sin(2pi), cos(2pi)]= [ 0,-1,  0,  1]
+    feats = fourier_features(np.array([0.0, 1.75, 3.5]), period=7.0, order=2)
+    want = np.array([
+        [0.0, 1.0, 0.0, 1.0],
+        [1.0, 0.0, 0.0, -1.0],
+        [0.0, -1.0, 0.0, 1.0],
+    ])
+    npt.assert_allclose(np.asarray(feats), want, atol=2e-7)
+
+
+def test_logistic_gamma_golden():
+    # Public Prophet offset recursion, one changepoint:
+    #   gamma_1 = (s_1 - m - 0) * (1 - k / (k + delta_1))
+    # k=1, m=0.4, delta=0.5, s=0.5:
+    #   gamma_1 = (0.5 - 0.4) * (1 - 1/1.5) = 0.1 * (1/3) = 1/30
+    gamma = trend._logistic_gamma(
+        k=jnp.array([1.0]),
+        m=jnp.array([0.4]),
+        delta=jnp.array([[0.5]]),
+        s=jnp.array([[0.5]]),
+    )
+    npt.assert_allclose(np.asarray(gamma)[0], [1.0 / 30.0], rtol=1e-6)
+
+
+def test_logistic_trend_golden():
+    # g(t) = cap * sigmoid((k + A delta) * (t - (m + A gamma)))
+    # k=1, m=0.4, delta=(0.5,), s=(0.5,), cap=2, gamma_1 = 1/30 (above):
+    #   t=0.25 (< s): 2*sigmoid(1.0*(0.25-0.4))      = 2*sigmoid(-0.15)
+    #       e^0.15 = 1.16183424; 1/(1+1.16183424) = 0.46257015
+    #       -> 0.92514030
+    #   t=0.50 (= s, changepoint active): rate=1.5, offset=0.4+1/30
+    #       2*sigmoid(1.5*(0.5-0.43333333)) = 2*sigmoid(0.1)
+    #       e^-0.1 = 0.90483742; 1/1.90483742 = 0.52497919 -> 1.04995837
+    #   t=1.00: 2*sigmoid(1.5*(1.0-0.43333333)) = 2*sigmoid(0.85)
+    #       e^-0.85 = 0.42741493; 1/1.42741493 = 0.70056714 -> 1.40113428
+    # Continuity at the changepoint: the left limit 2*sigmoid(1.0*(0.5-0.4))
+    # = 2*sigmoid(0.1) equals the right value — that is what gamma is for.
+    t = jnp.array([[0.25, 0.5, 1.0]])
+    g = trend.logistic(
+        t,
+        cap=jnp.full((1, 3), 2.0),
+        k=jnp.array([1.0]),
+        m=jnp.array([0.4]),
+        delta=jnp.array([[0.5]]),
+        s=jnp.array([[0.5]]),
+    )
+    npt.assert_allclose(
+        np.asarray(g)[0], [0.92514030, 1.04995837, 1.40113428], rtol=1e-6
+    )
+
+
+def _bare_fit_data(t, y, s, n_cp):
+    t = np.asarray(t, np.float32)
+    y = np.asarray(y, np.float32)
+    s = np.asarray(s, np.float32)
+    b, t_len = y.shape
+    return FitData(
+        t=jnp.asarray(t),
+        y=jnp.asarray(y),
+        mask=jnp.ones((b, t_len), jnp.float32),
+        s=jnp.asarray(s).reshape(b, n_cp),
+        cap=jnp.ones((b, t_len), jnp.float32),
+        X_season=jnp.zeros((t_len, 0), jnp.float32),
+        X_reg=jnp.zeros((b, t_len, 0), jnp.float32),
+        prior_scales=jnp.zeros((0,), jnp.float32),
+        mult_mask=jnp.zeros((0,), jnp.float32),
+    )
+
+
+def test_neg_log_posterior_golden_no_changepoints():
+    # Config: linear growth, no seasonality/regressors/changepoints.
+    # Defaults: k_prior_scale=5, m_prior_scale=5, sigma_prior_scale=0.5
+    # (config.py).  theta = [k=0.2, m=0.1, log_sigma=0].
+    #
+    # sigma = SIGMA_FLOOR + exp(0) = 1.00001          (loss.py _SIGMA_FLOOR)
+    # yhat  = k*t + m = [0.1, 0.3];  y = [0.5, 0.7];  resid = [0.4, 0.4]
+    # nll   = 0.5 * 0.32 / sigma^2 + 2 * ln(sigma)
+    #       = 0.16 / 1.0000200001 + 2 * 9.99995e-6
+    #       = 0.15999680 + 0.00002000 = 0.16001680
+    # prior = 0.5*(0.2/5)^2 + 0.5*(0.1/5)^2 + 0.5*(1.00001/0.5)^2
+    #       = 0.0008 + 0.0002 + 0.5*4.00008000 = 0.0010 + 2.00004000
+    #       = 2.00106000
+    # total = 2.16107680
+    cfg = ProphetConfig(seasonalities=(), n_changepoints=0)
+    data = _bare_fit_data(
+        t=[[0.0, 1.0]], y=[[0.5, 0.7]], s=[[]], n_cp=0
+    )
+    theta = jnp.array([[0.2, 0.1, 0.0]])
+    val = float(neg_log_posterior(theta, data, cfg)[0])
+    npt.assert_allclose(val, 2.16107680, rtol=1e-5)
+
+
+def test_neg_log_posterior_golden_laplace_prior():
+    # Same skeleton plus two changepoints, delta=(0.3, -0.2), s=(0.5, 0.75),
+    # changepoint_prior_scale=0.05 (default).
+    #
+    # yhat(t=1) gains 0.3*relu(1-0.5) - 0.2*relu(1-0.75) = 0.15 - 0.05 = 0.1
+    #   -> yhat = [0.1, 0.4]; resid = [0.4, 0.3]; sum resid^2 = 0.25
+    # nll  = 0.5*0.25/1.0000200001 + 2*ln(1.00001)
+    #      = 0.12499750 + 0.00002000 = 0.12501750
+    # The Laplace kink is pseudo-Huber smoothed (loss.py _smooth_abs,
+    # eps=1e-4): smooth_abs(x) = sqrt(x^2 + 1e-8) - 1e-4
+    #   smooth_abs(0.3)  = 0.30000002 - 0.0001 = 0.29990002
+    #   smooth_abs(-0.2) = 0.20000002 - 0.0001 = 0.19990002
+    #   laplace = (0.29990002 + 0.19990002) / 0.05 = 9.99600083
+    # gaussian priors (as above) = 2.00106000
+    # total = 0.12501750 + 2.00106000 + 9.99600083 = 12.12207833
+    cfg = ProphetConfig(seasonalities=(), n_changepoints=2)
+    data = _bare_fit_data(
+        t=[[0.0, 1.0]], y=[[0.5, 0.7]], s=[[0.5, 0.75]], n_cp=2
+    )
+    theta = jnp.array([[0.2, 0.1, 0.0, 0.3, -0.2]])
+    val = float(neg_log_posterior(theta, data, cfg)[0])
+    npt.assert_allclose(val, 12.12207833, rtol=1e-5)
+
+
+def test_uniform_changepoints_golden():
+    # n=4 changepoints over changepoint_range=0.8 of span [0, 1]:
+    # fractions (1..4)/4 * 0.8 = [0.2, 0.4, 0.6, 0.8]
+    s = trend.uniform_changepoints(
+        np.array([0.0]), np.array([1.0]), 4, 0.8
+    )
+    npt.assert_allclose(np.asarray(s)[0], [0.2, 0.4, 0.6, 0.8], rtol=1e-6)
