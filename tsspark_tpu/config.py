@@ -242,18 +242,28 @@ class SolverConfig:
     # 1.4-nat gap vs the scipy oracle to 0.03 on hard 64-day series), but
     # SLOWS the well-conditioned majority that the ridge init already lands
     # next to the optimum (measured: 12-iter convergence 89% -> 13% on the
-    # M5 config).  Default "none"; the two-phase fit applies "gn_diag" to
-    # its compacted straggler pass, which is exactly the ill-conditioned
-    # tail (backends/tpu.fit_twophase).
-    precond: str = "none"
+    # M5 config).  "auto" (default) resolves per model: "gn_diag" for
+    # logistic growth, whose sigmoid curvature mixes scales badly enough
+    # that the plain metric loses ~1 nat/series to the scipy oracle at the
+    # same depth (round-4 measurement: mean loss gap +0.52 -> -0.95 on 32
+    # wiki-logistic series), "none" for linear/flat.  The two-phase fit
+    # additionally applies "gn_diag" to its compacted straggler pass, which
+    # is exactly the ill-conditioned tail (backends/tpu.fit_twophase).
+    precond: str = "auto"
 
     def __post_init__(self):
         if self.init not in ("ridge", "heuristic"):
             raise ValueError(f"init must be ridge|heuristic, got {self.init}")
-        if self.precond not in ("gn_diag", "none"):
+        if self.precond not in ("gn_diag", "none", "auto"):
             raise ValueError(
-                f"precond must be gn_diag|none, got {self.precond}"
+                f"precond must be gn_diag|none|auto, got {self.precond}"
             )
+
+    def resolved_precond(self, growth: str) -> str:
+        """Concrete initial-metric choice for a model's growth mode."""
+        if self.precond != "auto":
+            return self.precond
+        return "gn_diag" if growth == "logistic" else "none"
 
 
 @dataclasses.dataclass(frozen=True)

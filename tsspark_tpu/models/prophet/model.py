@@ -89,8 +89,11 @@ def fit_core(
         diag = curvature_diag(data, config, theta0)
         precond = jnp.where(gn_precond_dynamic, diag, jnp.ones_like(diag))
     else:
-        precond = (curvature_diag(data, config, theta0)
-                   if solver_config.precond == "gn_diag" else None)
+        precond = (
+            curvature_diag(data, config, theta0)
+            if solver_config.resolved_precond(config.growth) == "gn_diag"
+            else None
+        )
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
     fan = (lambda th, d, s: fan_value_closed_form(th, d, s, data, config)) \
@@ -154,8 +157,11 @@ def fit_init_core(
     """Jitted solver-state construction (for the segmented fit path)."""
     if theta0 is None:
         theta0 = initial_theta(data, config, solver_config)
-    precond = (curvature_diag(data, config, theta0)
-               if solver_config.precond == "gn_diag" else None)
+    precond = (
+        curvature_diag(data, config, theta0)
+        if solver_config.resolved_precond(config.growth) == "gn_diag"
+        else None
+    )
     fun = lambda th: value_and_grad_batch(th, data, config)
     return lbfgs.init_state(fun, theta0, solver_config, precond)
 
