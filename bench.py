@@ -68,20 +68,46 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
 RESERVE_S = 150.0
 
 
+# Bump when a bench.py change alters fit NUMERICS (solver args, phase
+# policy, data handling).  Orchestration-only changes (probing, retries,
+# logging) must NOT bump it: the whole point of the numerics-scoped
+# fingerprint below is that resume state survives them.
+BENCH_NUMERICS_REV = 1
+
+
 def _code_fingerprint() -> str:
-    """Hash of every source file that can affect bench results — keys the
-    resumable scratch dir so results never leak across code versions."""
+    """Hash of the numerics-affecting sources only — keys the resumable
+    scratch dir.  Round 3 hashed every package .py plus bench.py itself, so
+    ANY commit (even docstring-only) discarded cross-run resume state; now
+    only modules on the fit path rotate it: model math (models/), the
+    solver (ops/), backend chunking policy (backends/), the config schema,
+    and the data generator."""
     import hashlib
 
     h = hashlib.md5()
-    files = sorted(
-        glob.glob(os.path.join(REPO, "tsspark_tpu", "**", "*.py"),
-                  recursive=True)
-    ) + [os.path.abspath(__file__)]
+    h.update(str(BENCH_NUMERICS_REV).encode())
+    pats = [
+        os.path.join(REPO, "tsspark_tpu", "models", "**", "*.py"),
+        os.path.join(REPO, "tsspark_tpu", "ops", "**", "*.py"),
+        os.path.join(REPO, "tsspark_tpu", "backends", "**", "*.py"),
+        os.path.join(REPO, "tsspark_tpu", "config.py"),
+        os.path.join(REPO, "tsspark_tpu", "data", "datasets.py"),
+    ]
+    files = sorted(f for p in pats for f in glob.glob(p, recursive=True))
     for f in files:
         with open(f, "rb") as fh:
             h.update(fh.read())
     return h.hexdigest()[:10]
+
+
+def _datagen_fingerprint() -> str:
+    """Hash of the data generator alone — keys the shared datagen cache so
+    a generator change can never serve stale arrays to a new code version."""
+    import hashlib
+
+    with open(os.path.join(REPO, "tsspark_tpu", "data", "datasets.py"),
+              "rb") as fh:
+        return hashlib.md5(fh.read()).hexdigest()[:8]
 
 
 def _model_config():
@@ -123,6 +149,101 @@ def _setup_jax_child():
 # --------------------------------------------------------------------------
 # fit worker (TPU)
 # --------------------------------------------------------------------------
+
+def _prep_path(out_dir: str, lo: int, hi: int) -> str:
+    return os.path.join(out_dir, f"prep_{lo:06d}_{hi:06d}.npz")
+
+
+def _save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
+    """Persist one chunk's packed device payload (host numpy) so a CPU-side
+    prep worker can build it while the TPU tunnel is wedged and the fit
+    worker can later skip its own prep.  NamedTuple fields are flattened
+    with prefixes; the dotfile + rename makes readers never see a torn
+    file (same convention as chunk saves)."""
+    import numpy as np
+
+    arrays = {"b_real": np.asarray(b_real)}
+    for k, v in packed._asdict().items():
+        arrays[f"packed_{k}"] = np.asarray(v)
+    for k, v in meta._asdict().items():
+        arrays[f"meta_{k}"] = np.asarray(v)
+    tmp = os.path.join(out_dir, f".tmp_prep_{lo:06d}_{hi:06d}.npz")
+    np.savez(tmp, **arrays)
+    os.replace(tmp, _prep_path(out_dir, lo, hi))
+
+
+def _load_prep(out_dir, lo, hi):
+    """(b_real, PackedFitData, ScalingMeta) or None if absent/corrupt."""
+    import numpy as np
+
+    from tsspark_tpu.models.prophet.design import PackedFitData, ScalingMeta
+
+    path = _prep_path(out_dir, lo, hi)
+    if not os.path.exists(path):
+        return None
+    try:
+        z = np.load(path)
+        packed = PackedFitData(**{
+            k: z[f"packed_{k}"] for k in PackedFitData._fields
+        })
+        meta = ScalingMeta(**{
+            k: z[f"meta_{k}"] for k in ScalingMeta._fields
+        })
+        return int(z["b_real"]), packed, meta
+    except Exception:
+        return None
+
+
+def prep_worker(args) -> int:
+    """CPU-side chunk prep: build the packed device payloads for up to
+    ``--max-ahead`` pending chunks and save them next to the chunk results.
+
+    Runs overlapped with the parent's tunnel-probe loop (JAX_PLATFORMS=cpu,
+    so a wedged TPU tunnel cannot block it): when the tunnel recovers, the
+    fit worker finds its first chunks pre-packed and goes straight to
+    device work instead of paying host prep on the critical path."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _setup_jax_child()
+    import numpy as np
+
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.models.prophet.design import (
+        _indicator_reg_cols, pack_fit_data,
+    )
+    from tsspark_tpu.models.prophet.model import ProphetModel
+
+    ds = np.load(os.path.join(args.data, "ds.npy"))
+    y = np.load(os.path.join(args.data, "y.npy"), mmap_mode="r")
+    mask = np.load(os.path.join(args.data, "mask.npy"), mmap_mode="r")
+    reg = np.load(os.path.join(args.data, "reg.npy"), mmap_mode="r")
+    model = ProphetModel(_model_config(), SolverConfig(max_iters=args.max_iters))
+    u8_cols = _indicator_reg_cols(reg)
+
+    made = 0
+    for lo in range(0, args.series, args.chunk):
+        if made >= args.max_ahead:
+            break
+        hi = min(lo + args.chunk, args.series)
+        if os.path.exists(
+            os.path.join(args.out, f"chunk_{lo:06d}_{hi:06d}.npz")
+        ) or os.path.exists(_prep_path(args.out, lo, hi)):
+            continue
+        b_real = hi - lo
+        y_c = np.zeros((args.chunk, y.shape[1]), np.float32)
+        m_c = np.zeros((args.chunk, y.shape[1]), np.float32)
+        r_c = np.zeros((args.chunk,) + reg.shape[1:], np.float32)
+        y_c[:b_real] = y[lo:hi]
+        m_c[:b_real] = mask[lo:hi]
+        r_c[:b_real] = reg[lo:hi]
+        data, meta = model.prepare(
+            ds, y_c, mask=m_c, regressors=r_c, as_numpy=True
+        )
+        packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols,
+                                  collapse_cap=True)
+        _save_prep_atomic(args.out, lo, hi, b_real, packed, meta)
+        made += 1
+    return 0
+
 
 def _save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None):
     import numpy as np
@@ -238,6 +359,14 @@ def fit_worker(args) -> int:
     u8_cols = _indicator_reg_cols(reg)
 
     def prep(lo: int, hi: int):
+        if not segmented:
+            # A CPU prep worker may have pre-packed this chunk while the
+            # tunnel was down (same prepare/pack code path, so numerics
+            # are identical); corrupt/absent files fall through to local
+            # prep.
+            cached = _load_prep(args.out, lo, hi)
+            if cached is not None:
+                return lo, hi, cached[0], cached[1], cached[2]
         b_real = hi - lo
         y_c = np.zeros((args.chunk, y.shape[1]), np.float32)
         m_c = np.zeros((args.chunk, y.shape[1]), np.float32)
@@ -335,6 +464,10 @@ def fit_worker(args) -> int:
                 tune_depth(state, b_real)
             fit_s = time.time() - t0
             _save_chunk_atomic(args.out, lo, hi, state)
+            try:  # prep payload served its purpose; bound scratch disk
+                os.remove(_prep_path(args.out, lo, hi))
+            except OSError:
+                pass
             with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
                 fh.write(json.dumps({
                     "lo": lo, "hi": hi, "fit_s": round(fit_s, 3),
@@ -703,7 +836,8 @@ def _missing_ranges(done, total):
     return missing
 
 
-def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None):
+def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
+                   probes=None):
     """Summary JSON from whatever is on disk RIGHT NOW — callable at any
     point (including from the SIGTERM handler mid-fit)."""
     import numpy as np
@@ -779,6 +913,13 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None):
     }
     if note:
         extra["note"] = note
+    if probes and probes.get("n"):
+        # Wedge-resilience audit trail: how many tunnel probes ran, how
+        # many failed, and the wall-offset of the last one — proof the
+        # probe loop ran to the reserve on a fully-wedged budget.
+        extra["tunnel_probes"] = probes["n"]
+        extra["tunnel_probe_fails"] = probes["fails"]
+        extra["last_probe_at_s"] = probes["last_t"]
     # vs_baseline keeps the STRICT round-1/2 definition — 60 s target /
     # measured single-chip seconds, i.e. >= 1.0 means the whole 8-chip
     # target is beaten on one chip — so the headline stays conservative
@@ -888,7 +1029,8 @@ def main() -> None:
     # From here on a SIGTERM/SIGINT (harness timeout) still produces the one
     # summary line from whatever chunks have landed; the scratch dir is
     # KEPT on signal so the next run resumes.
-    state = {"chunk": args.chunk, "retries": 0, "gen_s": 0.0}
+    state = {"chunk": args.chunk, "retries": 0, "gen_s": 0.0,
+             "probes": {"n": 0, "fails": 0, "last_t": 0.0}}
 
     def _on_signal(signum, frame):
         for proc in list(_CHILDREN):  # free the TPU tunnel before exiting
@@ -897,7 +1039,8 @@ def main() -> None:
             except OSError:
                 pass
         _emit(_build_summary(args, t_wall0, state["gen_s"], state["chunk"],
-                             state["retries"], note=f"signal {signum}"))
+                             state["retries"], note=f"signal {signum}",
+                             probes=state["probes"]))
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_signal)
@@ -908,7 +1051,8 @@ def main() -> None:
     # budgeted run regenerating identical arrays.
     gen0 = time.time()
     cache = os.path.join(
-        tempfile.gettempdir(), f"tsbench_data_{args.series}x{args.days}_v1"
+        tempfile.gettempdir(),
+        f"tsbench_data_{args.series}x{args.days}_{_datagen_fingerprint()}",
     )
     if not os.path.exists(os.path.join(cache, "ok")):
         # Private temp dir + atomic rename: concurrent bench processes can
@@ -943,11 +1087,71 @@ def main() -> None:
     state["gen_s"] = gen_s = time.time() - gen0
 
     note = None
-    preflight_fails = 0  # CONSECUTIVE failures; reset on success
+    side = {"eval": None, "prep": None}  # overlapped CPU-side children
+    probes = state["probes"]
+
+    def _probe_log(ok: bool, dur: float) -> None:
+        probes["n"] += 1
+        probes["fails"] += 0 if ok else 1
+        probes["last_t"] = round(time.time() - t_wall0, 1)
+        try:
+            with open(os.path.join(args._out_dir, "probes.jsonl"), "a") as fh:
+                fh.write(json.dumps({
+                    "t": probes["last_t"], "ok": ok, "dur_s": round(dur, 1),
+                }) + "\n")
+        except OSError:
+            pass
+
+    def _reserve() -> float:
+        """End-of-run time to protect.  Shrinks as the remaining exit
+        obligations shrink: with eval.json on disk (or nothing evaluable)
+        only the summary print is left, so the probe/fit loop may run
+        nearly to the deadline — the round-3 failure mode was surrendering
+        with ~500 s left while a fixed 150 s reserve sat unused."""
+        if os.path.exists(os.path.join(args._out_dir, "eval.json")):
+            return 25.0
+        if not _completed_ranges(args._out_dir):
+            return 25.0  # nothing to eval; probing is the best use of time
+        if side["eval"] is not None and side["eval"].poll() is None:
+            return 60.0  # eval already running concurrently
+        return RESERVE_S
+
+    def _side_child(kind: str, extra: list) -> None:
+        """Nonblocking CPU child (--_eval / --_prep), JAX forced to CPU so
+        a wedged TPU tunnel cannot block it.  At most one of each kind."""
+        proc = side.get(kind)
+        if proc is not None and proc.poll() is None:
+            return
+        cmd = [sys.executable, os.path.abspath(__file__), f"--_{kind}",
+               "--data", args._data_dir, "--out", args._out_dir] + extra
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        side[kind] = subprocess.Popen(cmd, stdout=sys.stderr, env=env)
+        _CHILDREN.add(side[kind])
+
+    def _overlap_cpu_work() -> None:
+        """Tunnel-down time is spent on the CPU-side work the run needs
+        anyway: eval of already-landed chunks and pre-packing pending chunk
+        payloads, so a late tunnel recovery converts into chunks instantly."""
+        done = _completed_ranges(args._out_dir)
+        n_done = sum(hi - lo for lo, hi in done)
+        if n_done and not os.path.exists(
+            os.path.join(args._out_dir, "eval.json")
+        ):
+            _side_child("eval", ["--n-eval", str(min(512, n_done))])
+        if _missing_ranges(done, args.series):
+            _side_child("prep", [
+                "--series", str(args.series),
+                "--chunk", str(state["chunk"]),
+                "--max-iters", str(args.max_iters),
+                "--max-ahead", "6",
+            ])
+
     # Probe before the first attempt (tunnel health unknown) and after any
     # attempt that died without progress; a worker that just produced
     # chunks has proven the tunnel alive, so skip the probe then.
     check_tunnel = os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
+    probe_sleep = 5.0
     while True:
         missing = _missing_ranges(_completed_ranges(args._out_dir), args.series)
         phase2_pending = (
@@ -959,30 +1163,42 @@ def main() -> None:
         if not missing and not phase2_pending:
             break
         remaining = deadline - time.time()
-        if remaining < RESERVE_S:
+        if remaining < _reserve():
             note = "fit budget exhausted; partial"
             print(f"[bench] {note}", file=sys.stderr)
             break
         # Client-creation watchdog: don't hand the range to a fit worker
         # that will hang in jax.devices() for the whole stall allowance.
+        # A wedged tunnel recovers on its own schedule, so probing NEVER
+        # gives up while budget remains (round-3 verdict: quitting after
+        # three probes threw away ~500 s of a 900 s budget) — cheap ~30 s
+        # probes loop until deadline - reserve, with the wait overlapped
+        # by the CPU-side eval/prep children.
         if check_tunnel:
-            if not _tunnel_preflight(timeout=min(90.0, remaining / 3)):
-                preflight_fails += 1
-                state["retries"] += 1
-                print(f"[bench] tunnel preflight failed ({preflight_fails})",
-                      file=sys.stderr)
-                if preflight_fails >= 3:
-                    note = "tpu tunnel wedged (client creation never returned)"
-                    print(f"[bench] {note}", file=sys.stderr)
-                    break
-                time.sleep(
-                    min(30.0, max(0.0, deadline - time.time() - RESERVE_S))
+            t_probe = time.time()
+            ok = _tunnel_preflight(
+                timeout=min(30.0, max(10.0, remaining - _reserve()))
+            )
+            _probe_log(ok, time.time() - t_probe)
+            if not ok:
+                print(
+                    f"[bench] tunnel probe failed "
+                    f"({probes['fails']}/{probes['n']} probes failed, "
+                    f"{round(deadline - time.time())}s of budget left; "
+                    f"probing until the reserve)",
+                    file=sys.stderr,
                 )
+                _overlap_cpu_work()
+                time.sleep(min(
+                    probe_sleep,
+                    max(0.0, deadline - time.time() - _reserve()),
+                ))
+                probe_sleep = min(probe_sleep * 1.5, 30.0)
                 continue
-            preflight_fails = 0
+            probe_sleep = 5.0
             check_tunnel = False
         remaining = deadline - time.time()
-        budget = max(60.0, remaining - RESERVE_S)
+        budget = max(60.0, remaining - _reserve())
         before = len(_completed_ranges(args._out_dir))
         lo = missing[0][0] if missing else 0
         hi = missing[-1][1] if missing else args.series
@@ -1010,20 +1226,33 @@ def main() -> None:
             else max(chunk // 2, MIN_CHUNK)
         print(f"[bench] fit worker died (rc={rc}), chunk {chunk} -> "
               f"{new_chunk}, retry {state['retries']}", file=sys.stderr)
-        if chunk <= MIN_CHUNK and state["retries"] > 8 and not made_progress:
-            note = "fit worker kept dying at minimum chunk; partial"
-            break
+        # No retry cap: a crash loop is re-probed (check_tunnel above) and
+        # retried until the budget's reserve — the driver deadline, not a
+        # counter, decides when to stop (round-3 verdict item 1).
         state["chunk"] = new_chunk
         time.sleep(10.0)  # let the crashed TPU worker restart cleanly
 
     n_done = sum(hi - lo for lo, hi in _completed_ranges(args._out_dir))
-    if n_done:
+    eval_json = os.path.join(args._out_dir, "eval.json")
+    ep = side.get("eval")
+    if ep is not None and ep.poll() is None:
+        # An overlapped eval is already in flight; give it the remaining
+        # budget instead of starting a duplicate.
+        try:
+            ep.wait(timeout=max(15.0, deadline - time.time() - 15.0))
+        except subprocess.TimeoutExpired:
+            ep.kill()
+    if n_done and not os.path.exists(eval_json):
         eval_budget = max(60.0, deadline - time.time() - 15.0)
         _spawn("--_eval", args, ["--n-eval", str(min(512, n_done))],
                timeout=eval_budget)
+    pp = side.get("prep")
+    if pp is not None and pp.poll() is None:
+        pp.kill()
 
     summary = _build_summary(args, t_wall0, gen_s, state["chunk"],
-                             state["retries"], note=note)
+                             state["retries"], note=note,
+                             probes=state["probes"])
     _emit(summary)
     # Remove the scratch only after a COMPLETE run: partial results are the
     # resume state for the next invocation (fingerprint-keyed, so a code
@@ -1033,7 +1262,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] in ("--_fit", "--_eval"):
+    if len(sys.argv) > 1 and sys.argv[1] in ("--_fit", "--_eval", "--_prep"):
         mode = sys.argv.pop(1)
         ap = argparse.ArgumentParser()
         ap.add_argument("--data", required=True)
@@ -1046,6 +1275,8 @@ if __name__ == "__main__":
         ap.add_argument("--series", type=int, default=0)
         ap.add_argument("--phase1-iters", type=int, default=0)
         ap.add_argument("--n-eval", type=int, default=512)
+        ap.add_argument("--max-ahead", type=int, default=6)
         a = ap.parse_args()
-        sys.exit(fit_worker(a) if mode == "--_fit" else eval_worker(a))
+        sys.exit({"--_fit": fit_worker, "--_eval": eval_worker,
+                  "--_prep": prep_worker}[mode](a))
     main()
