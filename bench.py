@@ -72,7 +72,7 @@ RESERVE_S = 150.0
 # policy, data handling).  Orchestration-only changes (probing, retries,
 # logging) must NOT bump it: the whole point of the numerics-scoped
 # fingerprint below is that resume state survives them.
-BENCH_NUMERICS_REV = 1
+BENCH_NUMERICS_REV = 2
 
 
 def _code_fingerprint() -> str:
@@ -132,6 +132,22 @@ def _model_config():
     )
 
 
+def _host_cpu_tag() -> str:
+    """Host-CPU fingerprint for the compile-cache dir: XLA:CPU AOT entries
+    bake in the compile machine's feature set, and loading one on a
+    different VM generation segfaults (observed mid-test-suite)."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as fh:
+            line = next(l for l in fh if l.startswith("flags"))
+    except (OSError, StopIteration):
+        import platform
+
+        line = platform.platform()
+    return hashlib.md5(line.encode()).hexdigest()[:8]
+
+
 def _setup_jax_child():
     """Child-process JAX config: persistent compile cache."""
     import jax
@@ -140,7 +156,8 @@ def _setup_jax_child():
 
     honor_env_platforms()
     jax.config.update(
-        "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+        "jax_compilation_cache_dir",
+        os.path.join(REPO, f".jax_cache_{_host_cpu_tag()}"),
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     return jax
@@ -497,7 +514,13 @@ def fit_worker(args) -> int:
         # Already-patched chunks (resume after a phase-2 crash) are final.
         if z.get("phase2") is not None:
             continue
-        bad = np.flatnonzero(~z["converged"])
+        # Unconverged PLUS stuck exits (status FLOOR=3 / STALLED=4): the
+        # latter stopped because the plain metric ran out of resolvable
+        # descent, and the GN-diag multi-start pass below is exactly their
+        # medicine (backends/tpu.fit_twophase uses the same selection).
+        bad = np.flatnonzero(
+            ~z["converged"] | np.isin(z["status"], (3, 4))
+        )
         straggler_idx.extend(int(lo + i) for i in bad)
         straggler_theta.append(z["theta"][bad])
     if straggler_idx:

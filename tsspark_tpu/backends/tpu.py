@@ -79,6 +79,8 @@ class TpuBackend(ForecastBackend):
 
     def __init__(self, *args, chunk_size: int = 8192,
                  iter_segment: Optional[int] = None, on_segment=None,
+                 length_buckets: Optional[int] = None,
+                 rescue: bool = True,
                  **kwargs):
         """chunk_size bounds series per program; iter_segment bounds solver
         iterations per program.
@@ -89,17 +91,181 @@ class TpuBackend(ForecastBackend):
         per-dispatch execution time — needed on runtimes that kill
         long-running programs (the tunneled dev chip here), and useful for
         checkpoint/preemption granularity generally.
+
+        ``length_buckets``: ragged-length batches (the M4-Hourly regime,
+        SURVEY.md §7 hard part c) are padded to the full calendar grid;
+        device work then scales with the LONGEST series.  When a shared
+        1-D grid is used, ``fit`` groups series by observed window into up
+        to this many buckets and slices each bucket's time axis to its own
+        (128-aligned) window, so short series stop paying for the longest
+        one.  None (default) = auto: up to 3 buckets, applied only when it
+        saves >= 20% of padded cells; 1 disables.  Masked cells contribute
+        exact zeros to every reduction, so bucketing changes results only
+        at f32 reduction-order level.
         """
+        """``rescue``: a series can exit the lockstep solver STUCK rather
+        than solved — status FLOOR (no f32-resolvable progress) or STALLED
+        (no acceptable step) prove only that the plain metric ran out of
+        resolvable descent, and on the M5 eval config the whole
+        holdout-parity tail versus the scipy oracle was exactly such
+        series (round-3 verdict, Weak #3).  When enabled, ``fit`` follows
+        the main solve with a compacted GN-diagonal multi-start pass over
+        those suspects (warm-started from their stuck point AND fresh from
+        the ridge init) and keeps each series' best loss, original
+        included — so the pass can only improve.  Disabled internally for
+        phase-1 / straggler sub-backends (fit_twophase owns that flow)."""
         super().__init__(*args, **kwargs)
         self.chunk_size = chunk_size
         self.iter_segment = iter_segment
         self.on_segment = on_segment  # liveness hook, fires per dispatch
+        self.length_buckets = length_buckets
+        self.rescue = rescue
         self._model = ProphetModel(self.config, self.solver_config)
+
+    def _plan_length_buckets(self, y, mask):
+        """Bucket series by observed time window.
+
+        Returns a list of (row_idx, lo_t, hi_t) covering every row exactly
+        once, or None when bucketing is off / not worth it.  Buckets are
+        built from the sorted window-span order, their windows are aligned
+        up to 128 columns (coarse compile shapes, reusable across calls),
+        near-equal buckets are merged, and the plan is kept only if it
+        saves >= 20% of the (B, T) cells the unbucketed fit would pay for.
+        """
+        if self.length_buckets == 1:
+            return None
+        b, t_len = y.shape
+        if b < 32 or t_len < 256:
+            return None  # too small for the extra compile shapes to pay
+        m = (np.asarray(mask) > 0) if mask is not None else np.isfinite(y)
+        any_obs = m.any(axis=1)
+        first = np.where(any_obs, m.argmax(axis=1), 0)
+        last = np.where(
+            any_obs, t_len - 1 - m[:, ::-1].argmax(axis=1), -1
+        )
+        span = last - first + 1  # 0 for all-masked rows
+        k = self.length_buckets or 3
+        order = np.argsort(span, kind="stable")
+        cuts = [round(i * b / k) for i in range(k + 1)]
+        plan = []
+        for i in range(k):
+            idx = order[cuts[i]:cuts[i + 1]]
+            if idx.size == 0:
+                continue
+            sel = idx[any_obs[idx]]
+            lo = int(first[sel].min()) if sel.size else 0
+            hi = int(last[sel].max()) + 1 if sel.size else 1
+            # Align the window length up to 128 columns, preferring to
+            # extend toward lo (keeps hi, the "now" edge, stable for the
+            # right-aligned M4 layout).
+            length = min(t_len, -(-(hi - lo) // 128) * 128)
+            lo = max(0, hi - length)
+            hi = min(t_len, lo + length)
+            if plan and (plan[-1][2] - plan[-1][1]) >= 0.85 * (hi - lo):
+                prev_idx, prev_lo, prev_hi = plan.pop()
+                idx = np.concatenate([prev_idx, idx])
+                lo, hi = min(prev_lo, lo), max(prev_hi, hi)
+            plan.append((idx, lo, hi))
+        if len(plan) < 2:
+            return None
+        cost = sum(idx.size * (hi - lo) for idx, lo, hi in plan)
+        if cost > 0.8 * b * t_len:
+            return None
+        return plan
 
     def fit(self, ds, y, mask=None, cap=None, floor=None, regressors=None,
             init=None, conditions=None, max_iters_dynamic=None,
             gn_precond_dynamic=None, use_init_dynamic=None,
             reg_u8_cols=None):
+        dyn_used = any(
+            v is not None for v in
+            (max_iters_dynamic, gn_precond_dynamic, use_init_dynamic)
+        )
+        segmented = bool(
+            self.iter_segment
+            and self.iter_segment < self.solver_config.max_iters
+        )
+        # Indicator-column split decided ONCE here so the main fit and the
+        # rescue pass share it (it is a static argument of the jitted fit
+        # and an O(B*T*R) host scan — see _fit_main).
+        if reg_u8_cols is None and regressors is not None and not segmented:
+            reg_u8_cols = _indicator_reg_cols(np.asarray(regressors))
+        # One full-batch out-of-span changepoint warning instead of a copy
+        # per chunk with chunk-local counts (ADVICE r3).
+        from tsspark_tpu.models.prophet.design import (
+            changepoint_span_warning_suppressed,
+            warn_out_of_span_changepoints,
+        )
+
+        warn_out_of_span_changepoints(self.config, ds, y, mask)
+        with changepoint_span_warning_suppressed():
+            state = self._fit_main(
+                ds, y, mask=mask, cap=cap, floor=floor,
+                regressors=regressors, init=init, conditions=conditions,
+                max_iters_dynamic=max_iters_dynamic,
+                gn_precond_dynamic=gn_precond_dynamic,
+                use_init_dynamic=use_init_dynamic,
+                reg_u8_cols=reg_u8_cols,
+            )
+        # No rescue under traced phase controls (fit_twophase owns that
+        # flow via its straggler pass) or segmented solves (bounded
+        # dispatches are the caller's priority there).
+        if not self.rescue or dyn_used or segmented:
+            return state
+        with changepoint_span_warning_suppressed():
+            return self._rescue_pass(
+                state, ds, y, mask, cap, floor, regressors, conditions,
+                reg_u8_cols,
+            )
+
+    def _rescue_pass(self, state, ds, y, mask, cap, floor, regressors,
+                     conditions, u8):
+        """GN-diagonal multi-start refit of the stuck tail (see __init__)."""
+        from tsspark_tpu.ops import lbfgs
+
+        if state.status is None:
+            return state
+        idx = np.flatnonzero(np.isin(
+            np.asarray(state.status),
+            (lbfgs.STATUS_FLOOR, lbfgs.STATUS_STALLED),
+        ))
+        if idx.size == 0:
+            return state
+        bkr = TpuBackend(
+            self.config,
+            dataclasses.replace(self.solver_config, precond="gn_diag"),
+            chunk_size=self.chunk_size, iter_segment=self.iter_segment,
+            on_segment=self.on_segment, length_buckets=1, rescue=False,
+        )
+        y = np.asarray(y)
+        r = lambda a: None if a is None else np.asarray(a)[idx]
+        ds2 = ds if np.asarray(ds).ndim == 1 else np.asarray(ds)[idx]
+        kw = dict(
+            mask=r(mask if mask is not None
+                   else np.isfinite(y).astype(np.float32)),
+            cap=r(cap), floor=r(floor), regressors=r(regressors),
+            conditions=None if conditions is None else {
+                k: r(v) for k, v in conditions.items()
+            },
+            reg_u8_cols=u8,
+        )
+        warm = bkr.fit(ds2, y[idx], init=np.asarray(state.theta)[idx], **kw)
+        fresh = bkr.fit(ds2, y[idx], **kw)
+        redo = select_better_state(warm, fresh)
+        orig = jax.tree.map(lambda a: np.asarray(a)[idx], state)
+        best = select_better_state(redo, orig)
+        # n_iters reports work actually SPENT on the series (both starts
+        # ran regardless of which point won); patch_state accumulates it
+        # onto the main solve's count.
+        best = best._replace(n_iters=np.maximum(
+            np.asarray(warm.n_iters), np.asarray(fresh.n_iters)
+        ))
+        return patch_state(state, idx, best)
+
+    def _fit_main(self, ds, y, mask=None, cap=None, floor=None,
+                  regressors=None, init=None, conditions=None,
+                  max_iters_dynamic=None, gn_precond_dynamic=None,
+                  use_init_dynamic=None, reg_u8_cols=None):
         # Host numpy end-to-end until each chunk's single fit dispatch:
         # a device array here would ship the whole batch over the link only
         # for prepare_fit_data to pull it back for the numpy prep.
@@ -124,6 +290,43 @@ class TpuBackend(ForecastBackend):
             gn_precond_dynamic=gn_precond_dynamic,
             use_init_dynamic=use_init_dynamic,
         )
+        # Ragged-length bucketing (shared-grid batches only): fit each
+        # length bucket on its own sliced time window so short series stop
+        # paying device work for the longest one.  Masked cells are exact
+        # zeros in every reduction, so this changes results only at f32
+        # reduction-order level (tests/test_bucketing.py asserts parity).
+        if ds.ndim == 1:
+            plan = self._plan_length_buckets(y, mask)
+            if plan is not None:
+                sub = TpuBackend(
+                    self.config, self.solver_config,
+                    chunk_size=self.chunk_size,
+                    iter_segment=self.iter_segment,
+                    on_segment=self.on_segment,
+                    length_buckets=1,
+                    rescue=False,  # the top-level fit rescues the whole batch
+                )
+                states = []
+                for idx, lo_t, hi_t in plan:
+                    r2 = lambda a: None if a is None \
+                        else np.asarray(a)[idx][:, lo_t:hi_t]
+                    r1 = lambda a: None if a is None else np.asarray(a)[idx]
+                    rflex = lambda a: None if a is None else (
+                        r2(a) if np.asarray(a).ndim >= 2 else r1(a)
+                    )
+                    states.append(sub.fit(
+                        ds[lo_t:hi_t], r2(y), mask=r2(mask), cap=r2(cap),
+                        floor=rflex(floor), regressors=r2(regressors),
+                        init=r1(init),
+                        conditions=None if conditions is None else {
+                            k2: r2(v) for k2, v in conditions.items()
+                        },
+                        reg_u8_cols=u8, **dyn,
+                    ))
+                inv = np.argsort(np.concatenate([p[0] for p in plan]))
+                return jax.tree.map(
+                    lambda a: a[inv], _concat_states(states)
+                )
         if b <= c:
             return self._fit_padded(
                 ds, y, mask, cap, floor, regressors, init, conditions, c,
@@ -208,9 +411,15 @@ class TpuBackend(ForecastBackend):
         # a continuous column could coincidentally look binary and flip the
         # jit-static u8 split — decide once on the full batch and thread
         # the decision through every phase (and the multi-start refits).
+        # Segmented solves never reach the packed path, so skip the
+        # O(B*T*R) host scan there (ADVICE r3).
+        segmented_2p = bool(
+            self.iter_segment
+            and self.iter_segment < self.solver_config.max_iters
+        )
         u8 = (
             _indicator_reg_cols(np.asarray(regressors))
-            if regressors is not None else None
+            if regressors is not None and not segmented_2p else None
         )
         if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
             phase1_state = self._phase1(phase1_iters).fit(
@@ -227,7 +436,18 @@ class TpuBackend(ForecastBackend):
                 reg_u8_cols=u8,
             )
         state = phase1_state
-        idx = np.flatnonzero(~np.asarray(state.converged))
+        # Stragglers = unconverged PLUS stuck exits (FLOOR / STALLED): a
+        # series that stopped because the plain metric ran out of
+        # f32-resolvable descent is not solved, just frozen — round-4
+        # measurement on eval config 3 found the entire holdout-parity
+        # tail hiding behind such statuses (see __init__ on ``rescue``).
+        from tsspark_tpu.ops import lbfgs as _lbfgs
+
+        stuck = np.isin(
+            np.asarray(state.status),
+            (_lbfgs.STATUS_FLOOR, _lbfgs.STATUS_STALLED),
+        ) if state.status is not None else False
+        idx = np.flatnonzero(~np.asarray(state.converged) | stuck)
         if idx.size == 0:
             return state
         b = np.asarray(y).shape[0]
@@ -284,13 +504,16 @@ class TpuBackend(ForecastBackend):
 
     def _derived(self, **solver_overrides) -> "TpuBackend":
         """Same backend with SolverConfig fields replaced (keeps chunking
-        and liveness wiring in one place)."""
+        and liveness wiring in one place).  Derived backends are internal
+        phase workers: no auto-bucketing, no rescue pass of their own."""
         return TpuBackend(
             self.config,
             dataclasses.replace(self.solver_config, **solver_overrides),
             chunk_size=self.chunk_size,
             iter_segment=self.iter_segment,
             on_segment=self.on_segment,
+            length_buckets=1,
+            rescue=False,
         )
 
     def _phase1(self, phase1_iters: int) -> "TpuBackend":
@@ -319,8 +542,11 @@ class TpuBackend(ForecastBackend):
             else num_samples
         ) or 1
         # Round DOWN to a power of two: rounding up would let the sample
-        # tensor overshoot the element budget by up to 2x.
-        c = max(64, self._PREDICT_ELEMS // max(n_s * t_len, 1))
+        # tensor overshoot the element budget by up to 2x.  No floor above
+        # one series — a floor of 64 let huge num_samples * grid products
+        # overshoot the ~1 GB budget 64-fold (ADVICE r3); at c=1 the chunk
+        # tensor is (S, 1, T), within budget for any S * T <= the budget.
+        c = max(1, self._PREDICT_ELEMS // max(n_s * t_len, 1))
         c = min(_next_pow2(c + 1) // 2, self.chunk_size, _next_pow2(b))
         if b <= c:
             return self._model.predict(

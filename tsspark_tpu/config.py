@@ -220,10 +220,17 @@ class SolverConfig:
     max_iters: int = 200
     history: int = 10  # L-BFGS memory
     tol: float = 2e-9  # relative objective-decrease tolerance (scipy's ftol)
+    # Consecutive sub-tol iterations required before ftol ends a series: a
+    # single microscopic accepted step is indistinguishable from a stuck
+    # line search (measured on eval config 3: every holdout-tail outlier
+    # was a single-shot ftol exit at 2-3 iterations, up to 5.5 nats above
+    # the oracle's optimum — see ops/lbfgs.py).
+    ftol_patience: int = 2
     gtol: float = 1e-6  # gradient-inf-norm convergence tolerance
     ls_max_steps: int = 20  # line-search step-ladder size (one fan eval)
     ls_shrink: float = 0.5
     ls_armijo_c1: float = 1e-4
+    ls_seed_prev: bool = True  # seed each ladder from the last accepted step
     init_step: float = 1.0
     # Float32 noise-floor detection: a series whose accepted relative
     # objective decrease stays below floor_ulps machine epsilons for

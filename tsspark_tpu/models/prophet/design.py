@@ -17,6 +17,7 @@ Everything is a NamedTuple of arrays so it jits, vmaps, and shards cleanly.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -282,6 +283,65 @@ def model_yhat(
     return g * (1.0 + mult) + add, g
 
 
+# Chunked backends fit one batch as many prepare_fit_data calls; this flag
+# (set via the context manager) silences the per-chunk out-of-span warning
+# so the backend can emit ONE full-batch warning instead of dozens of
+# near-identical per-chunk copies whose counts never describe the batch.
+_CP_SPAN_WARNING_DISABLED = False
+
+
+@_contextlib.contextmanager
+def changepoint_span_warning_suppressed():
+    global _CP_SPAN_WARNING_DISABLED
+    prev = _CP_SPAN_WARNING_DISABLED
+    _CP_SPAN_WARNING_DISABLED = True
+    try:
+        yield
+    finally:
+        _CP_SPAN_WARNING_DISABLED = prev
+
+
+def _warn_out_of_span(s_scaled: np.ndarray, has_obs: np.ndarray,
+                      b: int) -> None:
+    out = ((s_scaled <= 0.0) | (s_scaled >= 1.0)) & has_obs[:, None]
+    if np.any(out):
+        import warnings
+
+        warnings.warn(
+            f"{int(out.any(axis=1).sum())} of {b} series have "
+            f"explicit changepoints outside their observed span "
+            f"({int(out.sum())} (series, changepoint) pairs); these "
+            "are inert or shift the base trend rather than kinking it",
+            stacklevel=3,
+        )
+
+
+def warn_out_of_span_changepoints(config, ds, y, mask) -> None:
+    """Full-batch out-of-span check for chunked backends (see above).
+
+    Computes each observed series' raw-day span directly (the same
+    first/last-observation convention as prepare_fit_data) and warns once
+    with whole-batch counts.
+    """
+    if config.changepoints is None or _CP_SPAN_WARNING_DISABLED:
+        return
+    y = np.asarray(y)
+    m = (np.asarray(mask) > 0) if mask is not None else np.isfinite(y)
+    b, t_len = m.shape
+    has_obs = m.any(axis=-1)
+    i0 = m.argmax(axis=-1)
+    i1 = t_len - 1 - m[:, ::-1].argmax(axis=-1)
+    dsb = np.asarray(ds, np.float64)
+    if dsb.ndim == 1:
+        dsb = np.broadcast_to(dsb, (b, t_len))
+    rows = np.arange(b)
+    start = dsb[rows, i0]
+    span = np.maximum(dsb[rows, i1] - start, 1e-9)
+    cp = np.asarray(config.changepoints, np.float64)
+    s = (cp[None, :] - start[:, None]) / span[:, None]
+    _warn_out_of_span(s, has_obs, b)
+
+
 def prepare_fit_data(
     ds: jnp.ndarray,
     y: jnp.ndarray,
@@ -383,18 +443,13 @@ def prepare_fit_data(
         # one series' span and outside another's, so warn (loudly, with
         # counts) instead of failing the whole batch.  s < 0 is active
         # from t=0 (perturbs the base slope's prior semantics); s > 1 is
-        # inert in-sample but kinks the forecast horizon.
-        out = (s_f64 <= 0.0) | (s_f64 >= 1.0)
-        if np.any(out):
-            import warnings
-
-            warnings.warn(
-                f"{int(out.any(axis=1).sum())} of {b} series have "
-                f"explicit changepoints outside their observed span "
-                f"({int(out.sum())} (series, changepoint) pairs); these "
-                "are inert or shift the base trend rather than kinking it",
-                stacklevel=2,
-            )
+        # inert in-sample but kinks the forecast horizon.  Counting skips
+        # rows with no observations (inert chunk-padding dummies), and
+        # chunked backends suppress this per-chunk copy in favor of ONE
+        # full-batch warning (warn_out_of_span_changepoints).
+        if not _CP_SPAN_WARNING_DISABLED:
+            has_obs = mask_np.any(axis=-1)
+            _warn_out_of_span(s_f64, has_obs, b)
         s = s_f64.astype(dtype)
     elif config.changepoint_placement == "quantile":
         s = quantile_changepoints(
