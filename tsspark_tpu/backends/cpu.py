@@ -110,7 +110,7 @@ class CpuBackend(ForecastBackend):
                 ds, state.meta, self.config, cap=cap, regressors=regressors,
                 conditions=conditions,
             )
-            return predict_mod.forecast(
+            return predict_mod.forecast_jit(
                 state.theta, data, state.meta, self.config,
                 key=jax.random.PRNGKey(seed), num_samples=num_samples,
             )

@@ -514,7 +514,7 @@ class ProphetModel:
             conditions=conditions,
         )
         key = jax.random.PRNGKey(seed)
-        return predict_mod.forecast(
+        return predict_mod.forecast_jit(
             state.theta, data, state.meta, self.config,
             key=key, num_samples=num_samples, return_samples=return_samples,
         )

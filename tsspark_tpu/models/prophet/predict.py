@@ -312,3 +312,19 @@ def forecast(
         if return_samples:
             out["yhat_samples"] = samples * scale[None] + floor[None]
     return out
+
+
+# One compiled program for the whole forecast (point pass + trend-path
+# simulation + quantiles) instead of dozens of tiny eager dispatches.  On
+# TPU this is the difference between one fused executable and an
+# op-by-op dispatch stream over the tunnel; it also sidesteps an XLA:CPU
+# JIT instability observed when a long-lived process (the test suite)
+# compiles hundreds of small eager programs and then segfaults inside a
+# trivial convert_element_type compile on this path.  config/num_samples/
+# return_samples are static (compile-time); theta/data/meta/key are traced
+# — meta's float64 host leaves are only used for the final y_scale/floor
+# affine map here, where f32 is fine (the precision-critical ds math
+# happens in prepare_predict_data, outside this program).
+forecast_jit = jax.jit(
+    forecast, static_argnames=("config", "num_samples", "return_samples")
+)
