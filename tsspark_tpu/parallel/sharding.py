@@ -79,8 +79,11 @@ def _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg):
     theta0 = jax.lax.with_sharding_constraint(
         theta0, NamedSharding(mesh, P(s_ax, None))
     )
-    precond = (curvature_diag(data, config, theta0)
-               if solver_config.precond == "gn_diag" else None)
+    precond = (
+        curvature_diag(data, config, theta0)
+        if solver_config.resolved_precond(config.growth) == "gn_diag"
+        else None
+    )
     fun = lambda th: value_and_grad_batch(th, data, config)
     fval = lambda th: value_batch(th, data, config)
     fan = (lambda th, d, s: fan_value_closed_form(th, d, s, data, config)) \
