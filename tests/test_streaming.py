@@ -281,3 +281,65 @@ def test_warmstart_transfer_window_slide():
     err = np.abs(np.asarray(fc_old["trend"] - fc_new["trend"]))
     scale = float(np.abs(np.asarray(fc_old["trend"])).mean())
     assert err.max() / scale < 0.05, err.max() / scale
+
+
+def test_crash_replay_between_refit_and_commit_is_idempotent():
+    """Driver death in the at-least-once window (BASELINE.json:11).
+
+    The driver commits offsets only AFTER a refit lands in the param store
+    (driver.run / source.commit), so a crash between the two makes the
+    broker re-deliver the uncommitted batch on restart.  The replayed
+    application must be idempotent: history appends dedup by (series, ds)
+    so rows are counted once, and the refit — warm-started from the params
+    the crashed refit already stored — lands on the same parameters."""
+    df = _series_df(240, "r0", seed=7)
+    rows = df.to_dict("records")
+
+    consumer = _FakeConsumer([rows[:200], rows[200:240]])
+    src = KafkaSource(consumer=consumer, max_records=500)
+    store = ParamStore(CFG)
+    sf = StreamingForecaster(
+        CFG, SolverConfig(max_iters=40), backend="tpu", store=store
+    )
+    b0 = src.poll()
+    sf.process(b0)
+    src.commit()                       # batch 0 durably applied
+    b1 = src.poll()
+    sf.process(b1)                     # refit landed in the store...
+    # ... and the driver dies HERE: no src.commit() for batch 1.
+    assert consumer.events.count("commit") == 1
+    theta_crash, _, found = store.lookup(["r0"])
+    assert bool(found.all())
+    code = sf._codes(["r0"])
+    n_hist = len(sf._hist.union_grid(code))
+    assert n_hist == 240
+
+    # Restarted poll loop: the broker re-delivers everything after the
+    # last committed offset — batch 1 again, then end-of-stream.
+    replay = _FakeConsumer([rows[200:240], []])
+    stats = sf.run(KafkaSource(consumer=replay, max_records=500))
+
+    # Second application committed, and idempotent:
+    assert replay.events.count("commit") == 1
+    # (a) rows counted once — the dedup absorbed all 40 replayed rows;
+    assert len(sf._hist.union_grid(code)) == 240
+    # (b) the refit reproduces the same parameters it already stored.
+    theta_replay, _, _ = store.lookup(["r0"])
+    # Warm-started at its own stored optimum, the replayed refit may walk a
+    # few sub-tolerance steps; anything beyond noise would mean replays
+    # compound (dedup failed / double-counted rows).
+    np.testing.assert_allclose(
+        np.asarray(theta_replay), np.asarray(theta_crash),
+        rtol=0, atol=5e-4,
+    )
+    # (c) a never-crashed driver over the same stream agrees too.
+    clean_consumer = _FakeConsumer([rows[:200], rows[200:240], []])
+    sf_clean = StreamingForecaster(
+        CFG, SolverConfig(max_iters=40), backend="tpu"
+    )
+    sf_clean.run(KafkaSource(consumer=clean_consumer, max_records=500))
+    theta_clean, _, _ = sf_clean.store.lookup(["r0"])
+    np.testing.assert_allclose(
+        np.asarray(theta_replay), np.asarray(theta_clean),
+        rtol=0, atol=2e-3,
+    )
