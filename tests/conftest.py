@@ -30,10 +30,57 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent compile cache: the suite's ~140 tests re-jit the same fit and
 # predict programs every run; caching them across runs cuts several minutes
-# of pure XLA:CPU compile time per invocation.
+# of pure XLA:CPU compile time per invocation.  The directory is keyed by a
+# HOST-CPU fingerprint: XLA:CPU AOT artifacts bake in the compile machine's
+# feature set, and loading one on a different VM generation segfaults the
+# process mid-suite (observed: entries from a prior session's host killed
+# test_prophet_features on this one with "machine features ... could lead
+# to execution errors such as SIGILL" warnings followed by a real SIGSEGV).
+
+
+def _host_cpu_tag() -> str:
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as fh:
+            line = next(l for l in fh if l.startswith("flags"))
+    except (OSError, StopIteration):
+        import platform
+
+        line = platform.platform()
+    return hashlib.md5(line.encode()).hexdigest()[:8]
+
+
 jax.config.update(
     "jax_compilation_cache_dir",
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache_tests"),
+                 f".jax_cache_tests_{_host_cpu_tag()}"),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+# ---------------------------------------------------------------------------
+# mmap exhaustion guard.  Measured on this VM: one full-suite process
+# accumulates >64k memory mappings (every live XLA:CPU executable holds
+# dozens) and SEGFAULTS mid-suite when it crosses vm.max_map_count
+# (default 65530) — the crash surfaces as a random compile failing, at a
+# position that drifts with every code change.  Two layers of defense:
+# raise the sysctl when the image allows it, and drop compiled-program
+# references between test modules so dead executables actually unmap (the
+# persistent compile cache above makes any cross-module recompiles cheap).
+
+try:
+    with open("/proc/sys/vm/max_map_count") as _fh:
+        _cur = int(_fh.read())
+    if _cur < 1 << 20:
+        with open("/proc/sys/vm/max_map_count", "w") as _fh:
+            _fh.write(str(1 << 20))
+except OSError:
+    pass  # unprivileged: the per-module cache clear below still bounds maps
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    yield
+    jax.clear_caches()
