@@ -11,7 +11,8 @@ change the compiled program beyond the static regressor count.
 
 Country calendars are computed arithmetically (nth-weekday rules + the
 Gregorian Easter computus) — this machine has zero egress, so nothing is
-looked up.  Supported: US, CA, GB, DE.
+looked up.  Supported: US, CA, GB/UK, DE, FR, IT, ES, BR, JP, IN (the
+``_COUNTRIES`` registry below is the source of truth).
 """
 
 from __future__ import annotations
@@ -251,14 +252,24 @@ def _br(year: int):
 
 def _jp(year: int):
     # Fixed-date subset (equinox days and Happy-Monday shifts post-2000
-    # are approximated by their statutory rules below).
+    # are approximated by their statutory rules below).  Era-dependent
+    # dates are year-gated: the Emperor's Birthday moved with the era
+    # (Dec 23 under Heisei 1989-2018, Feb 23 under Reiwa from 2020; none
+    # gazetted in the 2019 transition year), and the Apr 29 / May 4 pair
+    # was relabeled in 2007 (Apr 29: Greenery Day -> Showa Day; May 4:
+    # citizens' rest day -> Greenery Day).
     yield "New Year's Day", _dt.date(year, 1, 1)
     if year >= 2000:
         yield "Coming of Age Day", _nth_weekday(year, 1, 0, 2)
     yield "National Foundation Day", _dt.date(year, 2, 11)
-    yield "Showa Day", _dt.date(year, 4, 29)
+    if year >= 2020:
+        yield "Emperor's Birthday", _dt.date(year, 2, 23)
+    if year >= 2007:
+        yield "Showa Day", _dt.date(year, 4, 29)
+        yield "Greenery Day", _dt.date(year, 5, 4)
+    else:
+        yield "Greenery Day", _dt.date(year, 4, 29)
     yield "Constitution Day", _dt.date(year, 5, 3)
-    yield "Greenery Day", _dt.date(year, 5, 4)
     yield "Children's Day", _dt.date(year, 5, 5)
     if year >= 2003:
         yield "Marine Day", _nth_weekday(year, 7, 0, 3)
@@ -270,6 +281,8 @@ def _jp(year: int):
         yield "Health and Sports Day", _nth_weekday(year, 10, 0, 2)
     yield "Culture Day", _dt.date(year, 11, 3)
     yield "Labour Thanksgiving Day", _dt.date(year, 11, 23)
+    if 1989 <= year <= 2018:
+        yield "Emperor's Birthday", _dt.date(year, 12, 23)
 
 
 def _in(year: int):
