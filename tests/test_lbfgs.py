@@ -345,3 +345,36 @@ def test_dynamic_depth_matches_static():
         use_theta0_dynamic=np.bool_(True),
     )
     assert bool(np.all(np.asarray(res_warm.f) <= np.asarray(res_static.f) + 1e-5))
+
+
+def test_ftol_patience_survives_single_microscopic_step():
+    """A single sub-tol accepted step must NOT end a series (round-4: the
+    whole M5 parity tail was single-shot ftol exits 2-3 iterations in).
+    With patience=1 the first tiny accepted decrease converges the batch
+    immediately; the default patience keeps iterating and reaches the
+    true optimum."""
+    rng = np.random.default_rng(5)
+    b, p = 4, 6
+    # Anisotropic SPD quadratics: one L-BFGS step cannot reach the optimum.
+    a_half = rng.normal(size=(b, p, p))
+    a_mats = np.einsum("bij,bkj->bik", a_half, a_half) + 0.1 * np.eye(p)
+    centers = rng.normal(size=(b, p))
+    a_j = jnp.asarray(a_mats)
+    c_j = jnp.asarray(centers)
+
+    def fun(theta):
+        d = theta - c_j
+        ad = jnp.einsum("bij,bj->bi", a_j, d)
+        return 0.5 * jnp.sum(d * ad, axis=-1), ad
+
+    theta0 = jnp.asarray(rng.normal(size=(b, p)))
+    # tol=1e9 makes EVERY accepted decrease "sub-tol"; gtol/floor disabled
+    # so ftol is the only live exit.
+    base = dict(max_iters=50, tol=1e9, gtol=0.0, floor_patience=1 << 30)
+    res1 = lbfgs.minimize(fun, theta0, SolverConfig(ftol_patience=1, **base))
+    res4 = lbfgs.minimize(fun, theta0, SolverConfig(ftol_patience=4, **base))
+    # Impatient: one accepted iteration then stop, far from the optimum.
+    assert int(np.asarray(res1.n_iters).max()) == 1
+    # Patient: runs exactly the patience budget, strictly lower objective.
+    assert int(np.asarray(res4.n_iters).min()) == 4
+    assert float(np.asarray(res4.f).max()) < float(np.asarray(res1.f).min())
