@@ -71,3 +71,52 @@ def test_fit_worker_two_phase_and_resume(tmp_path):
     for f in files:
         assert np.load(f)["phase2"] == 1
     assert os.path.exists(os.path.join(args.out, "phase2_done"))
+
+
+def test_prep_worker_cache_matches_inline_prep(tmp_path):
+    """The overlapped CPU --_prep worker and the fit worker's inline prep
+    run the same prepare/pack code path; the cached payload must be
+    BIT-identical so a chunk fit from cache reproduces the inline fit."""
+    args = _args(tmp_path, series=64, days=128, chunk=32, phase1=0)
+    args.max_ahead = 1
+    assert bench.prep_worker(args) == 0
+    cached = bench._load_prep(args.out, 0, 32)
+    assert cached is not None
+    b_real, packed, meta = cached
+    assert b_real == 32
+
+    # Inline reference: same construction as fit_worker.prep.
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.models.prophet.design import (
+        _indicator_reg_cols, pack_fit_data,
+    )
+    from tsspark_tpu.models.prophet.model import ProphetModel
+
+    ds = np.load(os.path.join(args.data, "ds.npy"))
+    y = np.load(os.path.join(args.data, "y.npy"))
+    mask = np.load(os.path.join(args.data, "mask.npy"))
+    reg = np.load(os.path.join(args.data, "reg.npy"))
+    model = ProphetModel(bench._model_config(), SolverConfig(max_iters=120))
+    u8 = _indicator_reg_cols(reg)
+    y_c = np.zeros((32, y.shape[1]), np.float32); y_c[:] = y[0:32]
+    m_c = np.zeros((32, y.shape[1]), np.float32); m_c[:] = mask[0:32]
+    r_c = np.zeros((32,) + reg.shape[1:], np.float32); r_c[:] = reg[0:32]
+    data, meta_ref = model.prepare(
+        ds, y_c, mask=m_c, regressors=r_c, as_numpy=True
+    )
+    packed_ref, _ = pack_fit_data(data, meta_ref, ds, reg_u8_cols=u8,
+                                  collapse_cap=True)
+    for k in packed._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(packed, k)),
+            np.asarray(getattr(packed_ref, k)), err_msg=k,
+        )
+    for k in meta._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(meta, k)),
+            np.asarray(getattr(meta_ref, k)), err_msg=k,
+        )
+
+    # A second prep run is a no-op (file exists), and a chunk file
+    # supersedes the prep cache.
+    assert bench.prep_worker(args) == 0
