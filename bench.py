@@ -72,7 +72,7 @@ RESERVE_S = 150.0
 # policy, data handling).  Orchestration-only changes (probing, retries,
 # logging) must NOT bump it: the whole point of the numerics-scoped
 # fingerprint below is that resume state survives them.
-BENCH_NUMERICS_REV = 3
+BENCH_NUMERICS_REV = 4
 
 
 def _code_fingerprint() -> str:
@@ -314,6 +314,8 @@ def fit_worker(args) -> int:
         FitState, fit_core_packed, fitstate_from_packed,
         select_better_state,
     )
+    from tsspark_tpu.models.prophet.model import KEEP_BEST_MARGIN \
+        as select_margin
 
     ds = np.load(os.path.join(args.data, "ds.npy"))
     y = np.load(os.path.join(args.data, "y.npy"), mmap_mode="r")
@@ -583,7 +585,8 @@ def fit_worker(args) -> int:
                     cands.append(fitstate_from_packed(
                         np.asarray(th2), st2, meta2
                     ))
-                subs.append(select_better_state(*cands))
+                subs.append(select_better_state(
+                    *cands, margin=select_margin))
             state2 = jax.tree.map(
                 lambda *xs: np.concatenate(xs, axis=0)[:n_s], *subs
             )

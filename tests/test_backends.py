@@ -355,10 +355,47 @@ def test_rescue_pass_never_degrades_and_triggers():
     assert np.isin(np.asarray(st_plain.status), (3, 4)).any()
     l0 = np.asarray(st_plain.loss)
     l1 = np.asarray(st_resc.loss)
-    # Keep-best contract: never worse (tiny f32 slack), strictly better
-    # somewhere on this batch.
+    # Keep-best contract: never worse (tiny f32 slack).  Whether any series
+    # improves is data-dependent (a restart must beat the incumbent by
+    # KEEP_BEST_MARGIN to win — see select_better_state); the margin
+    # semantics themselves are unit-tested in test_select_better_margin.
     assert (l1 <= l0 + 1e-4).all()
-    assert (l1 < l0 - 1e-4).any()
+
+
+def test_select_better_margin():
+    """A challenger must beat the incumbent by MORE than the margin: ties
+    and epsilon wins keep the incumbent's theta (basin stability for
+    warm-start continuity)."""
+    from tsspark_tpu.models.prophet.design import ScalingMeta
+    from tsspark_tpu.models.prophet.model import (
+        FitState, select_better_state,
+    )
+
+    def st(loss, tag):
+        b = len(loss)
+        meta = ScalingMeta(
+            y_scale=np.ones(b), floor=np.zeros(b), ds_start=np.zeros(b),
+            ds_span=np.ones(b), reg_mean=np.zeros((b, 0)),
+            reg_std=np.ones((b, 0)), changepoints=np.zeros((b, 0)),
+        )
+        return FitState(
+            theta=np.full((b, 2), tag, np.float32),
+            meta=meta, loss=np.asarray(loss, np.float32),
+            grad_norm=np.zeros(b, np.float32),
+            converged=np.ones(b, bool), n_iters=np.ones(b, np.int32),
+            status=np.zeros(b, np.int32),
+        )
+
+    #           tie,  eps win, real win, worse
+    a = st([10.0, 10.0, 10.0, 10.0], tag=1.0)
+    b_ = st([10.0, 9.99, 9.80, 11.0], tag=2.0)
+    out = select_better_state(a, b_, margin=0.05)
+    np.testing.assert_array_equal(
+        np.asarray(out.theta)[:, 0], [1.0, 1.0, 2.0, 1.0]
+    )
+    np.testing.assert_allclose(
+        np.asarray(out.loss), [10.0, 10.0, 9.80, 10.0]
+    )
 
 
 def test_small_batches_share_one_compiled_shape():

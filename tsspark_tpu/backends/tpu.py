@@ -24,6 +24,7 @@ from tsspark_tpu.models.prophet import predict as predict_mod
 from tsspark_tpu.models.prophet.design import _indicator_reg_cols
 from tsspark_tpu.models.prophet.model import (
     FitState,
+    KEEP_BEST_MARGIN,
     ProphetModel,
     select_better_state,
 )
@@ -102,8 +103,8 @@ class TpuBackend(ForecastBackend):
         saves >= 20% of padded cells; 1 disables.  Masked cells contribute
         exact zeros to every reduction, so bucketing changes results only
         at f32 reduction-order level.
-        """
-        """``rescue``: a series can exit the lockstep solver STUCK rather
+
+        ``rescue``: a series can exit the lockstep solver STUCK rather
         than solved — status FLOOR (no f32-resolvable progress) or STALLED
         (no acceptable step) prove only that the plain metric ran out of
         resolvable descent, and on the M5 eval config the whole
@@ -251,9 +252,12 @@ class TpuBackend(ForecastBackend):
         )
         warm = bkr.fit(ds2, y[idx], init=np.asarray(state.theta)[idx], **kw)
         fresh = bkr.fit(ds2, y[idx], **kw)
-        redo = select_better_state(warm, fresh)
+        # Keep-best with a margin, incumbents first: a restart that merely
+        # ties on loss must not basin-hop the parameters (warm-start
+        # continuity; see select_better_state).
+        redo = select_better_state(warm, fresh, margin=KEEP_BEST_MARGIN)
         orig = jax.tree.map(lambda a: np.asarray(a)[idx], state)
-        best = select_better_state(redo, orig)
+        best = select_better_state(orig, redo, margin=KEEP_BEST_MARGIN)
         # n_iters reports work actually SPENT on the series (both starts
         # ran regardless of which point won); patch_state accumulates it
         # onto the main solve's count.
@@ -497,7 +501,8 @@ class TpuBackend(ForecastBackend):
         state2 = fit2(ds2, sub(y), **kwargs, **dyn_warm[0])
         for dyn in dyn_warm[1:]:
             state2 = select_better_state(
-                state2, fit2(ds2, sub(y), **kwargs, **dyn)
+                state2, fit2(ds2, sub(y), **kwargs, **dyn),
+                margin=KEEP_BEST_MARGIN,
             )
         if pad:
             state2 = _slice_state(state2, 0, idx.size)

@@ -189,16 +189,32 @@ def fit_segment_core(
                              fun_value=fval, fan_value=fan)
 
 
-def select_better_state(a: "FitState", b: "FitState") -> "FitState":
+# Default keep-best margin for multi-start / rescue selection: safely above
+# solve-to-solve float noise at the typical loss scale (~1e3 nats, where a
+# re-solve of the same basin lands within ~1e-3), and below any rescue gain
+# worth taking (measured real gains start ~0.1 nats).
+KEEP_BEST_MARGIN = 0.05
+
+
+def select_better_state(a: "FitState", b: "FitState",
+                        margin: float = 0.0) -> "FitState":
     """Per-series argmin-loss merge of two fits of the SAME data.
 
     The multi-start selector: non-finite losses always lose; ties keep
     ``a``.  Meta is identical by construction (same rows, deterministic
     prep), so ``a``'s is carried.
+
+    ``margin``: the challenger ``b`` must beat ``a`` by MORE than this
+    many nats to win.  Rescue/multi-start callers use a small positive
+    margin so an equal-quality restart cannot replace the incumbent
+    parameters on float noise — near-flat posteriors have multiple
+    equal-loss optima with different theta, and basin-hopping on epsilons
+    breaks warm-start continuity (a streaming replay must reproduce the
+    params it already stored).
     """
     la = np.asarray(a.loss)
     lb = np.asarray(b.loss)
-    take_b = np.isfinite(lb) & (~np.isfinite(la) | (lb < la))
+    take_b = np.isfinite(lb) & (~np.isfinite(la) | (lb < la - margin))
 
     def pick(xa, xb):
         if xa is None or xb is None:
