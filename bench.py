@@ -133,19 +133,9 @@ def _model_config():
 
 
 def _host_cpu_tag() -> str:
-    """Host-CPU fingerprint for the compile-cache dir: XLA:CPU AOT entries
-    bake in the compile machine's feature set, and loading one on a
-    different VM generation segfaults (observed mid-test-suite)."""
-    import hashlib
+    from tsspark_tpu.utils.platform import host_cpu_tag
 
-    try:
-        with open("/proc/cpuinfo") as fh:
-            line = next(l for l in fh if l.startswith("flags"))
-    except (OSError, StopIteration):
-        import platform
-
-        line = platform.platform()
-    return hashlib.md5(line.encode()).hexdigest()[:8]
+    return host_cpu_tag()
 
 
 def _setup_jax_child():
@@ -189,8 +179,13 @@ def _save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
     os.replace(tmp, _prep_path(out_dir, lo, hi))
 
 
-def _load_prep(out_dir, lo, hi):
-    """(b_real, PackedFitData, ScalingMeta) or None if absent/corrupt."""
+def _load_prep(out_dir, lo, hi, chunk=None):
+    """(b_real, PackedFitData, ScalingMeta) or None if absent/corrupt.
+
+    ``chunk``: reject payloads whose padded batch width differs — a tail
+    range keeps its (lo, hi) name across a chunk-halving retry, and
+    serving the old wider payload would re-dispatch exactly the program
+    size that just crashed the worker."""
     import numpy as np
 
     from tsspark_tpu.models.prophet.design import PackedFitData, ScalingMeta
@@ -206,6 +201,8 @@ def _load_prep(out_dir, lo, hi):
         meta = ScalingMeta(**{
             k: z[f"meta_{k}"] for k in ScalingMeta._fields
         })
+        if chunk is not None and packed.y.shape[0] != chunk:
+            return None
         return int(z["b_real"]), packed, meta
     except Exception:
         return None
@@ -383,7 +380,7 @@ def fit_worker(args) -> int:
             # tunnel was down (same prepare/pack code path, so numerics
             # are identical); corrupt/absent files fall through to local
             # prep.
-            cached = _load_prep(args.out, lo, hi)
+            cached = _load_prep(args.out, lo, hi, chunk=args.chunk)
             if cached is not None:
                 return lo, hi, cached[0], cached[1], cached[2]
         b_real = hi - lo
@@ -1129,13 +1126,29 @@ def main() -> None:
         except OSError:
             pass
 
+    def _eval_covered() -> bool:
+        """eval.json exists AND covers the series the final eval would:
+        an overlapped eval started mid-wedge may have scored only the
+        chunks landed at that moment, and must not satisfy the end-of-run
+        obligation for a run that went on to complete more."""
+        try:
+            with open(os.path.join(args._out_dir, "eval.json")) as fh:
+                have = json.load(fh).get("n_eval", 0)
+        except (OSError, ValueError):
+            return False
+        n_done = sum(
+            hi - lo for lo, hi in _completed_ranges(args._out_dir)
+        )
+        return n_done > 0 and have >= min(512, n_done)
+
     def _reserve() -> float:
         """End-of-run time to protect.  Shrinks as the remaining exit
-        obligations shrink: with eval.json on disk (or nothing evaluable)
-        only the summary print is left, so the probe/fit loop may run
-        nearly to the deadline — the round-3 failure mode was surrendering
-        with ~500 s left while a fixed 150 s reserve sat unused."""
-        if os.path.exists(os.path.join(args._out_dir, "eval.json")):
+        obligations shrink: with a covering eval.json on disk (or nothing
+        evaluable) only the summary print is left, so the probe/fit loop
+        may run nearly to the deadline — the round-3 failure mode was
+        surrendering with ~500 s left while a fixed 150 s reserve sat
+        unused."""
+        if _eval_covered():
             return 25.0
         if not _completed_ranges(args._out_dir):
             return 25.0  # nothing to eval; probing is the best use of time
@@ -1162,9 +1175,7 @@ def main() -> None:
         payloads, so a late tunnel recovery converts into chunks instantly."""
         done = _completed_ranges(args._out_dir)
         n_done = sum(hi - lo for lo, hi in done)
-        if n_done and not os.path.exists(
-            os.path.join(args._out_dir, "eval.json")
-        ):
+        if n_done and not _eval_covered():
             _side_child("eval", ["--n-eval", str(min(512, n_done))])
         if _missing_ranges(done, args.series):
             _side_child("prep", [
@@ -1260,7 +1271,6 @@ def main() -> None:
         time.sleep(10.0)  # let the crashed TPU worker restart cleanly
 
     n_done = sum(hi - lo for lo, hi in _completed_ranges(args._out_dir))
-    eval_json = os.path.join(args._out_dir, "eval.json")
     ep = side.get("eval")
     if ep is not None and ep.poll() is None:
         # An overlapped eval is already in flight; give it the remaining
@@ -1269,7 +1279,9 @@ def main() -> None:
             ep.wait(timeout=max(15.0, deadline - time.time() - 15.0))
         except subprocess.TimeoutExpired:
             ep.kill()
-    if n_done and not os.path.exists(eval_json):
+    # Re-run when coverage grew past what an overlapped mid-wedge eval
+    # scored (eval.json records its n_eval; the worker overwrites it).
+    if n_done and not _eval_covered():
         eval_budget = max(60.0, deadline - time.time() - 15.0)
         _spawn("--_eval", args, ["--n-eval", str(min(512, n_done))],
                timeout=eval_budget)

@@ -39,16 +39,9 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def _host_cpu_tag() -> str:
-    import hashlib
+    from tsspark_tpu.utils.platform import host_cpu_tag
 
-    try:
-        with open("/proc/cpuinfo") as fh:
-            line = next(l for l in fh if l.startswith("flags"))
-    except (OSError, StopIteration):
-        import platform
-
-        line = platform.platform()
-    return hashlib.md5(line.encode()).hexdigest()[:8]
+    return host_cpu_tag()
 
 
 jax.config.update(

@@ -77,7 +77,9 @@ def test_bucketed_lockstep_trajectory_matches_unbucketed():
         max_iters=1, tol=0.0, gtol=0.0,
         floor_patience=1 << 30, ftol_patience=1 << 30,
     )
-    st0 = TpuBackend(CFG, solver, length_buckets=1).fit(ds, y, mask=mask)
+    st0 = TpuBackend(CFG, solver, length_buckets=1, rescue=False).fit(
+        ds, y, mask=mask
+    )
     st3 = TpuBackend(CFG, solver, rescue=False).fit(ds, y, mask=mask)
     th0, th3 = np.asarray(st0.theta), np.asarray(st3.theta)
     scale = max(np.abs(th0).max(), 1.0)

@@ -17,3 +17,24 @@ import jax
 def honor_env_platforms() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def host_cpu_tag() -> str:
+    """Host-CPU fingerprint for persistent compile-cache dirs.
+
+    XLA:CPU AOT cache entries bake in the compile machine's feature set;
+    loading one on a different VM generation is a documented SIGILL/SIGSEGV
+    path.  Keying cache dirs on a hash of the cpuinfo flags line makes
+    cross-host reuse impossible (bench.py and tests/conftest.py share this
+    single definition so their cache keys can never drift apart).
+    """
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as fh:
+            line = next(l for l in fh if l.startswith("flags"))
+    except (OSError, StopIteration):
+        import platform as _platform
+
+        line = _platform.platform()
+    return hashlib.md5(line.encode()).hexdigest()[:8]
