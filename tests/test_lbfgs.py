@@ -318,7 +318,11 @@ def test_dynamic_depth_matches_static():
     )
     data, _ = prepare_fit_data(ds, y, cfg)
 
-    res_static = fit_core(data, None, cfg, SolverConfig(max_iters=9))
+    # precond pinned to "none": the gn flag below is OFF, and the default
+    # ("auto") now resolves to gn_diag, which would be a different metric.
+    res_static = fit_core(
+        data, None, cfg, SolverConfig(max_iters=9, precond="none")
+    )
     res_dyn = fit_core(
         data,
         np.zeros_like(np.asarray(res_static.theta)),  # ignored: flag off

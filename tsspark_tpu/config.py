@@ -244,18 +244,18 @@ class SolverConfig:
     # optimum; "heuristic" is Prophet's endpoint initializer.
     init: str = "ridge"
     # Initial L-BFGS metric: "gn_diag" preconditions with the inverse
-    # Gauss-Newton diagonal at theta0 (models/prophet/init.curvature_diag) —
-    # rescues ill-conditioned series that stall in float32 (measured: cuts a
-    # 1.4-nat gap vs the scipy oracle to 0.03 on hard 64-day series), but
-    # SLOWS the well-conditioned majority that the ridge init already lands
-    # next to the optimum (measured: 12-iter convergence 89% -> 13% on the
-    # M5 config).  "auto" (default) resolves per model: "gn_diag" for
-    # logistic growth, whose sigmoid curvature mixes scales badly enough
-    # that the plain metric loses ~1 nat/series to the scipy oracle at the
-    # same depth (round-4 measurement: mean loss gap +0.52 -> -0.95 on 32
-    # wiki-logistic series), "none" for linear/flat.  The two-phase fit
-    # additionally applies "gn_diag" to its compacted straggler pass, which
-    # is exactly the ill-conditioned tail (backends/tpu.fit_twophase).
+    # Gauss-Newton diagonal at theta0 (models/prophet/init.curvature_diag).
+    # "auto" (default) currently resolves to "gn_diag" for every growth
+    # mode on full-depth solves — measured round 4 on the M5 eval config
+    # (609 series vs the scipy oracle): GN-primary + rescue cuts the
+    # holdout-parity tail p99 0.86 -> 0.58 sMAPE at equal wall, and on
+    # logistic growth the plain metric loses ~1 nat/series at the same
+    # depth (mean gap +0.52 -> -0.95 after the switch).  The one place the
+    # plain metric still wins is SHORT-depth lockstep passes (GN roughly
+    # halves the fraction converged by iteration 12 on the well-ridge-
+    # initialized majority), which is why the two-phase bench pins its
+    # phase-1 to the plain metric and phase-2 to "gn_diag" via the traced
+    # solver switches rather than relying on this default.
     precond: str = "auto"
 
     def __post_init__(self):
@@ -270,7 +270,8 @@ class SolverConfig:
         """Concrete initial-metric choice for a model's growth mode."""
         if self.precond != "auto":
             return self.precond
-        return "gn_diag" if growth == "logistic" else "none"
+        del growth  # measured best for every growth mode (see above)
+        return "gn_diag"
 
 
 @dataclasses.dataclass(frozen=True)
