@@ -72,7 +72,7 @@ RESERVE_S = 150.0
 # policy, data handling).  Orchestration-only changes (probing, retries,
 # logging) must NOT bump it: the whole point of the numerics-scoped
 # fingerprint below is that resume state survives them.
-BENCH_NUMERICS_REV = 4
+BENCH_NUMERICS_REV = 5
 
 
 def _code_fingerprint() -> str:
@@ -309,10 +309,7 @@ def fit_worker(args) -> int:
     )
     from tsspark_tpu.models.prophet.model import (
         FitState, fit_core_packed, fitstate_from_packed,
-        select_better_state,
     )
-    from tsspark_tpu.models.prophet.model import KEEP_BEST_MARGIN \
-        as select_margin
 
     ds = np.load(os.path.join(args.data, "ds.npy"))
     y = np.load(os.path.join(args.data, "y.npy"), mmap_mode="r")
@@ -564,26 +561,25 @@ def fit_worker(args) -> int:
                     data2, meta2, ds, reg_u8_cols=u8_cols,
                     collapse_cap=True,
                 )
-                # Multi-start: warm-started from phase 1 AND fresh from
-                # the ridge init (same compiled program, only the traced
-                # use_init flag differs); keep each series' lower loss.
-                cands = []
-                for use_init in (True, False):
-                    th2, st2 = fit_core_packed(
-                        packed2, init_s[lo2:hi2], model.config,
-                        model.solver_config,
-                        reg_u8_cols=u8_cols,
-                        max_iters_dynamic=np.int32(args.max_iters),
-                        gn_precond_dynamic=np.bool_(True),
-                        use_theta0_dynamic=np.bool_(use_init),
-                    )
-                    jax.block_until_ready(th2)
-                    heartbeat()
-                    cands.append(fitstate_from_packed(
-                        np.asarray(th2), st2, meta2
-                    ))
-                subs.append(select_better_state(
-                    *cands, margin=select_margin))
+                # Warm continuation only: phase 2's set is series still
+                # PROGRESSING at the phase-1 cap (stuck exits carry
+                # status FLOOR/STALLED and are the rescue path's job, not
+                # phase 2's) — measured round 4, a fresh-ridge restart
+                # won 0/120 of these with zero total gain, so the second
+                # solve bought nothing at double the phase-2 cost.
+                th2, st2 = fit_core_packed(
+                    packed2, init_s[lo2:hi2], model.config,
+                    model.solver_config,
+                    reg_u8_cols=u8_cols,
+                    max_iters_dynamic=np.int32(args.max_iters),
+                    gn_precond_dynamic=np.bool_(True),
+                    use_theta0_dynamic=np.bool_(True),
+                )
+                jax.block_until_ready(th2)
+                heartbeat()
+                subs.append(fitstate_from_packed(
+                    np.asarray(th2), st2, meta2
+                ))
             state2 = jax.tree.map(
                 lambda *xs: np.concatenate(xs, axis=0)[:n_s], *subs
             )

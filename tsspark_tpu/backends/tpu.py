@@ -472,20 +472,16 @@ class TpuBackend(ForecastBackend):
             dyn_warm = [{}]
         else:
             fit2 = self.fit
-            # Multi-start for the ill-conditioned tail: continue from the
-            # phase-1 point AND solve fresh from the ridge init (a stuck
-            # phase-1 iterate can trap the warm start in a worse basin),
-            # then keep each series' lower loss.  Same compiled program
-            # both times — only the traced use_init flag differs — and the
-            # straggler batch is tiny, so the second solve is ~free.
-            base = dict(
+            # Warm continuation only: this set is series still PROGRESSING
+            # at the phase-1 cap (stuck exits carry status FLOOR/STALLED
+            # and are the rescue pass's job) — measured round 4, a
+            # fresh-ridge restart won 0/120 of these with zero total gain,
+            # so the former second solve bought nothing for its cost.
+            dyn_warm = [dict(
                 max_iters_dynamic=np.int32(self.solver_config.max_iters),
                 gn_precond_dynamic=np.bool_(True),
-            )
-            dyn_warm = [
-                dict(base, use_init_dynamic=np.bool_(True)),
-                dict(base, use_init_dynamic=np.bool_(False)),
-            ]
+                use_init_dynamic=np.bool_(True),
+            )]
         kwargs = dict(
             mask=sub(mask if mask is not None
                      else np.isfinite(np.asarray(y)).astype(np.float32)),
