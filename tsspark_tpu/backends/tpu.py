@@ -467,21 +467,21 @@ class TpuBackend(ForecastBackend):
                 [a, np.full((pad,) + a.shape[1:], fill, a.dtype)]
             ) if pad else a
 
+        # Warm continuation only: this set is series still PROGRESSING at
+        # the phase-1 cap (stuck exits carry status FLOOR/STALLED and are
+        # the rescue pass's job) — measured round 4, a fresh-ridge restart
+        # won 0/120 of these with zero total gain, so the former
+        # multi-start second solve bought nothing for its cost.
         if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
             fit2 = self._straggler_backend().fit
-            dyn_warm = [{}]
+            dyn2 = {}
         else:
             fit2 = self.fit
-            # Warm continuation only: this set is series still PROGRESSING
-            # at the phase-1 cap (stuck exits carry status FLOOR/STALLED
-            # and are the rescue pass's job) — measured round 4, a
-            # fresh-ridge restart won 0/120 of these with zero total gain,
-            # so the former second solve bought nothing for its cost.
-            dyn_warm = [dict(
+            dyn2 = dict(
                 max_iters_dynamic=np.int32(self.solver_config.max_iters),
                 gn_precond_dynamic=np.bool_(True),
                 use_init_dynamic=np.bool_(True),
-            )]
+            )
         kwargs = dict(
             mask=sub(mask if mask is not None
                      else np.isfinite(np.asarray(y)).astype(np.float32)),
@@ -494,12 +494,7 @@ class TpuBackend(ForecastBackend):
             reg_u8_cols=u8,
         )
         ds2 = ds if np.asarray(ds).ndim == 1 else sub(np.asarray(ds))
-        state2 = fit2(ds2, sub(y), **kwargs, **dyn_warm[0])
-        for dyn in dyn_warm[1:]:
-            state2 = select_better_state(
-                state2, fit2(ds2, sub(y), **kwargs, **dyn),
-                margin=KEEP_BEST_MARGIN,
-            )
+        state2 = fit2(ds2, sub(y), **kwargs, **dyn2)
         if pad:
             state2 = _slice_state(state2, 0, idx.size)
         return patch_state(state, idx, state2)
@@ -519,7 +514,12 @@ class TpuBackend(ForecastBackend):
         )
 
     def _phase1(self, phase1_iters: int) -> "TpuBackend":
-        return self._derived(max_iters=phase1_iters)
+        # Plain metric pinned: the GN default ("auto") is the FULL-depth
+        # choice; at phase-1's short lockstep depth the plain metric
+        # converges roughly twice as many series by the cap (config.py),
+        # and the packed path pins the same thing via
+        # gn_precond_dynamic=False — the two modes must agree.
+        return self._derived(max_iters=phase1_iters, precond="none")
 
     def _straggler_backend(self) -> "TpuBackend":
         """Full-depth backend for the compacted unconverged tail, with the
