@@ -13,9 +13,11 @@ per-host processes that each hold only their slice of the series batch:
      collectives over ICI within a host and DCN across hosts.
 
 Single-process meshes degrade gracefully: ``global_batch`` is then just a
-device_put onto the mesh sharding (this is what the CPU-mesh tests cover;
-multi-process behavior uses the same jax.make_array_from_process_local_data
-contract).
+device_put onto the mesh sharding.  The REAL multi-process path (two OS
+processes joined via jax.distributed, each holding half the batch,
+assembled with jax.make_array_from_process_local_data and solved over a
+4-device mesh) is exercised by tests/test_multihost.py, which checks every
+addressable result shard against a single-device reference solve.
 """
 
 from __future__ import annotations
