@@ -233,14 +233,30 @@ def prep_worker(args) -> int:
     model = ProphetModel(_model_config(), SolverConfig(max_iters=args.max_iters))
     u8_cols = _indicator_reg_cols(reg)
 
+    # Completed COVERAGE, not exact chunk-file names: after a mid-run
+    # chunk halving, regions fitted under the old wider grid have no file
+    # at the new (lo, hi) spacing, and pre-packing them would burn the
+    # bounded --max-ahead budget on payloads no fit worker will read.
+    done = _completed_ranges(args.out)
+
+    def _covered(lo: int, hi: int) -> bool:
+        cur = lo
+        for dlo, dhi in done:
+            if dhi <= cur:
+                continue
+            if dlo > cur:
+                return False
+            cur = dhi
+            if cur >= hi:
+                return True
+        return cur >= hi
+
     made = 0
     for lo in range(0, args.series, args.chunk):
         if made >= args.max_ahead:
             break
         hi = min(lo + args.chunk, args.series)
-        if os.path.exists(
-            os.path.join(args.out, f"chunk_{lo:06d}_{hi:06d}.npz")
-        ) or os.path.exists(_prep_path(args.out, lo, hi)):
+        if _covered(lo, hi) or os.path.exists(_prep_path(args.out, lo, hi)):
             continue
         b_real = hi - lo
         y_c = np.zeros((args.chunk, y.shape[1]), np.float32)
@@ -1210,9 +1226,16 @@ def main() -> None:
         # by the CPU-side eval/prep children.
         if check_tunnel:
             t_probe = time.time()
+            # Escalating timeout: cheap 30 s probes while wedged, but a
+            # healthy tunnel whose client creation is merely SLOW (30-90 s
+            # has been observed) must not fail every probe forever — each
+            # consecutive failure buys the next probe more patience, up
+            # to the old 90 s allowance.
+            patience = min(30.0 + 15.0 * probes.get("consec", 0), 90.0)
             ok = _tunnel_preflight(
-                timeout=min(30.0, max(10.0, remaining - _reserve()))
+                timeout=min(patience, max(10.0, remaining - _reserve()))
             )
+            probes["consec"] = 0 if ok else probes.get("consec", 0) + 1
             _probe_log(ok, time.time() - t_probe)
             if not ok:
                 print(

@@ -162,10 +162,23 @@ class TpuBackend(ForecastBackend):
             length = min(t_len, -(-(hi - lo) // 128) * 128)
             lo = max(0, hi - length)
             hi = min(t_len, lo + length)
-            if plan and (plan[-1][2] - plan[-1][1]) >= 0.85 * (hi - lo):
-                prev_idx, prev_lo, prev_hi = plan.pop()
-                idx = np.concatenate([prev_idx, idx])
-                lo, hi = min(prev_lo, lo), max(prev_hi, hi)
+            if plan:
+                prev_idx, prev_lo, prev_hi = plan[-1]
+                union_lo = min(prev_lo, lo)
+                union_hi = max(prev_hi, hi)
+                # Merge only when the UNION window is barely bigger than
+                # the SMALLER member — i.e. merging costs the smaller
+                # bucket almost nothing.  Comparing against the larger
+                # member would always merge nested windows (union ==
+                # larger, erasing the smaller bucket's savings), and
+                # similar LENGTHS alone are not enough either (two
+                # equal-span buckets at disjoint calendar offsets would
+                # union into a near-full-grid window).
+                if union_hi - union_lo <= 1.15 * min(hi - lo,
+                                                     prev_hi - prev_lo):
+                    plan.pop()
+                    idx = np.concatenate([prev_idx, idx])
+                    lo, hi = union_lo, union_hi
             plan.append((idx, lo, hi))
         if len(plan) < 2:
             return None
@@ -261,8 +274,8 @@ class TpuBackend(ForecastBackend):
         # n_iters reports work actually SPENT on the series (both starts
         # ran regardless of which point won); patch_state accumulates it
         # onto the main solve's count.
-        best = best._replace(n_iters=np.maximum(
-            np.asarray(warm.n_iters), np.asarray(fresh.n_iters)
+        best = best._replace(n_iters=(
+            np.asarray(warm.n_iters) + np.asarray(fresh.n_iters)
         ))
         return patch_state(state, idx, best)
 
@@ -494,7 +507,15 @@ class TpuBackend(ForecastBackend):
             reg_u8_cols=u8,
         )
         ds2 = ds if np.asarray(ds).ndim == 1 else sub(np.asarray(ds))
-        state2 = fit2(ds2, sub(y), **kwargs, **dyn2)
+        # Phase 1's fit already emitted the one full-batch out-of-span
+        # changepoint warning; the compacted refit must not add a second
+        # copy with subset counts.
+        from tsspark_tpu.models.prophet.design import (
+            changepoint_span_warning_suppressed as _no_cp_warn,
+        )
+
+        with _no_cp_warn():
+            state2 = fit2(ds2, sub(y), **kwargs, **dyn2)
         if pad:
             state2 = _slice_state(state2, 0, idx.size)
         return patch_state(state, idx, state2)

@@ -105,7 +105,15 @@ def _logistic_km_init(
     r0 = jnp.where(jnp.abs(r0 - r1) <= 0.01, jnp.clip(r0 * 1.05, 0.01, 0.99), r0)
     l0 = jnp.log(r0 / (1.0 - r0))
     l1 = jnp.log(r1 / (1.0 - r1))
-    k0 = (l1 - l0) / jnp.maximum(t1 - t0, eps)
+    # Degenerate span (single observed point; all-masked padding rows):
+    # dividing the nudged Δlogit by the eps floor would manufacture a
+    # ±5e6 rate that saturates the sigmoid and leaves the solver
+    # descending the prior from nowhere — start those rows flat instead.
+    span = t1 - t0
+    degenerate = span < 1e-6
+    k0 = jnp.where(
+        degenerate, 0.0, (l1 - l0) / jnp.maximum(span, eps)
+    )
     safe_k = jnp.where(jnp.abs(k0) < eps, jnp.where(k0 < 0, -eps, eps), k0)
     m0 = jnp.where(
         jnp.abs(k0) >= eps, t0 - l0 / safe_k, 0.5 * (t0 + t1)

@@ -382,7 +382,27 @@ class ProphetModel:
             and not (iter_segment and iter_segment < self.solver_config.max_iters)
             and bool(np.all((mask_np == 0.0) | (mask_np == 1.0)))
         )
-        dynamic = max_iters_dynamic is not None
+        dynamic = any(
+            v is not None
+            for v in (max_iters_dynamic, gn_precond_dynamic, use_init_dynamic)
+        )
+        if dynamic:
+            # Partial traced controls are normalized to the full triple so
+            # every path (the packed one-program path AND the static
+            # fallback) keeps the exact semantics of the static config it
+            # replaces: missing depth = the solver's own cap, missing
+            # metric flag = resolved_precond (NOT a silent "none" — the
+            # "auto" default resolves to gn_diag), missing init flag =
+            # honor a caller-supplied init.
+            if max_iters_dynamic is None:
+                max_iters_dynamic = np.int32(self.solver_config.max_iters)
+            if gn_precond_dynamic is None:
+                gn_precond_dynamic = np.bool_(
+                    self.solver_config.resolved_precond(self.config.growth)
+                    == "gn_diag"
+                )
+            if use_init_dynamic is None:
+                use_init_dynamic = np.bool_(init is not None)
         if packable:
             # Not guarded by try/except: pack_fit_data's remaining failure
             # mode (reg_u8_cols naming a non-0/1 column) is a caller
@@ -410,21 +430,16 @@ class ProphetModel:
                 on_segment()
             return fitstate_from_packed(theta, stats, meta)
         if dynamic:
-            # Fallback path: fold the traced phase controls into an
-            # equivalent static solver (semantics preserved; the
-            # shared-program benefit only exists on the packed path).
+            # Fallback path: fold the (normalized) traced phase controls
+            # into an equivalent static solver — semantics preserved; the
+            # shared-program benefit only exists on the packed path.
             solver = dataclasses.replace(
                 self.solver_config,
                 max_iters=int(max_iters_dynamic),
                 precond="gn_diag" if bool(gn_precond_dynamic) else "none",
             )
             fallback = ProphetModel(self.config, solver)
-            # use_init_dynamic None keeps the default semantics (honor a
-            # caller-supplied init), matching the packed path; only an
-            # explicit False drops it in favor of the ridge init.
-            theta0 = init if (
-                use_init_dynamic is None or bool(use_init_dynamic)
-            ) else None
+            theta0 = init if bool(use_init_dynamic) else None
             return fallback._fit_prepared(
                 data, meta, theta0, iter_segment, on_segment
             )

@@ -32,7 +32,14 @@ def host_cpu_tag() -> str:
 
     try:
         with open("/proc/cpuinfo") as fh:
-            line = next(l for l in fh if l.startswith("flags"))
+            # x86 calls the line "flags"; ARM64 calls it "Features" — the
+            # guard must key on actual CPU capabilities on both, not fall
+            # through to a kernel string that two different-feature VMs
+            # can share.
+            line = next(
+                l for l in fh
+                if l.startswith("flags") or l.startswith("Features")
+            )
     except (OSError, StopIteration):
         import platform as _platform
 
