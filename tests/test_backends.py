@@ -427,3 +427,38 @@ def test_small_batches_share_one_compiled_shape():
             st = bk.fit(ds, y)
             assert np.asarray(st.theta).shape[0] == b
     assert seen == [32, 32, 32, 32]
+
+
+def test_partial_dynamic_flags_keep_static_semantics():
+    """Passing ONLY max_iters_dynamic must behave exactly like the static
+    config at that depth: missing flags are normalized (metric from
+    resolved_precond — NOT silently 'none' — and a caller init honored),
+    on both the packed path and the non-packable fallback (review r4)."""
+    from tsspark_tpu.models.prophet.model import ProphetModel
+
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=4,
+    )
+    rng = np.random.default_rng(3)
+    ds = np.arange(96, dtype=np.float64)
+    y = 5 + 0.4 * ds[None] / 96 + np.sin(2 * np.pi * ds[None] / 7.0) \
+        + rng.normal(0, 0.1, (6, 96))
+
+    m_dyn = ProphetModel(cfg, SolverConfig(max_iters=120))
+    m_static = ProphetModel(cfg, SolverConfig(max_iters=7))
+    for label, mask in (
+        ("packed", None),                       # exact 0/1 mask -> packed
+        ("fallback", np.full_like(y, 0.5)),     # fractional -> FitData path
+    ):
+        st_d = m_dyn.fit(ds, y, mask=mask,
+                         max_iters_dynamic=np.int32(7))
+        st_s = m_static.fit(ds, y, mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(st_d.theta), np.asarray(st_s.theta),
+            rtol=0, atol=1e-5, err_msg=label,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(st_d.n_iters), np.asarray(st_s.n_iters),
+            err_msg=label,
+        )
