@@ -406,8 +406,9 @@ def fit_worker(args) -> int:
         # as_numpy: a prep thread must not issue device transfers — on the
         # single-chip tunnel they queue behind the in-flight fit program
         # and re-serialize the pipeline the prefetch exists to overlap.
-        # pack_fit_data then cuts the shipped bytes ~2.5x (uint8 mask,
-        # device-side t reconstruction, elided cap; design.PackedFitData).
+        # pack_fit_data then cuts the shipped bytes ~3x (mask folded into
+        # y as NaN, bit-packed indicator columns, device-side t
+        # reconstruction, elided cap; design.PackedFitData).
         data, meta = model.prepare(
             ds, y_c, mask=m_c, regressors=r_c, as_numpy=True
         )
@@ -445,7 +446,46 @@ def fit_worker(args) -> int:
         elif frac_unconv < 0.005 and depth["v"] > 8:
             depth["v"] = max(8, int(depth["v"]) * 2 // 3)
 
-    with ThreadPoolExecutor(max_workers=2) as pool:
+    def save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1):
+        """Chunk save + prep-file cleanup + one times.jsonl row (shared by
+        the packed writer path and the segmented inline path)."""
+        _save_chunk_atomic(args.out, lo, hi, state)
+        try:  # prep payload served its purpose; bound scratch disk
+            os.remove(_prep_path(args.out, lo, hi))
+        except OSError:
+            pass
+        with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
+            fh.write(json.dumps({
+                "lo": lo, "hi": hi, "fit_s": round(fit_s, 3),
+                "wait_s": round(t_wait, 3), "put_s": round(t_put, 3),
+                "dev_s": round(t_dev, 3),
+                "read_s": round(time.time() - t1, 3),
+                "chunk": args.chunk, "device": str(jax.devices()[0]),
+            }) + "\n")
+
+    # Post-fit host work (device->host readback of the small result
+    # buffers, FitState assembly, chunk-file save) rides a single writer
+    # thread so the main thread's next device_put starts immediately after
+    # the fit dispatch completes — the readbacks (~0.4 MB) overlap the next
+    # chunk's multi-MB upload instead of serializing ahead of it.  One
+    # worker keeps times.jsonl appends race-free.  ``fit_s`` is captured
+    # on the MAIN thread at hand-off so it measures the chunk's actual
+    # wall (wait+put+dev); read_s alone reflects writer-side readback,
+    # which may overlap the next chunk's upload.
+    def finish_chunk(lo, hi, b_real, theta, stats, meta, fit_s, t_wait,
+                     t_put, t_dev):
+        t1 = time.time()
+        state = fitstate_from_packed(
+            np.asarray(theta)[:b_real],
+            np.asarray(stats)[:, :b_real],
+            jax.tree.map(lambda a: np.asarray(a)[:b_real], meta),
+        )
+        save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1)
+        return state
+
+    with ThreadPoolExecutor(max_workers=2) as pool, \
+            ThreadPoolExecutor(max_workers=1) as writer:
+        write_futs = []
         futs = {
             j: pool.submit(prep, *todo[j])
             for j in range(min(prefetch_depth, len(todo)))
@@ -473,6 +513,8 @@ def fit_worker(args) -> int:
                 state = jax.tree.map(
                     lambda a: np.asarray(a)[:b_real], state
                 )
+                save_and_log(lo, hi, state, time.time() - t0,
+                             t_wait, t_put, t_dev, t1)
             else:
                 theta, stats = fit_core_packed(
                     payload, zeros_theta, model.config, model.solver_config,
@@ -484,27 +526,20 @@ def fit_worker(args) -> int:
                 jax.block_until_ready(theta)
                 heartbeat()
                 t_dev = time.time() - t1
-                t1 = time.time()
-                state = fitstate_from_packed(
-                    np.asarray(theta)[:b_real],
-                    np.asarray(stats)[:, :b_real],
-                    jax.tree.map(lambda a: np.asarray(a)[:b_real], meta),
-                )
-                tune_depth(state, b_real)
-            fit_s = time.time() - t0
-            _save_chunk_atomic(args.out, lo, hi, state)
-            try:  # prep payload served its purpose; bound scratch disk
-                os.remove(_prep_path(args.out, lo, hi))
-            except OSError:
-                pass
-            with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
-                fh.write(json.dumps({
-                    "lo": lo, "hi": hi, "fit_s": round(fit_s, 3),
-                    "wait_s": round(t_wait, 3), "put_s": round(t_put, 3),
-                    "dev_s": round(t_dev, 3),
-                    "read_s": round(time.time() - t1, 3),
-                    "chunk": args.chunk, "device": str(jax.devices()[0]),
-                }) + "\n")
+                fit_s = time.time() - t0
+                if not depth["tuned"]:
+                    # Depth must settle before chunk 1 dispatches, so
+                    # chunk 0 finalizes inline.
+                    state = finish_chunk(lo, hi, b_real, theta, stats,
+                                         meta, fit_s, t_wait, t_put, t_dev)
+                    tune_depth(state, b_real)
+                else:
+                    write_futs.append(writer.submit(
+                        finish_chunk, lo, hi, b_real, theta, stats, meta,
+                        fit_s, t_wait, t_put, t_dev,
+                    ))
+        for f in write_futs:
+            f.result()  # surface writer-thread failures before phase 2
 
     # ---- phase 2: compacted straggler pass over the whole series range ----
     if not two_phase:
@@ -566,8 +601,12 @@ def fit_worker(args) -> int:
             state2 = jax.tree.map(lambda a: np.asarray(a)[:n_s], state2)
             jax.block_until_ready(jax.tree.leaves(state2)[0])
         else:
-            subs = []
-            for lo2 in range(0, n_s + pad, args.chunk):
+            # Straggler sub-chunk prep (numpy design build + packing,
+            # ~1 s each) prefetched on threads so it overlaps the deep
+            # device solves, same pattern as the phase-1 loop.
+            lows = list(range(0, n_s + pad, args.chunk))
+
+            def prep2(lo2):
                 hi2 = lo2 + args.chunk
                 data2, meta2 = model.prepare(
                     ds, y_s[lo2:hi2], mask=m_s[lo2:hi2],
@@ -577,25 +616,39 @@ def fit_worker(args) -> int:
                     data2, meta2, ds, reg_u8_cols=u8_cols,
                     collapse_cap=True,
                 )
-                # Warm continuation only: phase 2's set is series still
-                # PROGRESSING at the phase-1 cap (stuck exits carry
-                # status FLOOR/STALLED and are the rescue path's job, not
-                # phase 2's) — measured round 4, a fresh-ridge restart
-                # won 0/120 of these with zero total gain, so the second
-                # solve bought nothing at double the phase-2 cost.
-                th2, st2 = fit_core_packed(
-                    packed2, init_s[lo2:hi2], model.config,
-                    model.solver_config,
-                    reg_u8_cols=u8_cols,
-                    max_iters_dynamic=np.int32(args.max_iters),
-                    gn_precond_dynamic=np.bool_(True),
-                    use_theta0_dynamic=np.bool_(True),
-                )
-                jax.block_until_ready(th2)
-                heartbeat()
-                subs.append(fitstate_from_packed(
-                    np.asarray(th2), st2, meta2
-                ))
+                return packed2, meta2
+
+            subs = []
+            with ThreadPoolExecutor(max_workers=2) as pool2:
+                futs2 = {
+                    j: pool2.submit(prep2, lows[j])
+                    for j in range(min(prefetch_depth, len(lows)))
+                }
+                for j, lo2 in enumerate(lows):
+                    packed2, meta2 = futs2.pop(j).result()
+                    nxt = j + prefetch_depth
+                    if nxt < len(lows):
+                        futs2[nxt] = pool2.submit(prep2, lows[nxt])
+                    # Warm continuation only: phase 2's set is series
+                    # still PROGRESSING at the phase-1 cap (stuck exits
+                    # carry status FLOOR/STALLED and are the rescue
+                    # path's job, not phase 2's) — measured round 4, a
+                    # fresh-ridge restart won 0/120 of these with zero
+                    # total gain, so the second solve bought nothing at
+                    # double the phase-2 cost.
+                    th2, st2 = fit_core_packed(
+                        packed2, init_s[lo2:lo2 + args.chunk],
+                        model.config, model.solver_config,
+                        reg_u8_cols=u8_cols,
+                        max_iters_dynamic=np.int32(args.max_iters),
+                        gn_precond_dynamic=np.bool_(True),
+                        use_theta0_dynamic=np.bool_(True),
+                    )
+                    jax.block_until_ready(th2)
+                    heartbeat()
+                    subs.append(fitstate_from_packed(
+                        np.asarray(th2), st2, meta2
+                    ))
             state2 = jax.tree.map(
                 lambda *xs: np.concatenate(xs, axis=0)[:n_s], *subs
             )

@@ -162,10 +162,13 @@ def _mixed_batch(b=6, t_len=120):
     return cfg, ds, y, mask, reg
 
 
-def test_packed_fit_data_roundtrip():
+@pytest.mark.parametrize("t_len", [120, 125])
+def test_packed_fit_data_roundtrip(t_len):
     """pack_fit_data -> unpack_fit_data reproduces the prepared FitData:
     bit-for-bit except t (reconstructed on device from per-series scalars,
-    allowed a few f32 ulps)."""
+    allowed a few f32 ulps).  t_len=125 exercises the bit-packed
+    indicator tail (T % 8 != 0: the last byte carries padding bits that
+    must be sliced off on device)."""
     import jax
 
     from tsspark_tpu.models.prophet.design import (
@@ -173,16 +176,19 @@ def test_packed_fit_data_roundtrip():
         unpack_fit_data,
     )
 
-    cfg, ds, y, mask, reg = _mixed_batch()
+    cfg, ds, y, mask, reg = _mixed_batch(t_len=t_len)
     data, meta = prepare_fit_data(
         ds, y, cfg, mask=mask, regressors=reg, as_numpy=True
     )
     packed, u8_cols = pack_fit_data(data, meta, ds, collapse_cap=True)
-    # Binary promo column (index 0) travels as uint8, continuous price as f32.
+    # Binary promo column (index 0) travels bit-packed, continuous price
+    # as f32; the mask travels folded into y as NaN.
     assert u8_cols == (0,)
-    assert packed.X_reg_u8.shape[-1] == 1
+    assert packed.X_reg_bits.shape[-1] == 1
+    assert packed.X_reg_bits.shape[1] == -(-y.shape[1] // 8)
+    assert packed.X_reg_bits.dtype == np.uint8
     assert packed.X_reg.shape[-1] == 1
-    assert packed.mask_u8.dtype == np.uint8
+    assert bool(np.any(~np.isfinite(packed.y))) == bool(np.any(mask == 0))
     assert packed.cap.shape[-1] == 1  # linear growth: cap not shipped
 
     un = jax.jit(

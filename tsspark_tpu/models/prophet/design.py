@@ -69,15 +69,21 @@ class PackedFitData(NamedTuple):
     """Transfer-optimized FitData for shared-calendar batches.
 
     On a tunneled single-chip runtime the host->device copy is the dominant
-    per-chunk cost once the fit itself is fast (measured round 3: ~56 MB and
-    0.7-1.4 s per 1024x1941 chunk vs 0.22 s of fit).  This form ships the
-    same information in ~40% of the bytes:
+    per-chunk cost once the fit itself is fast (measured round 4 at chunk
+    2048x1941: ~0.63 s of device solve vs 1-5 s of transfer).  This form
+    ships the same information in a fraction of the bytes, bit-exactly:
 
-      * ``mask_u8``: the 0/1 validity mask as uint8 (4x smaller; cast back
-        to f32 on device);
-      * ``t`` is not shipped at all — the (B, T) scaled-time grid is an
-        affine map of the SHARED calendar, reconstructed on device from the
-        (T,) relative grid and two (B,) per-series scalars (error ~1e-6 in
+      * the validity mask is not shipped at all — masked cells of ``y``
+        travel as NaN and the device recovers ``mask = isfinite(y)`` and
+        ``y = where(mask, y, 0)``, both bit-exact because prepare_fit_data
+        zeroes masked observations and the packer requires an exact 0/1
+        mask;
+      * exact-0/1 indicator regressor columns (holidays, promos) are
+        bit-packed 8 time steps per byte (``X_reg_bits``, 32x smaller than
+        f32; unpacked on device with shifts);
+      * ``t`` is not shipped — the (B, T) scaled-time grid is an affine
+        map of the SHARED calendar, reconstructed on device from the (T,)
+        relative grid and two (B,) per-series scalars (error ~1e-6 in
         [0, 1] scaled units, far below the daily grid spacing ~5e-4);
       * ``cap`` collapses to (B, 1) for non-logistic growth (it is all-ones
         and unused by the trend there).
@@ -86,8 +92,7 @@ class PackedFitData(NamedTuple):
     no extra dispatch and the expanded tensors never cross the tunnel.
     """
 
-    y: jnp.ndarray            # (B, T) f32 scaled observations
-    mask_u8: jnp.ndarray      # (B, T) uint8 validity
+    y: jnp.ndarray            # (B, T) f32 scaled observations; NaN = masked
     ds_rel: jnp.ndarray       # (T,) f32 shared grid minus grid[0]
     t_off: jnp.ndarray        # (B,) f32: (ds_start - grid[0]) / ds_span
     t_inv_span: jnp.ndarray   # (B,) f32: 1 / ds_span
@@ -95,9 +100,34 @@ class PackedFitData(NamedTuple):
     cap: jnp.ndarray          # (B, 1) f32, or (B, T) f32 for logistic
     X_season: jnp.ndarray     # (T, Fs) or (B, T, Fs) f32
     X_reg: jnp.ndarray        # (B, T, R - K) f32 non-indicator columns
-    X_reg_u8: jnp.ndarray     # (B, T, K) uint8 exact-0/1 indicator columns
+    X_reg_bits: jnp.ndarray   # (B, ceil(T/8), K) u8 bit-packed indicators
     prior_scales: jnp.ndarray
     mult_mask: jnp.ndarray
+
+
+def _bitpack_time(a: np.ndarray) -> np.ndarray:
+    """(B, T, K) exact-0/1 array -> (B, ceil(T/8), K) uint8, little-endian
+    bits along the time axis (host side, numpy)."""
+    b, t, k = a.shape
+    tb = (t + 7) // 8
+    if k == 0:
+        return np.zeros((b, tb, 0), np.uint8)
+    pad = tb * 8 - t
+    u8 = np.asarray(a, np.uint8)
+    if pad:
+        u8 = np.concatenate([u8, np.zeros((b, pad, k), np.uint8)], axis=1)
+    w = (1 << np.arange(8, dtype=np.uint16)).reshape(1, 1, 8, 1)
+    return (
+        (u8.reshape(b, tb, 8, k).astype(np.uint16) * w).sum(axis=2)
+    ).astype(np.uint8)
+
+
+def _bitunpack_time(p: jnp.ndarray, t: int) -> jnp.ndarray:
+    """(B, ceil(T/8), K) uint8 -> (B, T, K) uint8 of 0/1 (traced; runs
+    inside the fit program — a few elementwise u8 ops, fused by XLA)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (p[:, :, None, :] >> shifts[None, None, :, None]) & jnp.uint8(1)
+    return bits.reshape(p.shape[0], -1, p.shape[-1])[:, :t, :]
 
 
 def _indicator_reg_cols(x_reg: np.ndarray) -> Tuple[int, ...]:
@@ -123,11 +153,11 @@ def pack_fit_data(
     ``ds`` is the shared (T,) calendar grid in absolute days (float64: the
     ds - ds[0] subtraction must happen before the f32 cast, same rationale
     as ScalingMeta).  Requires a shared grid and an exact 0/1 mask (the
-    uint8 transit would silently DROP fractionally-weighted observations
-    instead of down-weighting them); batches violating either keep the
-    plain FitData path.
+    NaN-fold transit only encodes observed/missing, so it would silently
+    DROP fractionally-weighted observations instead of down-weighting
+    them); batches violating either keep the plain FitData path.
 
-    ``reg_u8_cols``: which X_reg columns travel as uint8.  None
+    ``reg_u8_cols``: which X_reg columns travel bit-packed.  None
     auto-detects exact-0/1 columns — fine for a one-shot fit, but chunked
     pipelines must detect ONCE on the full dataset and pass the result
     here: the tuple is a static argument of the jitted consumer, and a
@@ -168,12 +198,18 @@ def pack_fit_data(
         if bad:
             raise ValueError(
                 f"reg_u8_cols {bad} contain non-0/1 values in this batch; "
-                "the uint8 transit would corrupt them"
+                "the bit-packed transit would corrupt them"
             )
     f32_cols = tuple(j for j in range(x_reg.shape[-1]) if j not in u8_cols)
+    # Mask folded into y as NaN: bit-exact because prepare_fit_data zeroes
+    # masked cells (y is "0 where masked" by the FitData contract), so the
+    # device-side where(isfinite(y), y, 0) reproduces data.y exactly and
+    # isfinite(y) reproduces the exact 0/1 mask checked above.
+    y_nan = np.where(
+        mask_np > 0, np.asarray(data.y, f32), np.float32(np.nan)
+    ).astype(f32)
     packed = PackedFitData(
-        y=np.asarray(data.y, f32),
-        mask_u8=np.asarray(data.mask, np.uint8),
+        y=y_nan,
         ds_rel=(ds64 - ds64[0]).astype(f32),
         t_off=((meta.ds_start - ds64[0]) / meta.ds_span).astype(f32),
         t_inv_span=(1.0 / meta.ds_span).astype(f32),
@@ -181,7 +217,9 @@ def pack_fit_data(
         cap=cap.astype(f32),
         X_season=np.asarray(data.X_season, f32),
         X_reg=np.ascontiguousarray(x_reg[..., f32_cols]),
-        X_reg_u8=np.ascontiguousarray(x_reg[..., u8_cols]).astype(np.uint8),
+        X_reg_bits=_bitpack_time(
+            np.ascontiguousarray(x_reg[..., u8_cols])
+        ),
         prior_scales=np.asarray(data.prior_scales, f32),
         mult_mask=np.asarray(data.mult_mask, f32),
     )
@@ -196,24 +234,28 @@ def unpack_fit_data(
         packed.ds_rel[None, :] * packed.t_inv_span[:, None]
         - packed.t_off[:, None]
     )
-    mask = packed.mask_u8.astype(packed.y.dtype)
+    finite = jnp.isfinite(packed.y)
+    y = jnp.where(finite, packed.y, jnp.zeros_like(packed.y))
+    mask = finite.astype(y.dtype)
     cap = packed.cap
     if cap.shape[-1] == 1:
         cap = jnp.broadcast_to(cap, packed.y.shape)
-    r = packed.X_reg.shape[-1] + packed.X_reg_u8.shape[-1]
+    r = packed.X_reg.shape[-1] + packed.X_reg_bits.shape[-1]
     f32_cols = tuple(j for j in range(r) if j not in reg_u8_cols)
+    if reg_u8_cols:
+        x_u8 = _bitunpack_time(packed.X_reg_bits, packed.y.shape[-1])
     cols = [None] * r
     for i, j in enumerate(f32_cols):
         cols[j] = packed.X_reg[..., i]
     for i, j in enumerate(reg_u8_cols):
-        cols[j] = packed.X_reg_u8[..., i].astype(packed.y.dtype)
+        cols[j] = x_u8[..., i].astype(y.dtype)
     x_reg = (
         jnp.stack(cols, axis=-1) if cols
         else jnp.zeros(packed.y.shape + (0,), packed.y.dtype)
     )
     return FitData(
         t=t,
-        y=packed.y,
+        y=y,
         mask=mask,
         s=packed.s,
         cap=cap,

@@ -358,12 +358,13 @@ class ProphetModel:
         stall detector is the motivating consumer).
 
         Transfer path: shared-grid batches with an exact 0/1 mask run as
-        ONE packed-transfer program (design.PackedFitData — ~40% of the
-        bytes over the host<->device link, unpack fused into the fit);
-        segmented solves, per-series grids, and fractional masks keep the
-        plain FitData path.  ``reg_u8_cols`` pins which regressor columns
-        travel as uint8 (chunked callers must decide once per dataset —
-        see pack_fit_data).
+        ONE packed-transfer program (design.PackedFitData — the mask
+        travels folded into y as NaN, indicator regressors bit-packed,
+        unpack fused into the fit, ~1/3 of the plain bytes over the
+        host<->device link); segmented solves, per-series grids, and
+        fractional masks keep the plain FitData path.  ``reg_u8_cols``
+        pins which regressor columns travel bit-packed (chunked callers
+        must decide once per dataset — see pack_fit_data).
 
         ``max_iters_dynamic`` / ``gn_precond_dynamic`` / ``use_init_dynamic``:
         TRACED phase controls (see fit_core) letting a two-phase caller
