@@ -432,7 +432,7 @@ def fit_worker(args) -> int:
     # if virtually everything converges early, shallow out.  One adjustment
     # after chunk 0 keeps runs predictable.
     depth = {"v": args.phase1_iters if two_phase else args.max_iters,
-             "tuned": not two_phase}
+             "tuned": not two_phase or getattr(args, "no_phase1_tune", False)}
 
     def tune_depth(state, b_real):
         if depth["tuned"]:
@@ -1058,6 +1058,11 @@ def main() -> None:
                     help="lockstep depth of the main pass; unconverged "
                          "series are compacted into one full-depth "
                          "follow-up batch (0 = single-phase)")
+    ap.add_argument("--no-phase1-tune", action="store_true",
+                    help="pin phase-1 depth to --phase1-iters instead of "
+                         "adapting it from chunk 0's convergence (A/B "
+                         "instrument: the tuner deepens 12 -> 24 on the "
+                         "M5 shape and the payoff is under measurement)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for a quick pipeline check")
     ap.add_argument("--keep", action="store_true",
@@ -1087,7 +1092,8 @@ def main() -> None:
     scratch = os.path.join(
         "/tmp",
         f"tsbench_run_{args.series}x{args.days}_c{args.chunk}"
-        f"_p{args.phase1_iters}_{_code_fingerprint()}",
+        f"_p{args.phase1_iters}{'f' if args.no_phase1_tune else ''}"
+        f"_{_code_fingerprint()}",
     )
     args._out_dir = os.path.join(scratch, "out")
     resumed = os.path.isdir(args._out_dir) and bool(
@@ -1318,7 +1324,8 @@ def main() -> None:
             "--segment", str(args.segment),
             "--series", str(args.series),
             "--phase1-iters", str(args.phase1_iters),
-        ], timeout=budget, progress_timeout=90.0)
+        ] + (["--no-phase1-tune"] if args.no_phase1_tune else []),
+            timeout=budget, progress_timeout=90.0)
         if rc == 0:
             continue  # re-scan; loop exits when nothing is missing
         state["retries"] += 1
@@ -1385,6 +1392,7 @@ if __name__ == "__main__":
         ap.add_argument("--segment", type=int, default=24)
         ap.add_argument("--series", type=int, default=0)
         ap.add_argument("--phase1-iters", type=int, default=0)
+        ap.add_argument("--no-phase1-tune", action="store_true")
         ap.add_argument("--n-eval", type=int, default=512)
         ap.add_argument("--max-ahead", type=int, default=6)
         a = ap.parse_args()
