@@ -94,3 +94,69 @@ def test_global_batch_feeds_sharded_fit():
     np.testing.assert_allclose(
         np.asarray(res.f), np.asarray(ref.f), rtol=2e-3, atol=2e-3
     )
+
+
+def test_tpu_backend_mesh_routing():
+    """TpuBackend(mesh=...) routes fits through the sharded program and
+    lands on results equivalent to the unsharded backend — the public
+    multi-chip path (collect -> shard -> fit -> scatter) behind the same
+    fit signature."""
+    from tsspark_tpu.backends.tpu import TpuBackend
+
+    rng = np.random.default_rng(7)
+    n, t_len = 11, 200
+    ds = np.arange(t_len, dtype=np.float64) + 19000.0
+    y = (
+        5.0 + 0.02 * np.arange(t_len) + np.sin(2 * np.pi * np.arange(t_len) / 7.0)
+        + rng.normal(0, 0.15, (n, t_len))
+    )
+    m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
+    plain = TpuBackend(CFG, SOLVER).fit(ds, y)
+    # Routing proof: the mesh fit must actually go through fit_sharded
+    # (results alone can't tell — the single-device fit is the oracle).
+    calls = []
+    orig = sharding.fit_sharded
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    sharding.fit_sharded = counting
+    try:
+        shard = TpuBackend(CFG, SOLVER, mesh=m).fit(ds, y)
+    finally:
+        sharding.fit_sharded = orig
+    assert calls, "mesh fit did not route through sharding.fit_sharded"
+    assert np.asarray(shard.theta).shape == np.asarray(plain.theta).shape
+    # Same optimum quality: one-sided loss comparison at f32 tolerance
+    # (the sharded trajectory may differ in reduction order).
+    scale = np.maximum(np.abs(np.asarray(plain.loss)), 1.0)
+    assert float(np.max(
+        (np.asarray(shard.loss) - np.asarray(plain.loss)) / scale
+    )) < 2e-3
+    # Scaling meta rides through for predict.
+    np.testing.assert_allclose(
+        np.asarray(shard.meta.y_scale), np.asarray(plain.meta.y_scale)
+    )
+
+
+def test_forecaster_mesh_end_to_end():
+    """Forecaster(backend='tpu', mesh=...) — DataFrame in, sharded fit,
+    forecast out."""
+    import pandas as pd
+
+    import tsspark_tpu as tt
+
+    rng = np.random.default_rng(1)
+    n = 240
+    ds = pd.date_range("2023-01-01", periods=n, freq="D")
+    rows = []
+    for sid in range(5):
+        y = 5 + sid + 0.01 * np.arange(n) + rng.normal(0, 0.1, n)
+        rows.append(pd.DataFrame({"series_id": f"s{sid}", "ds": ds, "y": y}))
+    df = pd.concat(rows, ignore_index=True)
+    m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
+    f = tt.Forecaster(CFG, backend="tpu", mesh=m).fit(df)
+    fc = f.predict(horizon=7)
+    assert np.isfinite(fc["yhat"].to_numpy()).all()
+    assert len(fc) == 5 * 7

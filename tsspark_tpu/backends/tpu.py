@@ -82,6 +82,7 @@ class TpuBackend(ForecastBackend):
                  iter_segment: Optional[int] = None, on_segment=None,
                  length_buckets: Optional[int] = None,
                  rescue: bool = True,
+                 mesh=None, shard_config=None,
                  **kwargs):
         """chunk_size bounds series per program; iter_segment bounds solver
         iterations per program.
@@ -114,13 +115,33 @@ class TpuBackend(ForecastBackend):
         those suspects (warm-started from their stuck point AND fresh from
         the ridge init) and keeps each series' best loss, original
         included — so the pass can only improve.  Disabled internally for
-        phase-1 / straggler sub-backends (fit_twophase owns that flow)."""
+        phase-1 / straggler sub-backends (fit_twophase owns that flow).
+
+        ``mesh``: a ``jax.sharding.Mesh`` routes every chunk's solve
+        through the sharded program (parallel.sharding.fit_sharded —
+        series-axis data parallelism plus optional time-axis sequence
+        parallelism per ``shard_config``) instead of the single-device
+        program.  This is the multi-chip path: collect -> shard -> fit ->
+        scatter (BASELINE.json:5) behind the same ``fit`` signature.
+        Incompatible with ``iter_segment`` (the sharded solve runs as one
+        program; segmenting it is not implemented — raise rather than
+        silently ignore the bounded-dispatch contract).  ``on_segment``
+        still fires once per chunk solve.
+        ``shard_config``: a ShardingConfig; defaults to axis names taken
+        from the mesh (series first, optional time second)."""
         super().__init__(*args, **kwargs)
+        if mesh is not None and iter_segment:
+            raise ValueError(
+                "TpuBackend(mesh=...) does not support iter_segment: the "
+                "sharded solve runs as one XLA program"
+            )
         self.chunk_size = chunk_size
         self.iter_segment = iter_segment
         self.on_segment = on_segment  # liveness hook, fires per dispatch
         self.length_buckets = length_buckets
         self.rescue = rescue
+        self.mesh = mesh
+        self.shard_config = shard_config
         self._model = ProphetModel(self.config, self.solver_config)
 
     def _plan_length_buckets(self, y, mask):
@@ -201,8 +222,10 @@ class TpuBackend(ForecastBackend):
         )
         # Indicator-column split decided ONCE here so the main fit and the
         # rescue pass share it (it is a static argument of the jitted fit
-        # and an O(B*T*R) host scan — see _fit_main).
-        if reg_u8_cols is None and regressors is not None and not segmented:
+        # and an O(B*T*R) host scan — see _fit_main).  Segmented and
+        # mesh-sharded solves never reach the packed path, so skip it.
+        if (reg_u8_cols is None and regressors is not None
+                and not segmented and self.mesh is None):
             reg_u8_cols = _indicator_reg_cols(np.asarray(regressors))
         # One full-batch out-of-span changepoint warning instead of a copy
         # per chunk with chunk-local counts (ADVICE r3).
@@ -250,6 +273,7 @@ class TpuBackend(ForecastBackend):
             dataclasses.replace(self.solver_config, precond="gn_diag"),
             chunk_size=self.chunk_size, iter_segment=self.iter_segment,
             on_segment=self.on_segment, length_buckets=1, rescue=False,
+            mesh=self.mesh, shard_config=self.shard_config,
         )
         y = np.asarray(y)
         r = lambda a: None if a is None else np.asarray(a)[idx]
@@ -306,7 +330,8 @@ class TpuBackend(ForecastBackend):
             self.iter_segment
             and self.iter_segment < self.solver_config.max_iters
         )
-        if u8 is None and regressors is not None and not segmented:
+        if (u8 is None and regressors is not None and not segmented
+                and self.mesh is None):
             u8 = _indicator_reg_cols(np.asarray(regressors))
         dyn = dict(
             max_iters_dynamic=max_iters_dynamic,
@@ -328,6 +353,7 @@ class TpuBackend(ForecastBackend):
                     on_segment=self.on_segment,
                     length_buckets=1,
                     rescue=False,  # the top-level fit rescues the whole batch
+                    mesh=self.mesh, shard_config=self.shard_config,
                 )
                 states = []
                 for idx, lo_t, hi_t in plan:
@@ -399,6 +425,12 @@ class TpuBackend(ForecastBackend):
                 conditions = {
                     k: _pad_batch(v, c) for k, v in conditions.items()
                 }
+        if self.mesh is not None:
+            state = self._fit_sharded_chunk(
+                ds, y, mask, cap, floor, regressors, init, conditions,
+                dyn,
+            )
+            return _slice_state(state, 0, b)
         state = self._model.fit(
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
             init=init, iter_segment=self.iter_segment,
@@ -406,6 +438,66 @@ class TpuBackend(ForecastBackend):
             reg_u8_cols=reg_u8_cols, **(dyn or {}),
         )
         return _slice_state(state, 0, b)
+
+    def _fit_sharded_chunk(self, ds, y, mask, cap, floor, regressors,
+                           init, conditions, dyn=None):
+        """One padded chunk through the multi-chip sharded program.
+
+        The traced phase controls (dyn) are folded into an equivalent
+        static solver — same normalization as ProphetModel.fit's
+        non-packable fallback; the one-compiled-program-for-both-phases
+        trick is a single-device transfer optimization the mesh path does
+        not need (its inputs are sharded across devices, not re-shipped
+        per phase)."""
+        from tsspark_tpu.config import ShardingConfig
+        from tsspark_tpu.parallel import sharding as sharding_mod
+
+        solver = self.solver_config
+        theta0 = init
+        d = dyn or {}
+        if any(v is not None for v in d.values()):
+            # Partial controls get the same normalization ProphetModel.fit
+            # applies: missing depth = the solver's own cap, missing metric
+            # flag = resolved_precond, missing init flag = honor init.
+            mi = d.get("max_iters_dynamic")
+            gp = d.get("gn_precond_dynamic")
+            ui = d.get("use_init_dynamic")
+            solver = dataclasses.replace(
+                solver,
+                max_iters=solver.max_iters if mi is None else int(mi),
+                precond=(
+                    solver.resolved_precond(self.config.growth)
+                    if gp is None else ("gn_diag" if bool(gp) else "none")
+                ),
+            )
+            if ui is not None and not bool(ui):
+                theta0 = None
+        data, meta = self._model.prepare(
+            ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
+            conditions=conditions,
+        )
+        if self.shard_config is not None:
+            shard_cfg = self.shard_config
+        else:
+            # Default layout takes the axis NAMES from the mesh itself so
+            # custom-named meshes work without a matching ShardingConfig.
+            names = self.mesh.axis_names
+            shard_cfg = ShardingConfig(
+                series_axis=names[0],
+                time_axis=names[1] if len(names) > 1 else None,
+            )
+        res = sharding_mod.fit_sharded(
+            data,
+            None if theta0 is None else jnp.asarray(theta0),
+            self.config, solver, self.mesh, shard_cfg,
+        )
+        if self.on_segment is not None:
+            self.on_segment()
+        return FitState(
+            theta=res.theta, meta=meta, loss=res.f,
+            grad_norm=res.grad_norm, converged=res.converged,
+            n_iters=res.n_iters, status=res.status,
+        )
 
     def fit_twophase(self, ds, y, mask=None, cap=None, floor=None,
                      regressors=None, init=None, conditions=None,
@@ -434,15 +526,16 @@ class TpuBackend(ForecastBackend):
         # a continuous column could coincidentally look binary and flip the
         # jit-static u8 split — decide once on the full batch and thread
         # the decision through every phase (and the multi-start refits).
-        # Segmented solves never reach the packed path, so skip the
-        # O(B*T*R) host scan there (ADVICE r3).
+        # Segmented and mesh-sharded solves never reach the packed path,
+        # so skip the O(B*T*R) host scan there (ADVICE r3).
         segmented_2p = bool(
             self.iter_segment
             and self.iter_segment < self.solver_config.max_iters
         )
         u8 = (
             _indicator_reg_cols(np.asarray(regressors))
-            if regressors is not None and not segmented_2p else None
+            if (regressors is not None and not segmented_2p
+                and self.mesh is None) else None
         )
         if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
             phase1_state = self._phase1(phase1_iters).fit(
@@ -532,6 +625,7 @@ class TpuBackend(ForecastBackend):
             on_segment=self.on_segment,
             length_buckets=1,
             rescue=False,
+            mesh=self.mesh, shard_config=self.shard_config,
         )
 
     def _phase1(self, phase1_iters: int) -> "TpuBackend":
