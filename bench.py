@@ -72,7 +72,7 @@ RESERVE_S = 150.0
 # policy, data handling).  Orchestration-only changes (probing, retries,
 # logging) must NOT bump it: the whole point of the numerics-scoped
 # fingerprint below is that resume state survives them.
-BENCH_NUMERICS_REV = 5
+BENCH_NUMERICS_REV = 6
 
 
 def _code_fingerprint() -> str:
@@ -427,10 +427,15 @@ def fit_worker(args) -> int:
             todo.append((lo, hi))
     prefetch_depth = 3
     # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
-    # program, so it can change per chunk for free.  If the first chunk
-    # leaves too many stragglers (phase 2 cost scales with them), deepen;
-    # if virtually everything converges early, shallow out.  One adjustment
-    # after chunk 0 keeps runs predictable.
+    # program, so it can change per chunk for free.  One adjustment after
+    # chunk 0 keeps runs predictable.  The deepen branch fires only on a
+    # PATHOLOGICAL first chunk (a quarter still progressing): measured on
+    # the M5 shape, the unconverged set is depth-FLAT (124/122/122/120/114
+    # stragglers per 1024 at depths 8/12/16/24/32) — it is the
+    # ill-conditioned tail that needs phase 2's GN metric, not more plain
+    # lockstep iterations, so the old 3% trigger doubled every chunk's
+    # device time for ~2 rescued series per 1024.  If virtually everything
+    # converges early, shallow out.
     depth = {"v": args.phase1_iters if two_phase else args.max_iters,
              "tuned": not two_phase or getattr(args, "no_phase1_tune", False)}
 
@@ -441,7 +446,7 @@ def fit_worker(args) -> int:
         frac_unconv = float(
             (~np.asarray(state.converged)[:b_real]).mean()
         )
-        if frac_unconv > 0.03:
+        if frac_unconv > 0.25:
             depth["v"] = min(int(depth["v"]) * 2, args.max_iters)
         elif frac_unconv < 0.005 and depth["v"] > 8:
             depth["v"] = max(8, int(depth["v"]) * 2 // 3)
