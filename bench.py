@@ -557,7 +557,7 @@ def fit_worker(args) -> int:
         return 0
 
     t0 = time.time()
-    straggler_idx, straggler_theta = [], []
+    straggler_idx, straggler_theta, straggler_gn = [], [], []
     files = {}
     for lo, hi in done:
         f = os.path.join(args.out, f"chunk_{lo:06d}_{hi:06d}.npz")
@@ -576,9 +576,16 @@ def fit_worker(args) -> int:
         bad = np.flatnonzero(~z["converged"])
         straggler_idx.extend(int(lo + i) for i in bad)
         straggler_theta.append(z["theta"][bad])
+        straggler_gn.append(z["grad_norm"][bad])
     if straggler_idx:
         heartbeat()  # phase 2 starts: reset the stall clock
         idx = np.asarray(straggler_idx)
+        # Difficulty-sorted compaction (see backends.tpu.difficulty_order;
+        # the chunk-file patch below indexes by idx, so order is free).
+        from tsspark_tpu.backends.tpu import difficulty_order
+        order = difficulty_order(np.concatenate(straggler_gn))
+        idx = idx[order]
+        theta_cat = np.concatenate(straggler_theta, axis=0)[order]
         # Stragglers get the GN-diagonal initial metric (ill-conditioned
         # tail; see SolverConfig.precond) and the full solve depth, through
         # THE SAME compiled program as phase 1: the batch is padded to the
@@ -593,9 +600,7 @@ def fit_worker(args) -> int:
         y_s = pad_rows(np.ascontiguousarray(y[idx], np.float32))
         m_s = pad_rows(np.ascontiguousarray(mask[idx], np.float32))
         r_s = pad_rows(np.ascontiguousarray(reg[idx], np.float32))
-        init_s = pad_rows(
-            np.concatenate(straggler_theta, axis=0).astype(np.float32)
-        )
+        init_s = pad_rows(theta_cat.astype(np.float32))
         if segmented:
             # Bounded-dispatch mode: phase 2 keeps --segment's short
             # per-segment dispatches (the reason segmented mode exists),

@@ -561,6 +561,7 @@ class TpuBackend(ForecastBackend):
         idx = np.flatnonzero(~np.asarray(state.converged))
         if idx.size == 0:
             return state
+        idx = idx[difficulty_order(np.asarray(state.grad_norm)[idx])]
         b = np.asarray(y).shape[0]
         c = min(self.chunk_size, _next_pow2(b))
         pad = (-idx.size) % c
@@ -756,6 +757,17 @@ class TpuBackend(ForecastBackend):
             k: np.concatenate([o[k] for o in outs], axis=0)
             for k in outs[0]
         }
+
+
+def difficulty_order(grad_norm: np.ndarray) -> np.ndarray:
+    """Argsort for compacting stragglers, hardest first.
+
+    Each padded sub-chunk's lockstep solve runs until ITS slowest member
+    converges, so grouping similar-difficulty series lets easy sub-chunks
+    exit early instead of every sub-chunk paying for one deep series.
+    Phase-1 exit grad-norm is the difficulty proxy.  Callers patch results
+    back by index, so the reorder never changes results."""
+    return np.argsort(-np.asarray(grad_norm), kind="stable")
 
 
 def patch_state(state: FitState, idx: np.ndarray, sub: FitState) -> FitState:
