@@ -488,6 +488,13 @@ def fit_worker(args) -> int:
         save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1)
         return state
 
+    # Device-resident chunk payloads: phase 1 keeps every uploaded packed
+    # payload alive on device (~16.6 MB x 30 chunks = ~500 MB HBM) so
+    # phase 2 can gather its straggler rows ON DEVICE instead of
+    # re-prepping and re-uploading them over the serial tunnel.  Falls
+    # back to the host path whenever coverage is partial (resume,
+    # chunk-halving retries).
+    resident = {}
     with ThreadPoolExecutor(max_workers=2) as pool, \
             ThreadPoolExecutor(max_workers=1) as writer:
         write_futs = []
@@ -530,6 +537,12 @@ def fit_worker(args) -> int:
                 )
                 jax.block_until_ready(theta)
                 heartbeat()
+                if two_phase and not os.environ.get("BENCH_NO_RESIDENT"):
+                    # Real [lo, hi) recorded: rows past hi - lo are inert
+                    # padding that phase 2 must never gather (a padding
+                    # row "converges" instantly and would silently patch
+                    # garbage into a real series' slot).
+                    resident[lo] = (hi, payload)
                 t_dev = time.time() - t1
                 fit_s = time.time() - t0
                 if not depth["tuned"]:
@@ -577,6 +590,7 @@ def fit_worker(args) -> int:
         straggler_idx.extend(int(lo + i) for i in bad)
         straggler_theta.append(z["theta"][bad])
         straggler_gn.append(z["grad_norm"][bad])
+    phase2_mode = "none"
     if straggler_idx:
         heartbeat()  # phase 2 starts: reset the stall clock
         idx = np.asarray(straggler_idx)
@@ -597,11 +611,21 @@ def fit_worker(args) -> int:
         pad_rows = lambda a: np.concatenate(
             [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
         ) if pad else a
-        y_s = pad_rows(np.ascontiguousarray(y[idx], np.float32))
-        m_s = pad_rows(np.ascontiguousarray(mask[idx], np.float32))
-        r_s = pad_rows(np.ascontiguousarray(reg[idx], np.float32))
-        init_s = pad_rows(theta_cat.astype(np.float32))
+
+        def host_gather():
+            """(y, mask, reg, init) rows for the host-side phase-2 paths
+            (~45 MB of copies the device-resident path never makes)."""
+            return (
+                pad_rows(np.ascontiguousarray(y[idx], np.float32)),
+                pad_rows(np.ascontiguousarray(mask[idx], np.float32)),
+                pad_rows(np.ascontiguousarray(reg[idx], np.float32)),
+                pad_rows(theta_cat.astype(np.float32)),
+            )
+
         if segmented:
+            phase2_mode = "segmented"
+            resident.clear()  # free retained device payloads, if any
+            y_s, m_s, r_s, init_s = host_gather()
             # Bounded-dispatch mode: phase 2 keeps --segment's short
             # per-segment dispatches (the reason segmented mode exists),
             # via the static straggler backend.
@@ -610,10 +634,114 @@ def fit_worker(args) -> int:
             )
             state2 = jax.tree.map(lambda a: np.asarray(a)[:n_s], state2)
             jax.block_until_ready(jax.tree.leaves(state2)[0])
+        elif resident and all(
+            any(l2 <= int(g) < h2 for l2, (h2, _) in resident.items())
+            for g in idx
+        ):
+            phase2_mode = "resident"
+            # Device-resident gather: every straggler's chunk payload is
+            # still on device from phase 1, so the deep refit gathers its
+            # rows there — per sub-chunk the tunnel carries only a (c,)
+            # index vector and a (c, P) warm-start instead of a ~16 MB
+            # re-packed payload, and no host re-prep runs at all.  Only
+            # the ~n_s straggler rows are ever concatenated (per-chunk
+            # takes first, each chunk freed as it is consumed), so peak
+            # HBM stays near phase-1 levels.
+            import jax.numpy as jnp
+
+            from tsspark_tpu.models.prophet.design import (
+                PACKED_PER_SERIES_FIELDS,
+            )
+
+            def map_batch(p, fn):
+                upd = {
+                    k: fn(getattr(p, k)) for k in PACKED_PER_SERIES_FIELDS
+                }
+                if p.X_season.ndim == 3:  # per-series (conditional seas.)
+                    upd["X_season"] = fn(p.X_season)
+                return p._replace(**upd)
+
+            smalls, grouped = [], []
+            for l2 in sorted(resident):
+                h2, payload2 = resident[l2]
+                sel = idx[(idx >= l2) & (idx < h2)]
+                if sel.size:
+                    local = jnp.asarray((sel - l2).astype(np.int32))
+                    smalls.append(map_batch(
+                        payload2,
+                        lambda a: jnp.take(a, local, axis=0),
+                    ))
+                    grouped.extend(int(g) for g in sel)
+                del resident[l2]
+            strag = smalls[0]._replace(**{
+                k: jnp.concatenate(
+                    [getattr(s, k) for s in smalls], axis=0
+                ) for k in PACKED_PER_SERIES_FIELDS
+            })
+            del smalls
+            pos_of = {g: i for i, g in enumerate(grouped)}
+            row_idx = np.asarray(
+                [pos_of[int(g)] for g in idx], np.int32
+            )
+
+            def gather_fit(ix, th):
+                # Eager device-side row gathers (a few small dispatches),
+                # then THE SAME compiled fit program as phase 1 — the
+                # gathered payload has phase 1's exact shapes/dtypes, so
+                # no new executable is ever compiled for phase 2.
+                packed_g = map_batch(
+                    strag, lambda a: jnp.take(a, ix, axis=0)
+                )
+                return fit_core_packed(
+                    packed_g, th, model.config, model.solver_config,
+                    reg_u8_cols=u8_cols,
+                    max_iters_dynamic=np.int32(args.max_iters),
+                    gn_precond_dynamic=np.bool_(True),
+                    use_theta0_dynamic=np.bool_(True),
+                )
+            th_parts, st_parts = [], []
+            for lo2 in range(0, n_s, args.chunk):
+                hi2 = min(lo2 + args.chunk, n_s)
+                ix = row_idx[lo2:hi2]
+                th = theta_cat[lo2:hi2].astype(np.float32)
+                if hi2 - lo2 < args.chunk:
+                    # Pad by repeating the first row: a duplicate of a row
+                    # already being solved adds no lockstep depth (unlike
+                    # arbitrary data) and its result is sliced away.
+                    rep = args.chunk - (hi2 - lo2)
+                    ix = np.concatenate([ix, np.repeat(ix[:1], rep)])
+                    th = np.concatenate(
+                        [th, np.repeat(th[:1], rep, axis=0)]
+                    )
+                th2, st2 = gather_fit(jnp.asarray(ix), jnp.asarray(th))
+                jax.block_until_ready(th2)
+                heartbeat()
+                th_parts.append(np.asarray(th2)[:hi2 - lo2])
+                st_parts.append(np.asarray(st2)[:, :hi2 - lo2])
+            del strag
+            # Scaling meta for the straggler rows comes from the chunk
+            # files — it is deterministic per series, so these are the
+            # exact values a host re-prep would recompute.
+            meta_full = {
+                k: np.concatenate([files[rng_][k] for rng_ in done])
+                for k in ("y_scale", "floor", "ds_start", "ds_span",
+                          "reg_mean", "reg_std", "changepoints")
+            }
+            state2 = fitstate_from_packed(
+                np.concatenate(th_parts, axis=0),
+                np.concatenate(st_parts, axis=1),
+                ScalingMeta(**{k: v[idx] for k, v in meta_full.items()}),
+            )
         else:
             # Straggler sub-chunk prep (numpy design build + packing,
             # ~1 s each) prefetched on threads so it overlaps the deep
             # device solves, same pattern as the phase-1 loop.
+            phase2_mode = "host"
+            # Partial-coverage fallback: the retained payloads (~500 MB
+            # HBM) serve no purpose here — release them before the deep
+            # solves raise peak memory.
+            resident.clear()
+            y_s, m_s, r_s, init_s = host_gather()
             lows = list(range(0, n_s + pad, args.chunk))
 
             def prep2(lo2):
@@ -688,6 +816,7 @@ def fit_worker(args) -> int:
         fh.write(json.dumps({
             "phase2_s": round(time.time() - t0, 3),
             "stragglers": len(straggler_idx),
+            "phase2_mode": phase2_mode,
         }) + "\n")
     with open(marker, "w") as fh:
         fh.write("ok\n")

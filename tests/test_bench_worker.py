@@ -120,3 +120,46 @@ def test_prep_worker_cache_matches_inline_prep(tmp_path):
     # A second prep run is a no-op (file exists), and a chunk file
     # supersedes the prep cache.
     assert bench.prep_worker(args) == 0
+
+
+def test_phase2_resident_matches_host_path(tmp_path, monkeypatch):
+    """The device-resident phase-2 gather and the host re-prep path must
+    produce equivalent straggler refits: same convergence/status and
+    thetas equal to f32 solver tolerance (the gathered payload is
+    bit-identical to a re-packed one; only dispatch mechanics differ)."""
+    (tmp_path / "resident").mkdir()
+    (tmp_path / "host").mkdir()
+    args_r = _args(tmp_path / "resident", series=96, days=128, chunk=32,
+                   phase1=6)
+    args_h = _args(tmp_path / "host", series=96, days=128, chunk=32,
+                   phase1=6)
+    # Non-segmented mode: the resident path only exists there.
+    args_r.segment = 0
+    args_h.segment = 0
+    monkeypatch.delenv("BENCH_NO_RESIDENT", raising=False)
+    assert bench.fit_worker(args_r) == 0
+    monkeypatch.setenv("BENCH_NO_RESIDENT", "1")
+    assert bench.fit_worker(args_h) == 0
+
+    def mode(out):
+        with open(os.path.join(out, "times.jsonl")) as fh:
+            rows = [json.loads(l) for l in fh if l.strip()]
+        return next(t["phase2_mode"] for t in rows if "phase2_s" in t)
+
+    assert mode(args_r.out) == "resident"
+    assert mode(args_h.out) == "host"
+    fr = sorted(glob.glob(os.path.join(args_r.out, "chunk_*.npz")))
+    fh_ = sorted(glob.glob(os.path.join(args_h.out, "chunk_*.npz")))
+    assert len(fr) == len(fh_) == 3
+    for a, b in zip(fr, fh_):
+        za, zb = np.load(a), np.load(b)
+        assert za["phase2"] == 1 and zb["phase2"] == 1
+        np.testing.assert_array_equal(za["status"], zb["status"])
+        np.testing.assert_array_equal(za["converged"], zb["converged"])
+        # Same data, same warm start, same program semantics: thetas agree
+        # to f32 noise.
+        np.testing.assert_allclose(
+            za["theta"], zb["theta"], rtol=2e-4, atol=2e-4
+        )
+        for k in ("y_scale", "ds_start", "ds_span"):
+            np.testing.assert_array_equal(za[k], zb[k])

@@ -105,6 +105,18 @@ class PackedFitData(NamedTuple):
     mult_mask: jnp.ndarray
 
 
+# PackedFitData fields that ALWAYS carry a leading per-series batch axis —
+# the fields a row gather/concat over series must touch.  X_season is NOT
+# here: it is (T, Fs) shared for plain seasonalities but (B, T, Fs) when
+# conditional seasonalities make it per-series — consumers must branch on
+# its ndim.  Kept next to the NamedTuple so a new per-series field gets
+# added here in the same change (consumers: bench.py's device-resident
+# phase-2 gather).
+PACKED_PER_SERIES_FIELDS = (
+    "y", "t_off", "t_inv_span", "s", "cap", "X_reg", "X_reg_bits",
+)
+
+
 def _bitpack_time(a: np.ndarray) -> np.ndarray:
     """(B, T, K) exact-0/1 array -> (B, ceil(T/8), K) uint8, little-endian
     bits along the time axis (host side, numpy)."""
