@@ -624,7 +624,6 @@ def fit_worker(args) -> int:
 
         if segmented:
             phase2_mode = "segmented"
-            resident.clear()  # free retained device payloads, if any
             y_s, m_s, r_s, init_s = host_gather()
             # Bounded-dispatch mode: phase 2 keeps --segment's short
             # per-segment dispatches (the reason segmented mode exists),
@@ -661,7 +660,7 @@ def fit_worker(args) -> int:
                     upd["X_season"] = fn(p.X_season)
                 return p._replace(**upd)
 
-            smalls, grouped = [], []
+            smalls, grouped, gather_ranges = [], [], []
             for l2 in sorted(resident):
                 h2, payload2 = resident[l2]
                 sel = idx[(idx >= l2) & (idx < h2)]
@@ -672,11 +671,15 @@ def fit_worker(args) -> int:
                         lambda a: jnp.take(a, local, axis=0),
                     ))
                     grouped.extend(int(g) for g in sel)
+                    gather_ranges.append((l2, h2))
                 del resident[l2]
+            cat_fields = PACKED_PER_SERIES_FIELDS + (
+                ("X_season",) if smalls[0].X_season.ndim == 3 else ()
+            )
             strag = smalls[0]._replace(**{
                 k: jnp.concatenate(
                     [getattr(s, k) for s in smalls], axis=0
-                ) for k in PACKED_PER_SERIES_FIELDS
+                ) for k in cat_fields
             })
             del smalls
             pos_of = {g: i for i, g in enumerate(grouped)}
@@ -720,17 +723,26 @@ def fit_worker(args) -> int:
                 st_parts.append(np.asarray(st2)[:, :hi2 - lo2])
             del strag
             # Scaling meta for the straggler rows comes from the chunk
-            # files — it is deterministic per series, so these are the
-            # exact values a host re-prep would recompute.
-            meta_full = {
-                k: np.concatenate([files[rng_][k] for rng_ in done])
-                for k in ("y_scale", "floor", "ds_start", "ds_span",
-                          "reg_mean", "reg_std", "changepoints")
+            # files — deterministic per series, so these are the exact
+            # values a host re-prep would recompute.  Rows are selected
+            # inside each file via its own (lo, hi) (no full-dataset
+            # concatenation, no positional-alignment assumption), in
+            # grouped order, then mapped back to difficulty order with
+            # the same row_idx the solves used.
+            meta_keys = ("y_scale", "floor", "ds_start", "ds_span",
+                         "reg_mean", "reg_std", "changepoints")
+            meta_grouped = {
+                k: np.concatenate([
+                    files[(l2, h2)][k][idx[(idx >= l2) & (idx < h2)] - l2]
+                    for (l2, h2) in gather_ranges
+                ]) for k in meta_keys
             }
             state2 = fitstate_from_packed(
                 np.concatenate(th_parts, axis=0),
                 np.concatenate(st_parts, axis=1),
-                ScalingMeta(**{k: v[idx] for k, v in meta_full.items()}),
+                ScalingMeta(**{
+                    k: v[row_idx[:n_s]] for k, v in meta_grouped.items()
+                }),
             )
         else:
             # Straggler sub-chunk prep (numpy design build + packing,

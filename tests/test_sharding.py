@@ -160,3 +160,35 @@ def test_forecaster_mesh_end_to_end():
     fc = f.predict(horizon=7)
     assert np.isfinite(fc["yhat"].to_numpy()).all()
     assert len(fc) == 5 * 7
+
+
+def test_mesh_with_length_bucketing():
+    """mesh= and length_buckets compose: bucket sub-fits inherit the mesh
+    (sliced time windows through the sharded program) and match the
+    unsharded bucketed fit."""
+    from tsspark_tpu.backends.tpu import TpuBackend
+
+    rng = np.random.default_rng(11)
+    n, t_len = 48, 512
+    ds = np.arange(t_len, dtype=np.float64) + 19000.0
+    y = (
+        4.0 + 0.01 * np.arange(t_len)
+        + np.sin(2 * np.pi * np.arange(t_len) / 7.0)
+        + rng.normal(0, 0.1, (n, t_len))
+    )
+    mask = np.ones((n, t_len), np.float32)
+    # Right-aligned ragged history: half the series observe only the last
+    # 160 steps -> the bucket planner slices their time window.
+    mask[: n // 2, : t_len - 160] = 0.0
+    y = np.where(mask > 0, y, 0.0)
+    m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
+    plain = TpuBackend(CFG, SOLVER, length_buckets=2).fit(ds, y, mask=mask)
+    shard = TpuBackend(CFG, SOLVER, length_buckets=2, mesh=m).fit(
+        ds, y, mask=mask
+    )
+    scale = np.maximum(np.abs(np.asarray(plain.loss)), 1.0)
+    worse = float(np.max(
+        (np.asarray(shard.loss) - np.asarray(plain.loss)) / scale
+    ))
+    assert worse < 2e-3, worse
+    assert np.isfinite(np.asarray(shard.theta)).all()
