@@ -510,7 +510,9 @@ def fit_worker(args) -> int:
             if nxt < len(todo):
                 futs[nxt] = pool.submit(prep, *todo[nxt])
             t1 = time.time()
-            payload = jax.tree.map(jax.device_put, payload)
+            # One device_put call for the whole pytree (not per-leaf
+            # tree.map): the runtime can batch the per-buffer dispatches.
+            payload = jax.device_put(payload)
             jax.block_until_ready(jax.tree.leaves(payload))
             t_put = time.time() - t1
             t1 = time.time()
