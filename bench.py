@@ -493,8 +493,15 @@ def fit_worker(args) -> int:
     # phase 2 can gather its straggler rows ON DEVICE instead of
     # re-prepping and re-uploading them over the serial tunnel.  Falls
     # back to the host path whenever coverage is partial (resume,
-    # chunk-halving retries).
+    # chunk-halving retries).  Retained bytes are CAPPED (ADVICE r4):
+    # HBM cost is linear in series count, so a much-larger-than-M5 run
+    # would otherwise OOM phase 1; past the budget we stop inserting and
+    # the partial-coverage check routes phase 2 to the host path.
     resident = {}
+    resident_bytes = 0
+    resident_budget = int(
+        os.environ.get("BENCH_RESIDENT_MB", "4096")
+    ) * (1 << 20)
     with ThreadPoolExecutor(max_workers=2) as pool, \
             ThreadPoolExecutor(max_workers=1) as writer:
         write_futs = []
@@ -544,7 +551,12 @@ def fit_worker(args) -> int:
                     # padding that phase 2 must never gather (a padding
                     # row "converges" instantly and would silently patch
                     # garbage into a real series' slot).
-                    resident[lo] = (hi, payload)
+                    nb = sum(
+                        a.nbytes for a in jax.tree.leaves(payload)
+                    )
+                    if resident_bytes + nb <= resident_budget:
+                        resident[lo] = (hi, payload)
+                        resident_bytes += nb
                 t_dev = time.time() - t1
                 fit_s = time.time() - t0
                 if not depth["tuned"]:

@@ -178,6 +178,18 @@ def test_tpu_twophase_matches_full_depth():
     assert two.status is not None
 
 
+def test_difficulty_order_nan_hardest():
+    """NaN grad norms (diverged series) must sort FIRST (hardest), not
+    last: argsort on raw values seats NaN rows in the easiest sub-chunk
+    and defeats similar-difficulty grouping (ADVICE r4)."""
+    from tsspark_tpu.backends.tpu import difficulty_order
+
+    g = np.array([1.0, np.nan, 50.0, 0.1, np.nan])
+    order = difficulty_order(g)
+    assert set(order[:2].tolist()) == {1, 4}  # NaN rows first (stable)
+    assert order[2:].tolist() == [2, 0, 3]  # then descending grad norm
+
+
 def test_cpu_backend_components():
     """components is part of the backend interface (base-class default)."""
     import numpy as np

@@ -202,6 +202,26 @@ def test_packed_fit_data_roundtrip(t_len):
         np.testing.assert_allclose(a, b_, atol=tol, err_msg=name)
 
 
+def test_pack_fit_data_rejects_nonfinite_observed_y():
+    """A NaN/inf cell with mask == 1 must fail loudly at pack time: the
+    NaN-fold transit recovers the mask as isfinite(y), so it would
+    silently reclassify the cell as missing while the plain FitData path
+    propagates the non-finite value into the loss (ADVICE r4)."""
+    from tsspark_tpu.models.prophet.design import pack_fit_data
+
+    cfg, ds, y, mask, reg = _mixed_batch()
+    data, meta = prepare_fit_data(
+        ds, y, cfg, mask=mask, regressors=reg, as_numpy=True
+    )
+    # Poke the pathological combination straight into the prepared batch:
+    # an OBSERVED cell whose value is non-finite.
+    y_bad = np.asarray(data.y).copy()
+    y_bad[1, 10] = np.nan
+    data = data._replace(y=y_bad)
+    with pytest.raises(ValueError, match="finite y"):
+        pack_fit_data(data, meta, ds, collapse_cap=True)
+
+
 def test_fit_core_packed_matches_plain():
     """The packed fit program lands on the same optima as the plain one
     (identical inputs up to 1 ulp of t -> same in-sample accuracy; exact

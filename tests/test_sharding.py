@@ -140,6 +140,40 @@ def test_tpu_backend_mesh_routing():
     )
 
 
+def test_mesh_axis_names_override_position():
+    """A user mesh declared ("time", "series") must not get the axes
+    swapped by the default ShardingConfig: conventional axis NAMES win
+    over position (ADVICE r4)."""
+    from tsspark_tpu.backends.tpu import TpuBackend
+
+    rng = np.random.default_rng(9)
+    n, t_len = 8, 200
+    ds = np.arange(t_len, dtype=np.float64) + 19000.0
+    y = 4.0 + 0.01 * np.arange(t_len) + rng.normal(0, 0.1, (n, t_len))
+    devs = np.array(jax.devices()).reshape(2, 4)
+    m = jax.sharding.Mesh(devs, ("time", "series"))
+    captured = {}
+    orig = sharding.fit_sharded
+
+    def capture(data, th, cfg, solver, mesh, shard_cfg, *a, **k):
+        captured["cfg"] = shard_cfg
+        return orig(data, th, cfg, solver, mesh, shard_cfg, *a, **k)
+
+    sharding.fit_sharded = capture
+    try:
+        TpuBackend(CFG, SOLVER, mesh=m).fit(ds, y)
+        assert captured["cfg"].series_axis == "series"
+        assert captured["cfg"].time_axis == "time"
+        # Symmetric case: only "time" is conventionally named — it must
+        # stay the time axis even when listed first.
+        m2 = jax.sharding.Mesh(devs, ("time", "batch"))
+        TpuBackend(CFG, SOLVER, mesh=m2).fit(ds, y)
+        assert captured["cfg"].series_axis == "batch"
+        assert captured["cfg"].time_axis == "time"
+    finally:
+        sharding.fit_sharded = orig
+
+
 def test_forecaster_mesh_end_to_end():
     """Forecaster(backend='tpu', mesh=...) — DataFrame in, sharded fit,
     forecast out."""

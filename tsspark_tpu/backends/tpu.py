@@ -481,10 +481,28 @@ class TpuBackend(ForecastBackend):
         else:
             # Default layout takes the axis NAMES from the mesh itself so
             # custom-named meshes work without a matching ShardingConfig.
+            # The conventional names win over position: a mesh declared
+            # ("time", "series") must not get its axes swapped just
+            # because "series" is not first (ADVICE r4).
             names = self.mesh.axis_names
+            if "series" in names:
+                series_ax = "series"
+                rest = [n for n in names if n != "series"]
+                time_ax = (
+                    "time" if "time" in rest
+                    else (rest[0] if rest else None)
+                )
+            elif "time" in names and len(names) > 1:
+                # Symmetric case: only "time" is conventionally named —
+                # it must stay the time axis even when listed first.
+                time_ax = "time"
+                series_ax = next(n for n in names if n != "time")
+            else:
+                series_ax = names[0]
+                time_ax = names[1] if len(names) > 1 else None
             shard_cfg = ShardingConfig(
-                series_axis=names[0],
-                time_axis=names[1] if len(names) > 1 else None,
+                series_axis=series_ax,
+                time_axis=time_ax,
             )
         res = sharding_mod.fit_sharded(
             data,
@@ -765,9 +783,14 @@ def difficulty_order(grad_norm: np.ndarray) -> np.ndarray:
     Each padded sub-chunk's lockstep solve runs until ITS slowest member
     converges, so grouping similar-difficulty series lets easy sub-chunks
     exit early instead of every sub-chunk paying for one deep series.
-    Phase-1 exit grad-norm is the difficulty proxy.  Callers patch results
-    back by index, so the reorder never changes results."""
-    return np.argsort(-np.asarray(grad_norm), kind="stable")
+    Phase-1 exit grad-norm is the difficulty proxy; NaN grad norms
+    (diverged series) count as hardest, not easiest — argsort would
+    otherwise sort NaN last and seat the most broken series in the
+    "easy" sub-chunk, inverting the grouping's intent.  Callers patch
+    results back by index, so the reorder never changes results."""
+    g = np.asarray(grad_norm, np.float64)
+    g = np.where(np.isnan(g), np.inf, g)
+    return np.argsort(-g, kind="stable")
 
 
 def patch_state(state: FitState, idx: np.ndarray, sub: FitState) -> FitState:

@@ -189,6 +189,15 @@ def pack_fit_data(
             "pack_fit_data requires an exact 0/1 mask; fractional "
             "observation weights need the plain FitData path"
         )
+    y_np = np.asarray(data.y)
+    if not np.all(np.isfinite(y_np[mask_np > 0])):
+        raise ValueError(
+            "pack_fit_data requires finite y wherever mask == 1: the "
+            "NaN-fold transit recovers the mask as isfinite(y), so a "
+            "non-finite OBSERVED cell would silently become masked on "
+            "device while the plain FitData path propagates it into the "
+            "loss"
+        )
     f32 = np.float32
     cap = np.asarray(data.cap)
     # Collapse is a STATIC (config-level) decision, not a data one: for

@@ -49,7 +49,14 @@ def data_shardings(
 ) -> FitData:
     """PartitionSpecs for each FitData leaf (shaped like the pytree)."""
     s_ax = config.series_axis
-    t_ax = mesh.axis_names[1] if len(mesh.axis_names) > 1 else None
+    # Time axis: the config's declared name wins; otherwise fall back to
+    # the first mesh axis that is NOT the series axis.  Taking
+    # axis_names[1] positionally put the SERIES axis on the time
+    # dimension for a mesh declared ("time", "series") (ADVICE r4).
+    t_ax = config.time_axis
+    if t_ax is None:
+        rest = [n for n in mesh.axis_names if n != s_ax]
+        t_ax = rest[0] if rest else None
     bt = P(s_ax, t_ax)
     return FitData(
         t=bt,
