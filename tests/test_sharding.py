@@ -112,21 +112,30 @@ def test_tpu_backend_mesh_routing():
     )
     m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
     plain = TpuBackend(CFG, SOLVER).fit(ds, y)
-    # Routing proof: the mesh fit must actually go through fit_sharded
-    # (results alone can't tell — the single-device fit is the oracle).
+    # Routing proof: the mesh fit must actually go through the sharded
+    # program — fit_sharded_packed for this packable batch, fit_sharded
+    # otherwise (results alone can't tell — the single-device fit is the
+    # oracle).
     calls = []
-    orig = sharding.fit_sharded
+    orig_u = sharding.fit_sharded
+    orig_p = sharding.fit_sharded_packed
 
-    def counting(*a, **k):
-        calls.append(1)
-        return orig(*a, **k)
+    def counting_u(*a, **k):
+        calls.append("plain")
+        return orig_u(*a, **k)
 
-    sharding.fit_sharded = counting
+    def counting_p(*a, **k):
+        calls.append("packed")
+        return orig_p(*a, **k)
+
+    sharding.fit_sharded = counting_u
+    sharding.fit_sharded_packed = counting_p
     try:
         shard = TpuBackend(CFG, SOLVER, mesh=m).fit(ds, y)
     finally:
-        sharding.fit_sharded = orig
-    assert calls, "mesh fit did not route through sharding.fit_sharded"
+        sharding.fit_sharded = orig_u
+        sharding.fit_sharded_packed = orig_p
+    assert calls, "mesh fit did not route through the sharded program"
     assert np.asarray(shard.theta).shape == np.asarray(plain.theta).shape
     # Same optimum quality: one-sided loss comparison at f32 tolerance
     # (the sharded trajectory may differ in reduction order).
@@ -211,6 +220,139 @@ def test_time_sharded_converged_loss_parity_long_series():
     assert d_worse < 5e-5, d_worse
 
 
+def test_packed_unpack_bit_identical_under_mesh():
+    """The packed transit is LOSSLESS under a mesh: unpacking the sharded
+    PackedFitData reproduces the single-device unpack bit-for-bit (every
+    unpack op is elementwise/broadcast, so partitioning cannot change a
+    single value — the whole multi-chip packed-feed story rests on this)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tsspark_tpu.models.prophet.design import (
+        pack_fit_data,
+        unpack_fit_data,
+    )
+
+    ds, y = _trend_sine_batch(b=8, t_len=256, seed=6)
+    mask = np.ones_like(y)
+    mask[0, 200:] = 0.0
+    data, meta = prepare_fit_data(
+        jnp.asarray(ds), jnp.asarray(y), CFG, mask=jnp.asarray(mask),
+        as_numpy=True,
+    )
+    packed, u8 = pack_fit_data(data, meta, ds, collapse_cap=True)
+    ref = jax.jit(
+        unpack_fit_data, static_argnames=("reg_u8_cols",)
+    )(jax.tree.map(jnp.asarray, packed), reg_u8_cols=u8)
+
+    m = mesh_mod.make_mesh(n_series_shards=4, n_time_shards=2)
+    scfg = ShardingConfig(time_axis="time")
+    pspecs = sharding.packed_shardings(m, packed, scfg)
+    packed_sh = jax.device_put(packed, jax.tree.map(
+        lambda sp: NamedSharding(m, sp), pspecs,
+        is_leaf=lambda x: isinstance(x, P),
+    ))
+    un = jax.jit(
+        unpack_fit_data, static_argnames=("reg_u8_cols",)
+    )(packed_sh, reg_u8_cols=u8)
+    for name in ref._fields:
+        a = np.asarray(getattr(ref, name))
+        b_ = np.asarray(getattr(un, name))
+        np.testing.assert_array_equal(a, b_, err_msg=name)
+
+
+def test_fit_sharded_packed_matches_plain_sharded():
+    """fit_sharded_packed parity, two gates per layout:
+
+    1. BIT-IDENTICAL to the single-device packed fit on the pure
+       series-parallel 8x1 layout — partitioning along B touches no
+       per-series reduction, so the mesh feed must not change one bit.
+    2. Same optimum as the PLAIN sharded fit at f32 solver tolerance on
+       both layouts (the packed t reconstruction differs by ~1 ulp from
+       the host-built t, so exact equality is not defined here)."""
+    from tsspark_tpu.models.prophet.design import pack_fit_data
+    from tsspark_tpu.models.prophet.model import fit_core_packed
+
+    ds, y = _trend_sine_batch(b=16, t_len=256, seed=8)
+    data, meta = prepare_fit_data(
+        jnp.asarray(ds), jnp.asarray(y), CFG, as_numpy=True
+    )
+    packed, u8 = pack_fit_data(data, meta, ds, collapse_cap=True)
+    theta_sd, stats_sd = fit_core_packed(
+        jax.tree.map(jnp.asarray, packed), None, CFG, SOLVER,
+        reg_u8_cols=u8,
+    )
+
+    for n_s, n_t in ((8, 1), (4, 2)):
+        m = mesh_mod.make_mesh(n_series_shards=n_s, n_time_shards=n_t)
+        scfg = ShardingConfig(time_axis="time")
+        ref = sharding.fit_sharded(data, None, CFG, SOLVER, m, scfg)
+        res = sharding.fit_sharded_packed(
+            packed, u8, None, CFG, SOLVER, m, scfg
+        )
+        assert np.asarray(res.theta).shape == np.asarray(ref.theta).shape
+        scale = np.maximum(np.abs(np.asarray(ref.f)), 1.0)
+        d = float(np.max((np.asarray(res.f) - np.asarray(ref.f)) / scale))
+        assert d < 2e-3, (n_s, n_t, d)
+        if n_t == 1:
+            np.testing.assert_array_equal(
+                np.asarray(res.theta), np.asarray(theta_sd)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(res.f), np.asarray(stats_sd)[0]
+            )
+
+
+def test_fit_sharded_packed_pads_ragged_batch():
+    """A batch NOT divisible by the series-shard count exercises
+    fit_sharded_packed's NaN-inert-row padding branch: results for the
+    real rows must match the unpadded plain sharded fit, and padded rows
+    must never leak (shape check)."""
+    from tsspark_tpu.models.prophet.design import pack_fit_data
+
+    ds, y = _trend_sine_batch(b=11, t_len=256, seed=12)
+    data, meta = prepare_fit_data(
+        jnp.asarray(ds), jnp.asarray(y), CFG, as_numpy=True
+    )
+    packed, u8 = pack_fit_data(data, meta, ds, collapse_cap=True)
+    m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
+    scfg = ShardingConfig(time_axis="time")
+    ref = sharding.fit_sharded(data, None, CFG, SOLVER, m, scfg)
+    res = sharding.fit_sharded_packed(packed, u8, None, CFG, SOLVER, m, scfg)
+    assert np.asarray(res.theta).shape[0] == 11
+    assert bool(np.asarray(res.converged).all())
+    scale = np.maximum(np.abs(np.asarray(ref.f)), 1.0)
+    d = float(np.max((np.asarray(res.f) - np.asarray(ref.f)) / scale))
+    assert d < 2e-3, d
+
+
+def test_tpu_backend_mesh_routes_packed():
+    """TpuBackend(mesh=...) on a packable batch (shared grid, exact 0/1
+    mask) must take the packed transit, not the plain sharded feed."""
+    from tsspark_tpu.backends.tpu import TpuBackend
+
+    ds, y = _trend_sine_batch(b=8, t_len=200, seed=10)
+    m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
+    calls = {"packed": 0, "plain": 0}
+    orig_p, orig_u = sharding.fit_sharded_packed, sharding.fit_sharded
+
+    def cp(*a, **k):
+        calls["packed"] += 1
+        return orig_p(*a, **k)
+
+    def cu(*a, **k):
+        calls["plain"] += 1
+        return orig_u(*a, **k)
+
+    sharding.fit_sharded_packed, sharding.fit_sharded = cp, cu
+    try:
+        state = TpuBackend(CFG, SOLVER, mesh=m).fit(ds, y)
+    finally:
+        sharding.fit_sharded_packed = orig_p
+        sharding.fit_sharded = orig_u
+    assert calls["packed"] >= 1 and calls["plain"] == 0, calls
+    assert bool(np.isfinite(np.asarray(state.loss)).all())
+
+
 def test_mesh_axis_names_override_position():
     """A user mesh declared ("time", "series") must not get the axes
     swapped by the default ShardingConfig: conventional axis NAMES win
@@ -224,13 +366,20 @@ def test_mesh_axis_names_override_position():
     devs = np.array(jax.devices()).reshape(2, 4)
     m = jax.sharding.Mesh(devs, ("time", "series"))
     captured = {}
-    orig = sharding.fit_sharded
+    orig_u = sharding.fit_sharded
+    orig_p = sharding.fit_sharded_packed
 
-    def capture(data, th, cfg, solver, mesh, shard_cfg, *a, **k):
+    def capture_u(data, th, cfg, solver, mesh, shard_cfg, *a, **k):
         captured["cfg"] = shard_cfg
-        return orig(data, th, cfg, solver, mesh, shard_cfg, *a, **k)
+        return orig_u(data, th, cfg, solver, mesh, shard_cfg, *a, **k)
 
-    sharding.fit_sharded = capture
+    def capture_p(packed, u8, th, cfg, solver, mesh, shard_cfg, *a, **k):
+        captured["cfg"] = shard_cfg
+        return orig_p(packed, u8, th, cfg, solver, mesh, shard_cfg,
+                      *a, **k)
+
+    sharding.fit_sharded = capture_u
+    sharding.fit_sharded_packed = capture_p
     try:
         TpuBackend(CFG, SOLVER, mesh=m).fit(ds, y)
         assert captured["cfg"].series_axis == "series"
@@ -242,7 +391,8 @@ def test_mesh_axis_names_override_position():
         assert captured["cfg"].series_axis == "batch"
         assert captured["cfg"].time_axis == "time"
     finally:
-        sharding.fit_sharded = orig
+        sharding.fit_sharded = orig_u
+        sharding.fit_sharded_packed = orig_p
 
 
 def test_forecaster_mesh_end_to_end():

@@ -222,10 +222,11 @@ class TpuBackend(ForecastBackend):
         )
         # Indicator-column split decided ONCE here so the main fit and the
         # rescue pass share it (it is a static argument of the jitted fit
-        # and an O(B*T*R) host scan — see _fit_main).  Segmented and
-        # mesh-sharded solves never reach the packed path, so skip it.
+        # and an O(B*T*R) host scan — see _fit_main).  Segmented solves
+        # never reach the packed path, so skip it there; mesh-sharded
+        # solves DO (fit_sharded_packed ships the packed form per shard).
         if (reg_u8_cols is None and regressors is not None
-                and not segmented and self.mesh is None):
+                and not segmented and np.asarray(ds).ndim == 1):
             reg_u8_cols = _indicator_reg_cols(np.asarray(regressors))
         # One full-batch out-of-span changepoint warning instead of a copy
         # per chunk with chunk-local counts (ADVICE r3).
@@ -331,7 +332,7 @@ class TpuBackend(ForecastBackend):
             and self.iter_segment < self.solver_config.max_iters
         )
         if (u8 is None and regressors is not None and not segmented
-                and self.mesh is None):
+                and ds.ndim == 1):
             u8 = _indicator_reg_cols(np.asarray(regressors))
         dyn = dict(
             max_iters_dynamic=max_iters_dynamic,
@@ -428,7 +429,7 @@ class TpuBackend(ForecastBackend):
         if self.mesh is not None:
             state = self._fit_sharded_chunk(
                 ds, y, mask, cap, floor, regressors, init, conditions,
-                dyn,
+                dyn, reg_u8_cols,
             )
             return _slice_state(state, 0, b)
         state = self._model.fit(
@@ -440,7 +441,7 @@ class TpuBackend(ForecastBackend):
         return _slice_state(state, 0, b)
 
     def _fit_sharded_chunk(self, ds, y, mask, cap, floor, regressors,
-                           init, conditions, dyn=None):
+                           init, conditions, dyn=None, reg_u8_cols=None):
         """One padded chunk through the multi-chip sharded program.
 
         The traced phase controls (dyn) are folded into an equivalent
@@ -448,8 +449,14 @@ class TpuBackend(ForecastBackend):
         non-packable fallback; the one-compiled-program-for-both-phases
         trick is a single-device transfer optimization the mesh path does
         not need (its inputs are sharded across devices, not re-shipped
-        per phase)."""
+        per phase).
+
+        Transfer: shared-grid batches with an exact 0/1 mask and finite
+        observed y ride the packed transit (fit_sharded_packed — each
+        device receives only its shard of the packed bytes); everything
+        else falls back to the plain sharded feed."""
         from tsspark_tpu.config import ShardingConfig
+        from tsspark_tpu.models.prophet.design import pack_fit_data
         from tsspark_tpu.parallel import sharding as sharding_mod
 
         solver = self.solver_config
@@ -474,7 +481,17 @@ class TpuBackend(ForecastBackend):
                 theta0 = None
         data, meta = self._model.prepare(
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
-            conditions=conditions,
+            conditions=conditions, as_numpy=True,
+        )
+        # Same packable predicate as ProphetModel.fit: shared grid + exact
+        # 0/1 mask.  pack_fit_data's own validation (finite observed y,
+        # reg_u8_cols columns still 0/1) stays a LOUD failure here too —
+        # those are contract violations the single-device path surfaces,
+        # not conditions to silently reroute around.
+        mask_np = np.asarray(data.mask)
+        packable = (
+            np.asarray(ds).ndim == 1
+            and bool(np.all((mask_np == 0.0) | (mask_np == 1.0)))
         )
         if self.shard_config is not None:
             shard_cfg = self.shard_config
@@ -504,11 +521,20 @@ class TpuBackend(ForecastBackend):
                 series_axis=series_ax,
                 time_axis=time_ax,
             )
-        res = sharding_mod.fit_sharded(
-            data,
-            None if theta0 is None else jnp.asarray(theta0),
-            self.config, solver, self.mesh, shard_cfg,
-        )
+        theta0 = None if theta0 is None else jnp.asarray(theta0)
+        if packable:
+            packed, u8 = pack_fit_data(
+                data, meta, ds, reg_u8_cols=reg_u8_cols,
+                collapse_cap=self.config.growth != "logistic",
+            )
+            res = sharding_mod.fit_sharded_packed(
+                packed, u8, theta0, self.config, solver, self.mesh,
+                shard_cfg,
+            )
+        else:
+            res = sharding_mod.fit_sharded(
+                data, theta0, self.config, solver, self.mesh, shard_cfg,
+            )
         if self.on_segment is not None:
             self.on_segment()
         return FitState(
@@ -544,8 +570,9 @@ class TpuBackend(ForecastBackend):
         # a continuous column could coincidentally look binary and flip the
         # jit-static u8 split — decide once on the full batch and thread
         # the decision through every phase (and the multi-start refits).
-        # Segmented and mesh-sharded solves never reach the packed path,
-        # so skip the O(B*T*R) host scan there (ADVICE r3).
+        # Segmented solves never reach the packed path, so skip the
+        # O(B*T*R) host scan there (ADVICE r3); mesh-sharded solves DO
+        # (fit_sharded_packed).
         segmented_2p = bool(
             self.iter_segment
             and self.iter_segment < self.solver_config.max_iters
@@ -553,7 +580,7 @@ class TpuBackend(ForecastBackend):
         u8 = (
             _indicator_reg_cols(np.asarray(regressors))
             if (regressors is not None and not segmented_2p
-                and self.mesh is None) else None
+                and np.asarray(ds).ndim == 1) else None
         )
         if self.iter_segment and self.iter_segment < self.solver_config.max_iters:
             phase1_state = self._phase1(phase1_iters).fit(

@@ -44,19 +44,26 @@ def pad_to_multiple(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def _resolve_time_axis(mesh: Mesh, config: ShardingConfig):
+    """Time axis for a layout: the config's declared name wins; otherwise
+    fall back to the first mesh axis that is NOT the series axis.  Taking
+    axis_names[1] positionally put the SERIES axis on the time dimension
+    for a mesh declared ("time", "series") (ADVICE r4).  Shared by the
+    plain and packed spec builders so the two feeds can never resolve
+    different time axes for the same mesh."""
+    t_ax = config.time_axis
+    if t_ax is None:
+        rest = [n for n in mesh.axis_names if n != config.series_axis]
+        t_ax = rest[0] if rest else None
+    return t_ax
+
+
 def data_shardings(
     mesh: Mesh, data: FitData, config: ShardingConfig
 ) -> FitData:
     """PartitionSpecs for each FitData leaf (shaped like the pytree)."""
     s_ax = config.series_axis
-    # Time axis: the config's declared name wins; otherwise fall back to
-    # the first mesh axis that is NOT the series axis.  Taking
-    # axis_names[1] positionally put the SERIES axis on the time
-    # dimension for a mesh declared ("time", "series") (ADVICE r4).
-    t_ax = config.time_axis
-    if t_ax is None:
-        rest = [n for n in mesh.axis_names if n != s_ax]
-        t_ax = rest[0] if rest else None
+    t_ax = _resolve_time_axis(mesh, config)
     bt = P(s_ax, t_ax)
     return FitData(
         t=bt,
@@ -71,10 +78,45 @@ def data_shardings(
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("config", "solver_config", "mesh", "shard_cfg")
-)
-def _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg):
+def packed_shardings(
+    mesh: Mesh, packed, config: ShardingConfig
+):
+    """PartitionSpecs for each PackedFitData leaf (design.PackedFitData).
+
+    Mirrors ``data_shardings`` for the transfer-optimized form: per-series
+    leaves shard on the series axis, time-major leaves additionally on the
+    time axis.  ``X_reg_bits`` is the one exception — its time axis is
+    bit-packed 8 steps per byte, so a time shard boundary would land
+    mid-byte unless every shard length were a multiple of 8; the column is
+    u8 (32x smaller than its f32 expansion) so replicating it across time
+    shards costs less than the alignment bookkeeping would."""
+    from tsspark_tpu.models.prophet.design import PackedFitData
+
+    s_ax = config.series_axis
+    t_ax = _resolve_time_axis(mesh, config)
+    return PackedFitData(
+        y=P(s_ax, t_ax),
+        ds_rel=P(t_ax),
+        t_off=P(s_ax),
+        t_inv_span=P(s_ax),
+        s=P(s_ax, None),
+        cap=P(s_ax, None) if packed.cap.shape[-1] == 1 else P(s_ax, t_ax),
+        X_season=(
+            P(t_ax, None) if packed.X_season.ndim == 2
+            else P(s_ax, t_ax, None)
+        ),
+        X_reg=P(s_ax, t_ax, None),
+        X_reg_bits=P(s_ax, None, None),
+        prior_scales=P(None),
+        mult_mask=P(None),
+    )
+
+
+def _constrained_solve(data, theta0, config, solver_config, mesh, shard_cfg):
+    """Shared sharded-solve tail (traced): anchor the FitData/theta
+    shardings, build the warm start + preconditioner inside the program,
+    run the batched L-BFGS.  Called from both jitted entry points (plain
+    and packed-transit)."""
     specs = data_shardings(mesh, data, shard_cfg)
     s_ax = shard_cfg.series_axis
     data = jax.lax.with_sharding_constraint(
@@ -97,6 +139,42 @@ def _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg):
         if has_closed_form_fan(config) else None
     return lbfgs.minimize(fun, theta0, solver_config, fun_value=fval,
                           precond=precond, fan_value=fan)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("config", "solver_config", "mesh", "shard_cfg")
+)
+def _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg):
+    return _constrained_solve(
+        data, theta0, config, solver_config, mesh, shard_cfg
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "config", "solver_config", "mesh", "shard_cfg", "reg_u8_cols"
+    ),
+)
+def _fit_sharded_packed_core(
+    packed, theta0, config, solver_config, mesh, shard_cfg, reg_u8_cols
+):
+    from tsspark_tpu.models.prophet.design import unpack_fit_data
+
+    pspecs = packed_shardings(mesh, packed, shard_cfg)
+    packed = jax.lax.with_sharding_constraint(
+        packed, jax.tree.map(lambda sp: NamedSharding(mesh, sp), pspecs,
+                             is_leaf=lambda x: isinstance(x, P))
+    )
+    # The unpack (elementwise: NaN-fold mask recovery, bit expansion, t
+    # reconstruction) is traced INTO the sharded program, so the expanded
+    # (B, T) tensors exist only as device shards — the host->device feed
+    # ships the packed bytes.  _constrained_solve then re-anchors the
+    # unpacked leaves on the plain FitData shardings.
+    data = unpack_fit_data(packed, reg_u8_cols)
+    return _constrained_solve(
+        data, theta0, config, solver_config, mesh, shard_cfg
+    )
 
 
 def fit_sharded(
@@ -138,6 +216,81 @@ def fit_sharded(
             theta0 = pad_b(theta0)
 
     res = _fit_sharded_core(data, theta0, config, solver_config, mesh, shard_cfg)
+    if b_pad != b:
+        res = jax.tree.map(lambda a: a[:b], res)
+    return res
+
+
+def fit_sharded_packed(
+    packed,
+    reg_u8_cols: Tuple[int, ...],
+    theta0: Optional[jnp.ndarray],
+    config: ProphetConfig,
+    solver_config: SolverConfig,
+    mesh: Mesh,
+    shard_cfg: ShardingConfig = ShardingConfig(),
+) -> lbfgs.LbfgsResult:
+    """Packed-transit analog of ``fit_sharded``.
+
+    The multi-chip host->device feed ships the PackedFitData bytes (~1/3
+    of the plain form — NaN-folded mask, bit-packed indicators, on-device
+    t reconstruction, design.PackedFitData) and each device receives ONLY
+    its shard: leaves are ``device_put`` with their NamedShardings before
+    the program runs, so no device ever materializes the full batch.  On
+    a real v5e-8 this is the same transfer bottleneck the single-chip
+    packed path exists for, 8x wider.
+
+    Padding rows are all-NaN ``y`` (the packed encoding of an all-masked
+    inert series — the NaN-fold recovers mask == 0 on device).
+    """
+    import numpy as np
+
+    b = packed.y.shape[0]
+    n_series_shards = mesh.shape[shard_cfg.series_axis]
+    b_pad = pad_to_multiple(b, n_series_shards)
+    if b_pad != b:
+        k = b_pad - b
+
+        def pad_rows(a, fill):
+            a = np.asarray(a)
+            return np.concatenate(
+                [a, np.full((k,) + a.shape[1:], fill, a.dtype)]
+            )
+
+        packed = packed._replace(
+            y=pad_rows(packed.y, np.nan),   # all-masked -> inert series
+            # t_inv_span=0, t_off=0 -> reconstructed t == 0 everywhere,
+            # the same inert-row t encoding fit_sharded's zero-padding
+            # produces (a 1.0 fill would make t the raw day offsets).
+            t_off=pad_rows(packed.t_off, 0.0),
+            t_inv_span=pad_rows(packed.t_inv_span, 0.0),
+            s=pad_rows(packed.s, 0.0),
+            cap=pad_rows(packed.cap, 1.0),  # keep logistic cap positive
+            X_reg=pad_rows(packed.X_reg, 0.0),
+            X_reg_bits=pad_rows(packed.X_reg_bits, 0),
+            X_season=(
+                packed.X_season if packed.X_season.ndim == 2
+                else pad_rows(packed.X_season, 0.0)
+            ),
+        )
+        if theta0 is not None:
+            theta0 = pad_rows(theta0, 0.0)
+
+    pspecs = packed_shardings(mesh, packed, shard_cfg)
+    packed = jax.device_put(
+        packed,
+        jax.tree.map(lambda sp: NamedSharding(mesh, sp), pspecs,
+                     is_leaf=lambda x: isinstance(x, P)),
+    )
+    if theta0 is not None:
+        theta0 = jax.device_put(
+            jnp.asarray(theta0),
+            NamedSharding(mesh, P(shard_cfg.series_axis, None)),
+        )
+    res = _fit_sharded_packed_core(
+        packed, theta0, config, solver_config, mesh, shard_cfg,
+        tuple(reg_u8_cols),
+    )
     if b_pad != b:
         res = jax.tree.map(lambda a: a[:b], res)
     return res
