@@ -9,30 +9,19 @@ values >= 1.0 mean the 8-chip target is beaten with 1/8th of the hardware.
 framing (480 chip-s budget / single-chip seconds spent) — an extrapolation
 over the embarrassingly-parallel series axis, kept out of the headline.
 
-Resilience: the single TPU chip sits behind an experimental stdio-tunneled
-relay whose worker can crash on large programs (observed: single input
-buffers over ~64 MB kill it, and the envelope shrinks after a crash).  A
-dead worker takes the whole JAX client with it, so the benchmark is split
-into processes:
+Resilience: the process isolation, stall watchdog, tunnel probe loop,
+chunk-halving retries, and crash-resumable two-phase fit all live in
+``tsspark_tpu.orchestrate`` (they are a LIBRARY capability —
+``fit_resilient`` / ``Forecaster(..., resilient=True)``); this file is a
+thin caller that adds only the benchmark-specific pieces:
 
-  parent (this file, no JAX)  — caches generated data across runs keyed by
-                                shape, spawns fit workers, retries crashed
-                                ranges (halving the chunk only when a
-                                phase-1 attempt made zero progress), resumes
-                                from completed per-chunk result files,
-                                watches per-dispatch heartbeats so long
-                                compiles / the chunk-less phase-2 pass are
-                                not killed as stalls, then runs a CPU eval
-                                worker and prints the ONE summary JSON line.
-  --_fit child (TPU)          — phase 1: every chunk at a short lockstep
-                                depth (prefetching the next chunk's host
-                                prep), saved as it lands; phase 2: the
-                                unconverged tail across ALL chunks is
-                                compacted into one batch, finished at full
-                                depth with the GN-diagonal metric, and the
-                                chunk files patched in place (idempotent).
-  --_eval child (CPU)         — in-sample sMAPE on a subsample from the
-                                saved states (accuracy gate, not the metric).
+  * the M5-shaped dataset cache (seed-deterministic, keyed by shape +
+    generator fingerprint),
+  * the numerics-scoped resumable scratch key,
+  * the CPU eval child (in-sample sMAPE accuracy gate),
+  * budget/reserve accounting against the driver's harness timeout, with
+    tunnel-down time spent on overlapped CPU eval/prep children,
+  * the ONE summary JSON line (also emitted from the SIGTERM handler).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
@@ -52,10 +41,11 @@ import subprocess
 import sys
 import tempfile
 import time
-from typing import Optional
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+
+from tsspark_tpu import orchestrate
 
 TARGET_S = 60.0        # driver target: 60 s on a v5e-8 (BASELINE.json:5)
 TARGET_CHIPS = 8       # ... which is a 480 chip-second budget
@@ -68,10 +58,10 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
 RESERVE_S = 150.0
 
 
-# Bump when a bench.py change alters fit NUMERICS (solver args, phase
-# policy, data handling).  Orchestration-only changes (probing, retries,
-# logging) must NOT bump it: the whole point of the numerics-scoped
-# fingerprint below is that resume state survives them.
+# Bump when a bench/orchestrate change alters fit NUMERICS (solver args,
+# phase policy, data handling).  Orchestration-only changes (probing,
+# retries, logging) must NOT bump it: the whole point of the
+# numerics-scoped fingerprint below is that resume state survives them.
 BENCH_NUMERICS_REV = 6
 
 
@@ -132,723 +122,6 @@ def _model_config():
     )
 
 
-def _host_cpu_tag() -> str:
-    from tsspark_tpu.utils.platform import host_cpu_tag
-
-    return host_cpu_tag()
-
-
-def _setup_jax_child():
-    """Child-process JAX config: persistent compile cache."""
-    import jax
-
-    from tsspark_tpu.utils.platform import honor_env_platforms
-
-    honor_env_platforms()
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(REPO, f".jax_cache_{_host_cpu_tag()}"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    return jax
-
-
-# --------------------------------------------------------------------------
-# fit worker (TPU)
-# --------------------------------------------------------------------------
-
-def _prep_path(out_dir: str, lo: int, hi: int) -> str:
-    return os.path.join(out_dir, f"prep_{lo:06d}_{hi:06d}.npz")
-
-
-def _save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
-    """Persist one chunk's packed device payload (host numpy) so a CPU-side
-    prep worker can build it while the TPU tunnel is wedged and the fit
-    worker can later skip its own prep.  NamedTuple fields are flattened
-    with prefixes; the dotfile + rename makes readers never see a torn
-    file (same convention as chunk saves)."""
-    import numpy as np
-
-    arrays = {"b_real": np.asarray(b_real)}
-    for k, v in packed._asdict().items():
-        arrays[f"packed_{k}"] = np.asarray(v)
-    for k, v in meta._asdict().items():
-        arrays[f"meta_{k}"] = np.asarray(v)
-    tmp = os.path.join(out_dir, f".tmp_prep_{lo:06d}_{hi:06d}.npz")
-    np.savez(tmp, **arrays)
-    os.replace(tmp, _prep_path(out_dir, lo, hi))
-
-
-def _load_prep(out_dir, lo, hi, chunk=None):
-    """(b_real, PackedFitData, ScalingMeta) or None if absent/corrupt.
-
-    ``chunk``: reject payloads whose padded batch width differs — a tail
-    range keeps its (lo, hi) name across a chunk-halving retry, and
-    serving the old wider payload would re-dispatch exactly the program
-    size that just crashed the worker."""
-    import numpy as np
-
-    from tsspark_tpu.models.prophet.design import PackedFitData, ScalingMeta
-
-    path = _prep_path(out_dir, lo, hi)
-    if not os.path.exists(path):
-        return None
-    try:
-        z = np.load(path)
-        packed = PackedFitData(**{
-            k: z[f"packed_{k}"] for k in PackedFitData._fields
-        })
-        meta = ScalingMeta(**{
-            k: z[f"meta_{k}"] for k in ScalingMeta._fields
-        })
-        if chunk is not None and packed.y.shape[0] != chunk:
-            return None
-        return int(z["b_real"]), packed, meta
-    except Exception:
-        return None
-
-
-def prep_worker(args) -> int:
-    """CPU-side chunk prep: build the packed device payloads for up to
-    ``--max-ahead`` pending chunks and save them next to the chunk results.
-
-    Runs overlapped with the parent's tunnel-probe loop (JAX_PLATFORMS=cpu,
-    so a wedged TPU tunnel cannot block it): when the tunnel recovers, the
-    fit worker finds its first chunks pre-packed and goes straight to
-    device work instead of paying host prep on the critical path."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    _setup_jax_child()
-    import numpy as np
-
-    from tsspark_tpu.config import SolverConfig
-    from tsspark_tpu.models.prophet.design import (
-        _indicator_reg_cols, pack_fit_data,
-    )
-    from tsspark_tpu.models.prophet.model import ProphetModel
-
-    ds = np.load(os.path.join(args.data, "ds.npy"))
-    y = np.load(os.path.join(args.data, "y.npy"), mmap_mode="r")
-    mask = np.load(os.path.join(args.data, "mask.npy"), mmap_mode="r")
-    reg = np.load(os.path.join(args.data, "reg.npy"), mmap_mode="r")
-    model = ProphetModel(_model_config(), SolverConfig(max_iters=args.max_iters))
-    u8_cols = _indicator_reg_cols(reg)
-
-    # Completed COVERAGE, not exact chunk-file names: after a mid-run
-    # chunk halving, regions fitted under the old wider grid have no file
-    # at the new (lo, hi) spacing, and pre-packing them would burn the
-    # bounded --max-ahead budget on payloads no fit worker will read.
-    done = _completed_ranges(args.out)
-
-    def _covered(lo: int, hi: int) -> bool:
-        cur = lo
-        for dlo, dhi in done:
-            if dhi <= cur:
-                continue
-            if dlo > cur:
-                return False
-            cur = dhi
-            if cur >= hi:
-                return True
-        return cur >= hi
-
-    made = 0
-    for lo in range(0, args.series, args.chunk):
-        if made >= args.max_ahead:
-            break
-        hi = min(lo + args.chunk, args.series)
-        if _covered(lo, hi) or os.path.exists(_prep_path(args.out, lo, hi)):
-            continue
-        b_real = hi - lo
-        y_c = np.zeros((args.chunk, y.shape[1]), np.float32)
-        m_c = np.zeros((args.chunk, y.shape[1]), np.float32)
-        r_c = np.zeros((args.chunk,) + reg.shape[1:], np.float32)
-        y_c[:b_real] = y[lo:hi]
-        m_c[:b_real] = mask[lo:hi]
-        r_c[:b_real] = reg[lo:hi]
-        data, meta = model.prepare(
-            ds, y_c, mask=m_c, regressors=r_c, as_numpy=True
-        )
-        packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols,
-                                  collapse_cap=True)
-        _save_prep_atomic(args.out, lo, hi, b_real, packed, meta)
-        made += 1
-    return 0
-
-
-def _save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None):
-    import numpy as np
-
-    # Dotfile prefix so a half-written file can never match the
-    # chunk_*.npz resume/eval glob.
-    tmp = os.path.join(out_dir, f".tmp_{lo:06d}_{hi:06d}.npz")
-    arrays = dict(
-        theta=np.asarray(state.theta),
-        loss=np.asarray(state.loss),
-        grad_norm=np.asarray(state.grad_norm),
-        converged=np.asarray(state.converged),
-        n_iters=np.asarray(state.n_iters),
-        status=np.asarray(state.status) if state.status is not None
-        else np.zeros(len(np.asarray(state.converged)), np.int32),
-        y_scale=np.asarray(state.meta.y_scale),
-        floor=np.asarray(state.meta.floor),
-        ds_start=np.asarray(state.meta.ds_start),
-        ds_span=np.asarray(state.meta.ds_span),
-        reg_mean=np.asarray(state.meta.reg_mean),
-        reg_std=np.asarray(state.meta.reg_std),
-        changepoints=np.asarray(state.meta.changepoints),
-    )
-    arrays.update(extra_arrays or {})
-    np.savez(tmp, **arrays)
-    os.replace(tmp, os.path.join(out_dir, f"chunk_{lo:06d}_{hi:06d}.npz"))
-
-
-def fit_worker(args) -> int:
-    """Phase 1: every chunk at a short lockstep depth (phase1 iters), saved
-    as it lands.  Phase 2 (once no chunk is missing over the whole range):
-    gather the unconverged tail across ALL chunks into one compacted batch,
-    finish it at full depth warm-started from phase-1 parameters, and patch
-    the chunk files in place (idempotent; resumable after any crash).
-
-    Rationale: the batched solver is lockstep, so pre-compaction every chunk
-    paid max_iters for its slowest series while the measured mean iterations
-    to converge is ~3 (VERDICT round 2).  See TpuBackend.fit_twophase for
-    the same logic as an in-memory API.
-    """
-    jax = _setup_jax_child()
-    import numpy as np
-
-    from tsspark_tpu.backends.registry import get_backend
-    from tsspark_tpu.backends.tpu import patch_state
-    from tsspark_tpu.config import SolverConfig
-    from tsspark_tpu.models.prophet.design import (
-        ScalingMeta, _indicator_reg_cols, pack_fit_data,
-    )
-    from tsspark_tpu.models.prophet.model import (
-        FitState, fit_core_packed, fitstate_from_packed,
-    )
-
-    ds = np.load(os.path.join(args.data, "ds.npy"))
-    y = np.load(os.path.join(args.data, "y.npy"), mmap_mode="r")
-    mask = np.load(os.path.join(args.data, "mask.npy"), mmap_mode="r")
-    reg = np.load(os.path.join(args.data, "reg.npy"), mmap_mode="r")
-
-    # Liveness for the parent's stall watchdog: every completed solver
-    # dispatch touches this file, so long legitimate work (a fresh compile,
-    # the chunk-less phase-2 straggler fit) is distinguishable from a
-    # wedged tunnel without any new chunk result appearing.
-    hb_path = os.path.join(args.out, "heartbeat")
-
-    def heartbeat():
-        with open(hb_path, "w") as fh:
-            fh.write(str(time.time()))
-
-    backend = get_backend(
-        "tpu", _model_config(), SolverConfig(max_iters=args.max_iters),
-        chunk_size=args.chunk, iter_segment=args.segment or None,
-        on_segment=heartbeat,
-    )
-    # phase1 depth >= full depth degenerates to a single-phase run.
-    two_phase = 0 < args.phase1_iters < args.max_iters
-    phase1 = backend._phase1(args.phase1_iters) if two_phase else backend
-
-    # Phase 1 drives the model layer directly with a bounded prefetch pool:
-    # upcoming chunks' host-side design builds (~0.6-1.4 s of numpy each)
-    # run while earlier chunks occupy the device.  Device time per chunk is
-    # now ~0.6 s (gather-free trend), so a one-deep prefetch left prep on
-    # the critical path every other chunk (measured alternating 0.6 s /
-    # 2.2 s chunk walls); two prep workers and a three-deep window keep the
-    # device continuously fed while bounding buffered chunks (~60 MB each).
-    # Chunks are padded to the full chunk size with inert all-masked rows
-    # (same convention as TpuBackend._fit_padded) so every fit hits one
-    # compiled shape.
-    from concurrent.futures import ThreadPoolExecutor
-
-    # The packed mode drives ONE compiled program for both phases: the
-    # static solver carries the full depth, while the per-phase differences
-    # (solve depth, GN-metric switch, warm-start-vs-ridge-init) are TRACED
-    # scalars (fit_core's *_dynamic args).  Phase 2 previously compiled and
-    # warmed a second program (different static solver + init presence) at
-    # ~10 s per run through the tunnel.
-    model = backend._model
-    n_params = model.config.num_params
-    zeros_theta = np.zeros((args.chunk, n_params), np.float32)
-
-    # Segmented mode (--segment < phase-1 depth) keeps the FitData path:
-    # per-segment dispatches with a heartbeat after each, for runs where
-    # bounding single-dispatch time matters more than transfer bytes.
-    # Default mode runs each chunk as ONE packed-transfer program.
-    segmented = bool(
-        phase1.iter_segment
-        and phase1.iter_segment < phase1._model.solver_config.max_iters
-    )
-    # Indicator-column split for the packed path, decided ONCE on the full
-    # dataset: per-chunk auto-detection would let a chunk whose continuous
-    # column is coincidentally all-0/1 flip the static argument and
-    # silently recompile mid-run.
-    u8_cols = _indicator_reg_cols(reg)
-
-    def prep(lo: int, hi: int):
-        if not segmented:
-            # A CPU prep worker may have pre-packed this chunk while the
-            # tunnel was down (same prepare/pack code path, so numerics
-            # are identical); corrupt/absent files fall through to local
-            # prep.
-            cached = _load_prep(args.out, lo, hi, chunk=args.chunk)
-            if cached is not None:
-                return lo, hi, cached[0], cached[1], cached[2]
-        b_real = hi - lo
-        y_c = np.zeros((args.chunk, y.shape[1]), np.float32)
-        m_c = np.zeros((args.chunk, y.shape[1]), np.float32)
-        r_c = np.zeros((args.chunk,) + reg.shape[1:], np.float32)
-        y_c[:b_real] = y[lo:hi]
-        m_c[:b_real] = mask[lo:hi]
-        r_c[:b_real] = reg[lo:hi]
-        # as_numpy: a prep thread must not issue device transfers — on the
-        # single-chip tunnel they queue behind the in-flight fit program
-        # and re-serialize the pipeline the prefetch exists to overlap.
-        # pack_fit_data then cuts the shipped bytes ~3x (mask folded into
-        # y as NaN, bit-packed indicator columns, device-side t
-        # reconstruction, elided cap; design.PackedFitData).
-        data, meta = model.prepare(
-            ds, y_c, mask=m_c, regressors=r_c, as_numpy=True
-        )
-        if segmented:
-            return lo, hi, b_real, data, meta
-        packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols,
-                                  collapse_cap=True)
-        return lo, hi, b_real, packed, meta
-
-    todo = []
-    for lo in range(args.lo, args.hi, args.chunk):
-        hi = min(lo + args.chunk, args.hi)
-        if not os.path.exists(
-            os.path.join(args.out, f"chunk_{lo:06d}_{hi:06d}.npz")
-        ):
-            todo.append((lo, hi))
-    prefetch_depth = 3
-    # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
-    # program, so it can change per chunk for free.  One adjustment after
-    # chunk 0 keeps runs predictable.  The deepen branch fires only on a
-    # PATHOLOGICAL first chunk (a quarter still progressing): measured on
-    # the M5 shape, the unconverged set is depth-FLAT (124/122/122/120/114
-    # stragglers per 1024 at depths 8/12/16/24/32) — it is the
-    # ill-conditioned tail that needs phase 2's GN metric, not more plain
-    # lockstep iterations, so the old 3% trigger doubled every chunk's
-    # device time for ~2 rescued series per 1024.  If virtually everything
-    # converges early, shallow out.
-    depth = {"v": args.phase1_iters if two_phase else args.max_iters,
-             "tuned": not two_phase or getattr(args, "no_phase1_tune", False)}
-
-    def tune_depth(state, b_real):
-        if depth["tuned"]:
-            return
-        depth["tuned"] = True
-        frac_unconv = float(
-            (~np.asarray(state.converged)[:b_real]).mean()
-        )
-        if frac_unconv > 0.25:
-            depth["v"] = min(int(depth["v"]) * 2, args.max_iters)
-        elif frac_unconv < 0.005 and depth["v"] > 8:
-            depth["v"] = max(8, int(depth["v"]) * 2 // 3)
-
-    def save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1):
-        """Chunk save + prep-file cleanup + one times.jsonl row (shared by
-        the packed writer path and the segmented inline path)."""
-        _save_chunk_atomic(args.out, lo, hi, state)
-        try:  # prep payload served its purpose; bound scratch disk
-            os.remove(_prep_path(args.out, lo, hi))
-        except OSError:
-            pass
-        with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
-            fh.write(json.dumps({
-                "lo": lo, "hi": hi, "fit_s": round(fit_s, 3),
-                "wait_s": round(t_wait, 3), "put_s": round(t_put, 3),
-                "dev_s": round(t_dev, 3),
-                "read_s": round(time.time() - t1, 3),
-                "chunk": args.chunk, "device": str(jax.devices()[0]),
-            }) + "\n")
-
-    # Post-fit host work (device->host readback of the small result
-    # buffers, FitState assembly, chunk-file save) rides a single writer
-    # thread so the main thread's next device_put starts immediately after
-    # the fit dispatch completes — the readbacks (~0.4 MB) overlap the next
-    # chunk's multi-MB upload instead of serializing ahead of it.  One
-    # worker keeps times.jsonl appends race-free.  ``fit_s`` is captured
-    # on the MAIN thread at hand-off so it measures the chunk's actual
-    # wall (wait+put+dev); read_s alone reflects writer-side readback,
-    # which may overlap the next chunk's upload.
-    def finish_chunk(lo, hi, b_real, theta, stats, meta, fit_s, t_wait,
-                     t_put, t_dev):
-        t1 = time.time()
-        state = fitstate_from_packed(
-            np.asarray(theta)[:b_real],
-            np.asarray(stats)[:, :b_real],
-            jax.tree.map(lambda a: np.asarray(a)[:b_real], meta),
-        )
-        save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1)
-        return state
-
-    # Device-resident chunk payloads: phase 1 keeps every uploaded packed
-    # payload alive on device (~16.6 MB x 30 chunks = ~500 MB HBM) so
-    # phase 2 can gather its straggler rows ON DEVICE instead of
-    # re-prepping and re-uploading them over the serial tunnel.  Falls
-    # back to the host path whenever coverage is partial (resume,
-    # chunk-halving retries).  Retained bytes are CAPPED (ADVICE r4):
-    # HBM cost is linear in series count, so a much-larger-than-M5 run
-    # would otherwise OOM phase 1; past the budget we stop inserting and
-    # the partial-coverage check routes phase 2 to the host path.
-    resident = {}
-    resident_bytes = 0
-    resident_budget = int(
-        os.environ.get("BENCH_RESIDENT_MB", "4096")
-    ) * (1 << 20)
-    with ThreadPoolExecutor(max_workers=2) as pool, \
-            ThreadPoolExecutor(max_workers=1) as writer:
-        write_futs = []
-        futs = {
-            j: pool.submit(prep, *todo[j])
-            for j in range(min(prefetch_depth, len(todo)))
-        }
-        for i in range(len(todo)):
-            t0 = time.time()
-            lo, hi, b_real, payload, meta = futs.pop(i).result()
-            t_wait = time.time() - t0
-            nxt = i + prefetch_depth
-            if nxt < len(todo):
-                futs[nxt] = pool.submit(prep, *todo[nxt])
-            t1 = time.time()
-            # One device_put call for the whole pytree (not per-leaf
-            # tree.map): the runtime can batch the per-buffer dispatches.
-            payload = jax.device_put(payload)
-            jax.block_until_ready(jax.tree.leaves(payload))
-            t_put = time.time() - t1
-            t1 = time.time()
-            if segmented:
-                state = phase1._model._fit_prepared(
-                    payload, meta, None, phase1.iter_segment,
-                    on_segment=heartbeat,
-                )
-                jax.block_until_ready(state.theta)
-                t_dev = time.time() - t1
-                t1 = time.time()
-                state = jax.tree.map(
-                    lambda a: np.asarray(a)[:b_real], state
-                )
-                save_and_log(lo, hi, state, time.time() - t0,
-                             t_wait, t_put, t_dev, t1)
-            else:
-                theta, stats = fit_core_packed(
-                    payload, zeros_theta, model.config, model.solver_config,
-                    reg_u8_cols=u8_cols,
-                    max_iters_dynamic=np.int32(depth["v"]),
-                    gn_precond_dynamic=np.bool_(False),
-                    use_theta0_dynamic=np.bool_(False),
-                )
-                jax.block_until_ready(theta)
-                heartbeat()
-                if two_phase and not os.environ.get("BENCH_NO_RESIDENT"):
-                    # Real [lo, hi) recorded: rows past hi - lo are inert
-                    # padding that phase 2 must never gather (a padding
-                    # row "converges" instantly and would silently patch
-                    # garbage into a real series' slot).
-                    nb = sum(
-                        a.nbytes for a in jax.tree.leaves(payload)
-                    )
-                    if resident_bytes + nb <= resident_budget:
-                        resident[lo] = (hi, payload)
-                        resident_bytes += nb
-                t_dev = time.time() - t1
-                fit_s = time.time() - t0
-                if not depth["tuned"]:
-                    # Depth must settle before chunk 1 dispatches, so
-                    # chunk 0 finalizes inline.
-                    state = finish_chunk(lo, hi, b_real, theta, stats,
-                                         meta, fit_s, t_wait, t_put, t_dev)
-                    tune_depth(state, b_real)
-                else:
-                    write_futs.append(writer.submit(
-                        finish_chunk, lo, hi, b_real, theta, stats, meta,
-                        fit_s, t_wait, t_put, t_dev,
-                    ))
-        for f in write_futs:
-            f.result()  # surface writer-thread failures before phase 2
-
-    # ---- phase 2: compacted straggler pass over the whole series range ----
-    if not two_phase:
-        return 0
-    done = _completed_ranges(args.out)
-    if _missing_ranges(done, args.series):
-        return 0  # another worker attempt still owes phase-1 chunks
-    marker = os.path.join(args.out, "phase2_done")
-    if os.path.exists(marker):
-        return 0
-
-    t0 = time.time()
-    straggler_idx, straggler_theta, straggler_gn = [], [], []
-    files = {}
-    for lo, hi in done:
-        f = os.path.join(args.out, f"chunk_{lo:06d}_{hi:06d}.npz")
-        z = dict(np.load(f))
-        files[(lo, hi)] = z
-        # Already-patched chunks (resume after a phase-2 crash) are final.
-        if z.get("phase2") is not None:
-            continue
-        # Unconverged only.  TpuBackend.fit's rescue pass additionally
-        # refits stuck exits (status FLOOR/STALLED) — measured on the eval
-        # configs it trims the CPU-parity tail (p99 1.24 -> 0.86 sMAPE) —
-        # but on bench-shaped data the same widening costs ~60% more fit
-        # wall for <= 0.1 nats/series, so the HEADLINE run keeps the fast
-        # selection; parity is graded through the eval path, which uses
-        # the rescue-enabled fit.
-        bad = np.flatnonzero(~z["converged"])
-        straggler_idx.extend(int(lo + i) for i in bad)
-        straggler_theta.append(z["theta"][bad])
-        straggler_gn.append(z["grad_norm"][bad])
-    phase2_mode = "none"
-    if straggler_idx:
-        heartbeat()  # phase 2 starts: reset the stall clock
-        idx = np.asarray(straggler_idx)
-        # Difficulty-sorted compaction (see backends.tpu.difficulty_order;
-        # the chunk-file patch below indexes by idx, so order is free).
-        from tsspark_tpu.backends.tpu import difficulty_order
-        order = difficulty_order(np.concatenate(straggler_gn))
-        idx = idx[order]
-        theta_cat = np.concatenate(straggler_theta, axis=0)[order]
-        # Stragglers get the GN-diagonal initial metric (ill-conditioned
-        # tail; see SolverConfig.precond) and the full solve depth, through
-        # THE SAME compiled program as phase 1: the batch is padded to the
-        # fixed phase-1 chunk size (inert all-masked rows) and the phase
-        # differences ride the traced *_dynamic args, so no second program
-        # is ever compiled or warmed.
-        n_s = len(straggler_idx)
-        pad = (-n_s) % args.chunk
-        pad_rows = lambda a: np.concatenate(
-            [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
-        ) if pad else a
-
-        def host_gather():
-            """(y, mask, reg, init) rows for the host-side phase-2 paths
-            (~45 MB of copies the device-resident path never makes)."""
-            return (
-                pad_rows(np.ascontiguousarray(y[idx], np.float32)),
-                pad_rows(np.ascontiguousarray(mask[idx], np.float32)),
-                pad_rows(np.ascontiguousarray(reg[idx], np.float32)),
-                pad_rows(theta_cat.astype(np.float32)),
-            )
-
-        if segmented:
-            phase2_mode = "segmented"
-            y_s, m_s, r_s, init_s = host_gather()
-            # Bounded-dispatch mode: phase 2 keeps --segment's short
-            # per-segment dispatches (the reason segmented mode exists),
-            # via the static straggler backend.
-            state2 = backend._straggler_backend().fit(
-                ds, y_s, mask=m_s, regressors=r_s, init=init_s,
-            )
-            state2 = jax.tree.map(lambda a: np.asarray(a)[:n_s], state2)
-            jax.block_until_ready(jax.tree.leaves(state2)[0])
-        elif resident and all(
-            any(l2 <= int(g) < h2 for l2, (h2, _) in resident.items())
-            for g in idx
-        ):
-            phase2_mode = "resident"
-            # Device-resident gather: every straggler's chunk payload is
-            # still on device from phase 1, so the deep refit gathers its
-            # rows there — per sub-chunk the tunnel carries only a (c,)
-            # index vector and a (c, P) warm-start instead of a ~16 MB
-            # re-packed payload, and no host re-prep runs at all.  Only
-            # the ~n_s straggler rows are ever concatenated (per-chunk
-            # takes first, each chunk freed as it is consumed), so peak
-            # HBM stays near phase-1 levels.
-            import jax.numpy as jnp
-
-            from tsspark_tpu.models.prophet.design import (
-                PACKED_PER_SERIES_FIELDS,
-            )
-
-            def map_batch(p, fn):
-                upd = {
-                    k: fn(getattr(p, k)) for k in PACKED_PER_SERIES_FIELDS
-                }
-                if p.X_season.ndim == 3:  # per-series (conditional seas.)
-                    upd["X_season"] = fn(p.X_season)
-                return p._replace(**upd)
-
-            smalls, grouped, gather_ranges = [], [], []
-            for l2 in sorted(resident):
-                h2, payload2 = resident[l2]
-                sel = idx[(idx >= l2) & (idx < h2)]
-                if sel.size:
-                    local = jnp.asarray((sel - l2).astype(np.int32))
-                    smalls.append(map_batch(
-                        payload2,
-                        lambda a: jnp.take(a, local, axis=0),
-                    ))
-                    grouped.extend(int(g) for g in sel)
-                    gather_ranges.append((l2, h2))
-                del resident[l2]
-            cat_fields = PACKED_PER_SERIES_FIELDS + (
-                ("X_season",) if smalls[0].X_season.ndim == 3 else ()
-            )
-            strag = smalls[0]._replace(**{
-                k: jnp.concatenate(
-                    [getattr(s, k) for s in smalls], axis=0
-                ) for k in cat_fields
-            })
-            del smalls
-            pos_of = {g: i for i, g in enumerate(grouped)}
-            row_idx = np.asarray(
-                [pos_of[int(g)] for g in idx], np.int32
-            )
-
-            def gather_fit(ix, th):
-                # Eager device-side row gathers (a few small dispatches),
-                # then THE SAME compiled fit program as phase 1 — the
-                # gathered payload has phase 1's exact shapes/dtypes, so
-                # no new executable is ever compiled for phase 2.
-                packed_g = map_batch(
-                    strag, lambda a: jnp.take(a, ix, axis=0)
-                )
-                return fit_core_packed(
-                    packed_g, th, model.config, model.solver_config,
-                    reg_u8_cols=u8_cols,
-                    max_iters_dynamic=np.int32(args.max_iters),
-                    gn_precond_dynamic=np.bool_(True),
-                    use_theta0_dynamic=np.bool_(True),
-                )
-            th_parts, st_parts = [], []
-            for lo2 in range(0, n_s, args.chunk):
-                hi2 = min(lo2 + args.chunk, n_s)
-                ix = row_idx[lo2:hi2]
-                th = theta_cat[lo2:hi2].astype(np.float32)
-                if hi2 - lo2 < args.chunk:
-                    # Pad by repeating the first row: a duplicate of a row
-                    # already being solved adds no lockstep depth (unlike
-                    # arbitrary data) and its result is sliced away.
-                    rep = args.chunk - (hi2 - lo2)
-                    ix = np.concatenate([ix, np.repeat(ix[:1], rep)])
-                    th = np.concatenate(
-                        [th, np.repeat(th[:1], rep, axis=0)]
-                    )
-                th2, st2 = gather_fit(jnp.asarray(ix), jnp.asarray(th))
-                jax.block_until_ready(th2)
-                heartbeat()
-                th_parts.append(np.asarray(th2)[:hi2 - lo2])
-                st_parts.append(np.asarray(st2)[:, :hi2 - lo2])
-            del strag
-            # Scaling meta for the straggler rows comes from the chunk
-            # files — deterministic per series, so these are the exact
-            # values a host re-prep would recompute.  Rows are selected
-            # inside each file via its own (lo, hi) (no full-dataset
-            # concatenation, no positional-alignment assumption), in
-            # grouped order, then mapped back to difficulty order with
-            # the same row_idx the solves used.
-            meta_keys = ("y_scale", "floor", "ds_start", "ds_span",
-                         "reg_mean", "reg_std", "changepoints")
-            meta_grouped = {
-                k: np.concatenate([
-                    files[(l2, h2)][k][idx[(idx >= l2) & (idx < h2)] - l2]
-                    for (l2, h2) in gather_ranges
-                ]) for k in meta_keys
-            }
-            state2 = fitstate_from_packed(
-                np.concatenate(th_parts, axis=0),
-                np.concatenate(st_parts, axis=1),
-                ScalingMeta(**{
-                    k: v[row_idx[:n_s]] for k, v in meta_grouped.items()
-                }),
-            )
-        else:
-            # Straggler sub-chunk prep (numpy design build + packing,
-            # ~1 s each) prefetched on threads so it overlaps the deep
-            # device solves, same pattern as the phase-1 loop.
-            phase2_mode = "host"
-            # Partial-coverage fallback: the retained payloads (~500 MB
-            # HBM) serve no purpose here — release them before the deep
-            # solves raise peak memory.
-            resident.clear()
-            y_s, m_s, r_s, init_s = host_gather()
-            lows = list(range(0, n_s + pad, args.chunk))
-
-            def prep2(lo2):
-                hi2 = lo2 + args.chunk
-                data2, meta2 = model.prepare(
-                    ds, y_s[lo2:hi2], mask=m_s[lo2:hi2],
-                    regressors=r_s[lo2:hi2], as_numpy=True,
-                )
-                packed2, _ = pack_fit_data(
-                    data2, meta2, ds, reg_u8_cols=u8_cols,
-                    collapse_cap=True,
-                )
-                return packed2, meta2
-
-            subs = []
-            with ThreadPoolExecutor(max_workers=2) as pool2:
-                futs2 = {
-                    j: pool2.submit(prep2, lows[j])
-                    for j in range(min(prefetch_depth, len(lows)))
-                }
-                for j, lo2 in enumerate(lows):
-                    packed2, meta2 = futs2.pop(j).result()
-                    nxt = j + prefetch_depth
-                    if nxt < len(lows):
-                        futs2[nxt] = pool2.submit(prep2, lows[nxt])
-                    # Warm continuation only: phase 2's set is series
-                    # still PROGRESSING at the phase-1 cap (stuck exits
-                    # carry status FLOOR/STALLED and are the rescue
-                    # path's job, not phase 2's) — measured round 4, a
-                    # fresh-ridge restart won 0/120 of these with zero
-                    # total gain, so the second solve bought nothing at
-                    # double the phase-2 cost.
-                    th2, st2 = fit_core_packed(
-                        packed2, init_s[lo2:lo2 + args.chunk],
-                        model.config, model.solver_config,
-                        reg_u8_cols=u8_cols,
-                        max_iters_dynamic=np.int32(args.max_iters),
-                        gn_precond_dynamic=np.bool_(True),
-                        use_theta0_dynamic=np.bool_(True),
-                    )
-                    jax.block_until_ready(th2)
-                    heartbeat()
-                    subs.append(fitstate_from_packed(
-                        np.asarray(th2), st2, meta2
-                    ))
-            state2 = jax.tree.map(
-                lambda *xs: np.concatenate(xs, axis=0)[:n_s], *subs
-            )
-        for (lo, hi), z in files.items():
-            if z.get("phase2") is not None:
-                continue
-            in_chunk = np.flatnonzero((idx >= lo) & (idx < hi))
-            local = idx[in_chunk] - lo
-            state = FitState(
-                theta=z["theta"], loss=z["loss"], grad_norm=z["grad_norm"],
-                converged=z["converged"], n_iters=z["n_iters"],
-                status=z["status"],
-                meta=ScalingMeta(
-                    y_scale=z["y_scale"], floor=z["floor"],
-                    ds_start=z["ds_start"], ds_span=z["ds_span"],
-                    reg_mean=z["reg_mean"], reg_std=z["reg_std"],
-                    changepoints=z["changepoints"],
-                ),
-            )
-            sub = jax.tree.map(lambda a: np.asarray(a)[in_chunk], state2)
-            patched = patch_state(state, local, sub)
-            _save_chunk_atomic(
-                args.out, lo, hi, patched,
-                extra_arrays={"phase2": np.asarray(1)},
-            )
-    with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
-        fh.write(json.dumps({
-            "phase2_s": round(time.time() - t0, 3),
-            "stragglers": len(straggler_idx),
-            "phase2_mode": phase2_mode,
-        }) + "\n")
-    with open(marker, "w") as fh:
-        fh.write("ok\n")
-    return 0
-
-
 # --------------------------------------------------------------------------
 # profile mode: trace one solver segment at bench shape
 # --------------------------------------------------------------------------
@@ -859,7 +132,7 @@ def profile_main(args) -> None:
     per-objective-eval).  The trace goes to --profile-dir for TensorBoard's
     profile plugin; the breakdown answers "where do the milliseconds go"
     without opening it (round-2 verdict item 3)."""
-    jax = _setup_jax_child()
+    jax = orchestrate._setup_jax_child()
     import numpy as np
 
     from tsspark_tpu.config import SolverConfig
@@ -924,7 +197,7 @@ def profile_main(args) -> None:
 
 def eval_worker(args) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    jax = _setup_jax_child()
+    jax = orchestrate._setup_jax_child()
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
@@ -980,114 +253,12 @@ def eval_worker(args) -> int:
 
 
 # --------------------------------------------------------------------------
-# parent orchestrator (no JAX)
+# parent orchestrator (no JAX): benchmark-specific wiring only
 # --------------------------------------------------------------------------
 
-# Live worker subprocesses: the SIGTERM handler must kill them or an orphan
-# fit child keeps holding the TPU tunnel after the parent is gone.
-_CHILDREN: set = set()
-
-
-def _tunnel_preflight(timeout: float = 90.0) -> bool:
-    """Client-creation watchdog: a wedged TPU tunnel blocks ``jax.devices()``
-    forever (observed repeatedly on this image).  Probe it in a disposable
-    subprocess so the decision takes <= ``timeout`` seconds instead of a
-    fit-worker stall cycle."""
-    code = (
-        "import jax, jax.numpy as jnp\n"
-        "jax.devices()\n"
-        "x = jnp.ones((128, 128))\n"
-        "(x @ x).block_until_ready()\n"
-        "print('tunnel-ok', flush=True)\n"
-    )
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return "tunnel-ok" in (r.stdout or "")
-
-
-def _spawn(mode: str, args, extra: list, timeout: Optional[float] = None,
-           progress_timeout: Optional[float] = None) -> int:
-    """Run a worker; kill it on overall timeout OR when no new chunk result
-    has appeared for ``progress_timeout`` seconds (a wedged TPU tunnel blocks
-    client creation forever — stalling is indistinguishable from working
-    except by watching the output directory)."""
-    cmd = [sys.executable, os.path.abspath(__file__), mode,
-           "--data", args._data_dir, "--out", args._out_dir] + extra
-    env = dict(os.environ)
-    if mode == "--_eval":
-        env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.Popen(cmd, stdout=sys.stderr, env=env)
-    _CHILDREN.add(proc)
-    start = time.time()
-    last_progress = start
-    n_start = len(_completed_ranges(args._out_dir))
-    n_chunks = n_start
-    hb_path = os.path.join(args._out_dir, "heartbeat")
-    hb_last = os.path.getmtime(hb_path) if os.path.exists(hb_path) else 0.0
-    any_progress = False
-    try:
-        while True:
-            try:
-                return proc.wait(timeout=10.0)
-            except subprocess.TimeoutExpired:
-                pass
-            now = time.time()
-            n_now = len(_completed_ranges(args._out_dir))
-            if n_now > n_chunks:
-                n_chunks, last_progress = n_now, now
-                any_progress = True
-            # Per-dispatch heartbeats from the fit worker also count: the
-            # phase-2 straggler pass rewrites existing chunks (no new files),
-            # and a fresh compile shows nothing for minutes — both are
-            # liveness, not a stall.
-            hb_now = os.path.getmtime(hb_path) if os.path.exists(hb_path) \
-                else 0.0
-            if hb_now > hb_last:
-                hb_last, last_progress = hb_now, now
-                any_progress = True
-            timed_out = timeout is not None and now - start > timeout
-            # Until THIS worker shows its first sign of life it may be
-            # cold-compiling its first dispatch — give it triple the steady
-            # allowance, but no more (round 2 lost 680 s to a silent stall).
-            allowance = (progress_timeout if any_progress
-                         else None if progress_timeout is None
-                         else 3.0 * progress_timeout)
-            stalled = (allowance is not None
-                       and now - last_progress > allowance)
-            if timed_out or stalled:
-                why = "timed out" if timed_out else "stalled (no new chunk)"
-                print(f"[bench] worker {why} after {round(now - start)}s",
-                      file=sys.stderr)
-                proc.kill()
-                proc.wait()
-                return -9
-    finally:
-        _CHILDREN.discard(proc)
-
-
-def _completed_ranges(out_dir: str):
-    done = []
-    for f in sorted(glob.glob(os.path.join(out_dir, "chunk_*.npz"))):
-        base = os.path.basename(f)[len("chunk_"):-len(".npz")]
-        lo, hi = base.split("_")
-        done.append((int(lo), int(hi)))
-    return done
-
-
-def _missing_ranges(done, total):
-    missing, cur = [], 0
-    for lo, hi in sorted(done):
-        if lo > cur:
-            missing.append((cur, lo))
-        cur = max(cur, hi)
-    if cur < total:
-        missing.append((cur, total))
-    return missing
+# Side (nonblocking CPU) children the bench runs during tunnel-down time;
+# the SIGTERM handler must kill them along with orchestrate's workers.
+_SIDE: dict = {"eval": None, "prep": None}
 
 
 def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
@@ -1111,7 +282,7 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
     phase2_s = sum(t.get("phase2_s", 0.0) for t in times)
     stragglers = sum(t.get("stragglers", 0) for t in times)
     fit_s = sum(t.get("fit_s", 0.0) for t in times) + phase2_s
-    done = _completed_ranges(args._out_dir)
+    done = orchestrate.completed_ranges(args._out_dir)
     n_done = sum(hi - lo for lo, hi in done)
 
     smape = None
@@ -1247,6 +418,7 @@ def main() -> None:
     deadline = t_wall0 + BUDGET_S
     import numpy as np
 
+    from tsspark_tpu.config import SolverConfig
     from tsspark_tpu.data import datasets
 
     # Persistent, code-fingerprinted scratch: a run killed by the harness
@@ -1285,6 +457,10 @@ def main() -> None:
         if time.time() - newest > 6 * 3600:
             shutil.rmtree(d, ignore_errors=True)
     os.makedirs(args._out_dir, exist_ok=True)
+    orchestrate.save_run_config(
+        args._out_dir, _model_config(),
+        SolverConfig(max_iters=args.max_iters),
+    )
 
     # From here on a SIGTERM/SIGINT (harness timeout) still produces the one
     # summary line from whatever chunks have landed; the scratch dir is
@@ -1293,11 +469,13 @@ def main() -> None:
              "probes": {"n": 0, "fails": 0, "last_t": 0.0}}
 
     def _on_signal(signum, frame):
-        for proc in list(_CHILDREN):  # free the TPU tunnel before exiting
-            try:
-                proc.kill()
-            except OSError:
-                pass
+        orchestrate.kill_children()  # free the TPU tunnel before exiting
+        for proc in _SIDE.values():
+            if proc is not None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
         _emit(_build_summary(args, t_wall0, state["gen_s"], state["chunk"],
                              state["retries"], note=f"signal {signum}",
                              probes=state["probes"]))
@@ -1346,22 +524,6 @@ def main() -> None:
     args._data_dir = cache
     state["gen_s"] = gen_s = time.time() - gen0
 
-    note = None
-    side = {"eval": None, "prep": None}  # overlapped CPU-side children
-    probes = state["probes"]
-
-    def _probe_log(ok: bool, dur: float) -> None:
-        probes["n"] += 1
-        probes["fails"] += 0 if ok else 1
-        probes["last_t"] = round(time.time() - t_wall0, 1)
-        try:
-            with open(os.path.join(args._out_dir, "probes.jsonl"), "a") as fh:
-                fh.write(json.dumps({
-                    "t": probes["last_t"], "ok": ok, "dur_s": round(dur, 1),
-                }) + "\n")
-        except OSError:
-            pass
-
     def _eval_covered() -> bool:
         """eval.json exists AND covers the series the final eval would:
         an overlapped eval started mid-wedge may have scored only the
@@ -1373,7 +535,8 @@ def main() -> None:
         except (OSError, ValueError):
             return False
         n_done = sum(
-            hi - lo for lo, hi in _completed_ranges(args._out_dir)
+            hi - lo
+            for lo, hi in orchestrate.completed_ranges(args._out_dir)
         )
         return n_done > 0 and have >= min(512, n_done)
 
@@ -1386,136 +549,68 @@ def main() -> None:
         unused."""
         if _eval_covered():
             return 25.0
-        if not _completed_ranges(args._out_dir):
+        if not orchestrate.completed_ranges(args._out_dir):
             return 25.0  # nothing to eval; probing is the best use of time
-        if side["eval"] is not None and side["eval"].poll() is None:
+        ep = _SIDE.get("eval")
+        if ep is not None and ep.poll() is None:
             return 60.0  # eval already running concurrently
         return RESERVE_S
 
-    def _side_child(kind: str, extra: list) -> None:
-        """Nonblocking CPU child (--_eval / --_prep), JAX forced to CPU so
-        a wedged TPU tunnel cannot block it.  At most one of each kind."""
-        proc = side.get(kind)
+    def _side_child(kind: str, cmd: list) -> None:
+        """Nonblocking CPU child, JAX forced to CPU so a wedged TPU tunnel
+        cannot block it.  At most one of each kind."""
+        proc = _SIDE.get(kind)
         if proc is not None and proc.poll() is None:
             return
-        cmd = [sys.executable, os.path.abspath(__file__), f"--_{kind}",
-               "--data", args._data_dir, "--out", args._out_dir] + extra
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        side[kind] = subprocess.Popen(cmd, stdout=sys.stderr, env=env)
-        _CHILDREN.add(side[kind])
+        _SIDE[kind] = subprocess.Popen(
+            cmd, stdout=sys.stderr,
+            env=orchestrate._child_env(force_cpu=True),
+        )
 
     def _overlap_cpu_work() -> None:
         """Tunnel-down time is spent on the CPU-side work the run needs
         anyway: eval of already-landed chunks and pre-packing pending chunk
         payloads, so a late tunnel recovery converts into chunks instantly."""
-        done = _completed_ranges(args._out_dir)
+        done = orchestrate.completed_ranges(args._out_dir)
         n_done = sum(hi - lo for lo, hi in done)
         if n_done and not _eval_covered():
-            _side_child("eval", ["--n-eval", str(min(512, n_done))])
-        if _missing_ranges(done, args.series):
+            _side_child("eval", [
+                sys.executable, os.path.abspath(__file__), "--_eval",
+                "--data", args._data_dir, "--out", args._out_dir,
+                "--n-eval", str(min(512, n_done)),
+            ])
+        if orchestrate.missing_ranges(done, args.series):
             _side_child("prep", [
+                sys.executable, "-m", "tsspark_tpu.orchestrate", "--_prep",
+                "--data", args._data_dir, "--out", args._out_dir,
                 "--series", str(args.series),
                 "--chunk", str(state["chunk"]),
-                "--max-iters", str(args.max_iters),
                 "--max-ahead", "6",
             ])
 
-    # Probe before the first attempt (tunnel health unknown) and after any
-    # attempt that died without progress; a worker that just produced
-    # chunks has proven the tunnel alive, so skip the probe then.
-    check_tunnel = os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
-    probe_sleep = 5.0
-    while True:
-        missing = _missing_ranges(_completed_ranges(args._out_dir), args.series)
-        phase2_pending = (
-            0 < args.phase1_iters < args.max_iters
-            and not os.path.exists(
-                os.path.join(args._out_dir, "phase2_done")
-            )
-        )
-        if not missing and not phase2_pending:
-            break
-        remaining = deadline - time.time()
-        if remaining < _reserve():
-            note = "fit budget exhausted; partial"
-            print(f"[bench] {note}", file=sys.stderr)
-            break
-        # Client-creation watchdog: don't hand the range to a fit worker
-        # that will hang in jax.devices() for the whole stall allowance.
-        # A wedged tunnel recovers on its own schedule, so probing NEVER
-        # gives up while budget remains (round-3 verdict: quitting after
-        # three probes threw away ~500 s of a 900 s budget) — cheap ~30 s
-        # probes loop until deadline - reserve, with the wait overlapped
-        # by the CPU-side eval/prep children.
-        if check_tunnel:
-            t_probe = time.time()
-            # Escalating timeout: cheap 30 s probes while wedged, but a
-            # healthy tunnel whose client creation is merely SLOW (30-90 s
-            # has been observed) must not fail every probe forever — each
-            # consecutive failure buys the next probe more patience, up
-            # to the old 90 s allowance.
-            patience = min(30.0 + 15.0 * probes.get("consec", 0), 90.0)
-            ok = _tunnel_preflight(
-                timeout=min(patience, max(10.0, remaining - _reserve()))
-            )
-            probes["consec"] = 0 if ok else probes.get("consec", 0) + 1
-            _probe_log(ok, time.time() - t_probe)
-            if not ok:
-                print(
-                    f"[bench] tunnel probe failed "
-                    f"({probes['fails']}/{probes['n']} probes failed, "
-                    f"{round(deadline - time.time())}s of budget left; "
-                    f"probing until the reserve)",
-                    file=sys.stderr,
-                )
-                _overlap_cpu_work()
-                time.sleep(min(
-                    probe_sleep,
-                    max(0.0, deadline - time.time() - _reserve()),
-                ))
-                probe_sleep = min(probe_sleep * 1.5, 30.0)
-                continue
-            probe_sleep = 5.0
-            check_tunnel = False
-        remaining = deadline - time.time()
-        budget = max(60.0, remaining - _reserve())
-        before = len(_completed_ranges(args._out_dir))
-        lo = missing[0][0] if missing else 0
-        hi = missing[-1][1] if missing else args.series
-        rc = _spawn("--_fit", args, [
-            "--lo", str(lo), "--hi", str(hi),
-            "--chunk", str(state["chunk"]), "--max-iters", str(args.max_iters),
-            "--segment", str(args.segment),
-            "--series", str(args.series),
-            "--phase1-iters", str(args.phase1_iters),
-        ] + (["--no-phase1-tune"] if args.no_phase1_tune else []),
-            timeout=budget, progress_timeout=90.0)
-        if rc == 0:
-            continue  # re-scan; loop exits when nothing is missing
-        state["retries"] += 1
-        made_progress = len(_completed_ranges(args._out_dir)) > before
-        # A death with zero progress puts the tunnel itself under suspicion.
-        check_tunnel = (not made_progress and
-                        os.environ.get("JAX_PLATFORMS", "") not in ("cpu",))
-        # Halve the chunk only when a PHASE-1 attempt made no progress at
-        # all — halving targets too-big-program crashes.  A straggler crash
-        # mid-run keeps the size that was evidently working, and a death in
-        # the phase-2 pass (all chunks already exist) says nothing about
-        # chunk size (changing it would only force a fresh compile shape).
-        chunk = state["chunk"]
-        new_chunk = chunk if (made_progress or not missing) \
-            else max(chunk // 2, MIN_CHUNK)
-        print(f"[bench] fit worker died (rc={rc}), chunk {chunk} -> "
-              f"{new_chunk}, retry {state['retries']}", file=sys.stderr)
-        # No retry cap: a crash loop is re-probed (check_tunnel above) and
-        # retried until the budget's reserve — the driver deadline, not a
-        # counter, decides when to stop (round-3 verdict item 1).
-        state["chunk"] = new_chunk
-        time.sleep(10.0)  # let the crashed TPU worker restart cleanly
+    result = orchestrate.run_resilient(
+        data_dir=args._data_dir,
+        out_dir=args._out_dir,
+        series=args.series,
+        chunk=args.chunk,
+        min_chunk=MIN_CHUNK,
+        segment=args.segment,
+        phase1_iters=args.phase1_iters,
+        no_phase1_tune=args.no_phase1_tune,
+        deadline=deadline,
+        reserve=_reserve,
+        on_idle=_overlap_cpu_work,
+        progress_timeout=90.0,
+        state=state,
+    )
+    note = None if result.get("complete") else "fit budget exhausted; partial"
+    if note:
+        print(f"[bench] {note}", file=sys.stderr)
 
-    n_done = sum(hi - lo for lo, hi in _completed_ranges(args._out_dir))
-    ep = side.get("eval")
+    n_done = sum(
+        hi - lo for lo, hi in orchestrate.completed_ranges(args._out_dir)
+    )
+    ep = _SIDE.get("eval")
     if ep is not None and ep.poll() is None:
         # An overlapped eval is already in flight; give it the remaining
         # budget instead of starting a duplicate.
@@ -1527,9 +622,20 @@ def main() -> None:
     # scored (eval.json records its n_eval; the worker overwrites it).
     if n_done and not _eval_covered():
         eval_budget = max(60.0, deadline - time.time() - 15.0)
-        _spawn("--_eval", args, ["--n-eval", str(min(512, n_done))],
-               timeout=eval_budget)
-    pp = side.get("prep")
+        cmd = [sys.executable, os.path.abspath(__file__), "--_eval",
+               "--data", args._data_dir, "--out", args._out_dir,
+               "--n-eval", str(min(512, n_done))]
+        env = orchestrate._child_env(force_cpu=True)
+        proc = subprocess.Popen(cmd, stdout=sys.stderr, env=env)
+        orchestrate._CHILDREN.add(proc)
+        try:
+            proc.wait(timeout=eval_budget)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        finally:
+            orchestrate._CHILDREN.discard(proc)
+    pp = _SIDE.get("prep")
     if pp is not None and pp.poll() is None:
         pp.kill()
 
@@ -1545,22 +651,11 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] in ("--_fit", "--_eval", "--_prep"):
-        mode = sys.argv.pop(1)
+    if len(sys.argv) > 1 and sys.argv[1] == "--_eval":
+        sys.argv.pop(1)
         ap = argparse.ArgumentParser()
         ap.add_argument("--data", required=True)
         ap.add_argument("--out", required=True)
-        ap.add_argument("--lo", type=int, default=0)
-        ap.add_argument("--hi", type=int, default=0)
-        ap.add_argument("--chunk", type=int, default=2048)
-        ap.add_argument("--max-iters", type=int, default=120)
-        ap.add_argument("--segment", type=int, default=24)
-        ap.add_argument("--series", type=int, default=0)
-        ap.add_argument("--phase1-iters", type=int, default=0)
-        ap.add_argument("--no-phase1-tune", action="store_true")
         ap.add_argument("--n-eval", type=int, default=512)
-        ap.add_argument("--max-ahead", type=int, default=6)
-        a = ap.parse_args()
-        sys.exit({"--_fit": fit_worker, "--_eval": eval_worker,
-                  "--_prep": prep_worker}[mode](a))
+        sys.exit(eval_worker(ap.parse_args()))
     main()

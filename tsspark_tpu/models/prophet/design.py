@@ -153,6 +153,21 @@ def _indicator_reg_cols(x_reg: np.ndarray) -> Tuple[int, ...]:
     )
 
 
+def packable_batch(ds, mask) -> bool:
+    """THE packed-transit eligibility predicate: a shared (T,) calendar
+    grid and an exact 0/1 mask (fractional observation weights need the
+    plain FitData path).  One definition shared by ProphetModel.fit,
+    TpuBackend's mesh routing, and the resilient-fit gate so the
+    single-device, sharded, and orchestrated paths can never decide
+    packability differently."""
+    if np.asarray(ds).ndim != 1:
+        return False
+    if mask is None:
+        return True  # prepare derives an isfinite mask, exactly 0/1
+    m = np.asarray(mask)
+    return bool(np.all((m == 0.0) | (m == 1.0)))
+
+
 def pack_fit_data(
     data: FitData,
     meta: ScalingMeta,

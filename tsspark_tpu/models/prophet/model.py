@@ -24,6 +24,7 @@ from tsspark_tpu.models.prophet.design import (
     FitData,
     ScalingMeta,
     pack_fit_data,
+    packable_batch,
     prepare_fit_data,
 )
 from tsspark_tpu.models.prophet.init import curvature_diag, initial_theta
@@ -385,11 +386,9 @@ class ProphetModel:
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
             regressors=regressors, conditions=conditions, as_numpy=True,
         )
-        mask_np = np.asarray(data.mask)
         packable = (
-            np.asarray(ds).ndim == 1
-            and not (iter_segment and iter_segment < self.solver_config.max_iters)
-            and bool(np.all((mask_np == 0.0) | (mask_np == 1.0)))
+            not (iter_segment and iter_segment < self.solver_config.max_iters)
+            and packable_batch(ds, data.mask)
         )
         dynamic = any(
             v is not None
