@@ -619,22 +619,21 @@ def main() -> None:
         except subprocess.TimeoutExpired:
             ep.kill()
     # Re-run when coverage grew past what an overlapped mid-wedge eval
-    # scored (eval.json records its n_eval; the worker overwrites it).
+    # scored (eval.json records its n_eval; the worker overwrites it) —
+    # through the same _side_child plumbing, waited on with the leftover
+    # budget.
     if n_done and not _eval_covered():
-        eval_budget = max(60.0, deadline - time.time() - 15.0)
-        cmd = [sys.executable, os.path.abspath(__file__), "--_eval",
-               "--data", args._data_dir, "--out", args._out_dir,
-               "--n-eval", str(min(512, n_done))]
-        env = orchestrate._child_env(force_cpu=True)
-        proc = subprocess.Popen(cmd, stdout=sys.stderr, env=env)
-        orchestrate._CHILDREN.add(proc)
+        _side_child("eval", [
+            sys.executable, os.path.abspath(__file__), "--_eval",
+            "--data", args._data_dir, "--out", args._out_dir,
+            "--n-eval", str(min(512, n_done)),
+        ])
+        ep = _SIDE.get("eval")
         try:
-            proc.wait(timeout=eval_budget)
+            ep.wait(timeout=max(60.0, deadline - time.time() - 15.0))
         except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait()
-        finally:
-            orchestrate._CHILDREN.discard(proc)
+            ep.kill()
+            ep.wait()
     pp = _SIDE.get("prep")
     if pp is not None and pp.poll() is None:
         pp.kill()

@@ -350,3 +350,33 @@ def test_fit_resilient_public_api(tmp_path, monkeypatch):
         np.asarray(state.theta), np.asarray(mem.theta), rtol=2e-4,
         atol=2e-4,
     )
+    # Scratch-resume guard: the same scratch_dir with DIFFERENT data must
+    # refuse to resume instead of silently mixing chunk results.
+    with pytest.raises(ValueError, match="DIFFERENT resilient run"):
+        orchestrate.fit_resilient(
+            cfg, solver, batch.ds, y + 1.0, mask=batch.mask,
+            regressors=batch.regressors, chunk=32, phase1_iters=6,
+            no_phase1_tune=True, scratch_dir=str(tmp_path / "s"),
+        )
+
+
+def test_run_resilient_gives_up_on_deterministic_failure(tmp_path,
+                                                         monkeypatch):
+    """A child that dies with ZERO progress every attempt (here: the data
+    dir does not exist) is a deterministic failure, not a wedge — with no
+    deadline the parent must raise after max_fruitless_retries instead of
+    respawning forever."""
+    from tsspark_tpu.config import SolverConfig
+
+    out_dir = str(tmp_path / "out")
+    orchestrate.save_run_config(
+        out_dir, _model_config(), SolverConfig(max_iters=10)
+    )
+    monkeypatch.setenv("TSSPARK_TEST_CRASH_AFTER", "0")  # short retry sleep
+    with pytest.raises(RuntimeError, match="consecutive"):
+        orchestrate.run_resilient(
+            data_dir=str(tmp_path / "no_such_data"), out_dir=out_dir,
+            series=64, chunk=32, min_chunk=32, segment=0, phase1_iters=0,
+            deadline=None, progress_timeout=120.0,
+            probe_accelerator=False, max_fruitless_retries=1,
+        )

@@ -123,6 +123,33 @@ def test_streaming_loop_warm_starts_and_improves():
     assert np.abs(fc.yhat.to_numpy() - want).mean() < 0.5
 
 
+def test_streaming_batch_latencies_and_cold_mode():
+    """RefitStats records one latency per micro-batch, and
+    warm_start=False forces the ridge-init path on every refit (the
+    warm-vs-cold instrument eval config 5 uses) while still converging
+    to a good forecast."""
+    df_full = _series_df(360, seed=3)
+    batches = [
+        df_full.iloc[:300],
+        df_full.iloc[300:330],
+        df_full.iloc[330:360],
+    ]
+    sf = StreamingForecaster(
+        CFG, SolverConfig(max_iters=60), backend="tpu", warm_start=False,
+    )
+    stats = sf.run(InMemorySource(batches))
+    assert len(stats.batch_seconds) == 3
+    assert all(s > 0 for s in stats.batch_seconds)
+    assert abs(sum(stats.batch_seconds) - stats.fit_seconds) < 1e-6
+    # Every refit is a forced cold start; none consult the store.
+    assert stats.cold_starts == 3
+    assert stats.warm_starts == 0
+    fc = sf.forecast(["s0"], horizon=14, num_samples=0)
+    t = fc.ds.to_numpy()
+    want = 10 + 0.02 * t + 1.5 * np.sin(2 * np.pi * t / 7)
+    assert np.abs(fc.yhat.to_numpy() - want).mean() < 0.5
+
+
 def test_streaming_multi_series_and_new_series_midstream():
     b1 = pd.concat([_series_df(120, "a", 1), _series_df(120, "b", 2)])
     b2 = pd.concat([

@@ -131,7 +131,13 @@ def config4_wiki_logistic(backend="tpu", scale=1.0) -> Dict:
 
 
 def config5_streaming(backend="tpu", scale=1.0) -> Dict:
-    """Kafka-style micro-batch incremental refit with warm starts."""
+    """Kafka-style micro-batch incremental refit with warm starts.
+
+    Records the full streaming story (round-4 verdict, Missing #5):
+    per-micro-batch refit latency, warm-vs-cold start quality AND
+    latency on the identical batch schedule, and at-least-once
+    semantics under a simulated crash (the last micro-batch redelivered
+    un-committed — the refit must be idempotent)."""
     import pandas as pd
 
     n_days = max(150, int(730 * scale))
@@ -154,30 +160,93 @@ def config5_streaming(backend="tpu", scale=1.0) -> Dict:
         df[(df.ds >= warm_len + k * micro) & (df.ds < warm_len + (k + 1) * micro)]
         for k in range(3)
     ]
+    batches = [b for b in batches if len(b)]
     cfg = ProphetConfig(
         seasonalities=(SeasonalityConfig("weekly", 7.0, 3),), n_changepoints=10
     )
+    sids = [f"s{i}" for i in range(n_series)]
+
+    def forecast_smape(sf):
+        fc = sf.forecast(sids, horizon=14, num_samples=0)
+        t = fc.ds.to_numpy().reshape(n_series, 14)
+        sid = np.arange(n_series)[:, None] + 1
+        want = 20 * sid + 0.05 * t + 3 * np.sin(2 * np.pi * t / 7)
+        return float(np.mean(np.asarray(metrics.smape(
+            jnp.asarray(want),
+            jnp.asarray(fc.yhat.to_numpy().reshape(n_series, 14)),
+        ))))
+
+    def lat(stats):
+        b = np.asarray(stats.batch_seconds)
+        return {
+            "per_batch_s": [round(float(x), 3) for x in b],
+            "mean_s": round(float(b.mean()), 3),
+            "p50_s": round(float(np.median(b)), 3),
+            "max_s": round(float(b.max()), 3),
+        }
+
+    # Throwaway pass to populate the jit cache: each micro-batch's union
+    # grid has its own (B, T) shape, so the FIRST schedule pays a compile
+    # per batch.  Without this, whichever of the warm/cold runs goes
+    # first absorbs every compile and the latency comparison measures the
+    # cache, not the solver (observed: 13.1 s vs 0.3 s "speedup" that was
+    # 100% compilation).
+    StreamingForecaster(
+        cfg, SolverConfig(max_iters=60), backend=backend
+    ).run(InMemorySource(batches))
+
     sf = StreamingForecaster(cfg, SolverConfig(max_iters=60), backend=backend)
     t0 = time.time()
-    stats = sf.run(InMemorySource([b for b in batches if len(b)]))
+    stats = sf.run(InMemorySource(batches))
     total_s = time.time() - t0
-    fc = sf.forecast([f"s{i}" for i in range(n_series)], horizon=14,
-                     num_samples=0)
-    t = fc.ds.to_numpy().reshape(n_series, 14)
-    sid = np.arange(n_series)[:, None] + 1
-    want = 20 * sid + 0.05 * t + 3 * np.sin(2 * np.pi * t / 7)
-    smape_fc = float(
-        np.mean(np.asarray(metrics.smape(
-            jnp.asarray(want), jnp.asarray(fc.yhat.to_numpy().reshape(n_series, 14))
-        )))
+    smape_fc = forecast_smape(sf)
+    # Snapshot BEFORE the crash-replay below mutates sf.stats in place.
+    n_batches = stats.micro_batches
+    n_warm, n_cold = stats.warm_starts, stats.cold_starts
+    latency = lat(stats)
+
+    # Warm-vs-cold on the IDENTICAL schedule: same batches, warm-start
+    # transfer disabled, so every refit pays the ridge-init path.  The
+    # steady-state comparison is the incremental batches (index >= 1) —
+    # batch 0 is a cold start in both runs by construction.
+    sf_cold = StreamingForecaster(
+        cfg, SolverConfig(max_iters=60), backend=backend, warm_start=False,
     )
+    stats_cold = sf_cold.run(InMemorySource(batches))
+    smape_cold = forecast_smape(sf_cold)
+    steady = np.asarray(stats.batch_seconds[1:n_batches])
+    steady_cold = np.asarray(stats_cold.batch_seconds[1:])
+
+    # At-least-once under crash: redeliver the final micro-batch as an
+    # un-committed replay (offset never acknowledged -> the source hands
+    # it out again).  The history store dedups and the refit re-lands the
+    # same parameters, so forecasts must not move.
+    sf.process(batches[-1])
+    smape_replay = forecast_smape(sf)
+    fc_delta = abs(smape_replay - smape_fc)
+
     return {
-        "micro_batches": stats.micro_batches,
-        "warm_starts": stats.warm_starts,
-        "cold_starts": stats.cold_starts,
+        "micro_batches": n_batches,
+        "warm_starts": n_warm,
+        "cold_starts": n_cold,
         "total_seconds": round(total_s, 3),
         "smape_forecast": round(smape_fc, 3),
         "n_series": n_series,
+        "refit_latency": latency,
+        "warm_vs_cold": {
+            "smape_warm": round(smape_fc, 3),
+            "smape_cold": round(smape_cold, 3),
+            "steady_latency_warm_mean_s": round(float(steady.mean()), 3)
+            if steady.size else None,
+            "steady_latency_cold_mean_s": round(float(steady_cold.mean()), 3)
+            if steady_cold.size else None,
+            "cold_starts_forced": stats_cold.cold_starts,
+        },
+        "crash_replay": {
+            "redelivered_batches": 1,
+            "smape_delta_after_replay": round(fc_delta, 6),
+            "idempotent": bool(fc_delta < 1e-3),
+        },
     }
 
 

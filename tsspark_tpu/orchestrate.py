@@ -298,6 +298,21 @@ def _pad_chunk_rows(a, lo, hi, chunk, fill=0.0):
     return out
 
 
+def _chunk_mask(y_c, mask, lo, hi, chunk):
+    """The chunk's (chunk, T) mask: the user's rows when given, else
+    derived from the chunk's own y — with the PAD region forced to zero
+    either way.  Without the explicit derivation, prepare's isfinite
+    fallback would see the zero-filled pad rows as fully-OBSERVED
+    constant-zero series and spend real lockstep solver work on them."""
+    import numpy as np
+
+    if mask is not None:
+        return _pad_chunk_rows(mask, lo, hi, chunk)
+    m = np.zeros(y_c.shape, np.float32)
+    m[:hi - lo] = np.isfinite(y_c[:hi - lo])
+    return m
+
+
 # --------------------------------------------------------------------------
 # fit worker (accelerator child)
 # --------------------------------------------------------------------------
@@ -398,8 +413,9 @@ def fit_worker(args) -> int:
         # as_numpy: a prep thread must not issue device transfers — they
         # would queue behind the in-flight fit program and re-serialize
         # the pipeline the prefetch exists to overlap.
+        y_c = rows(y, lo, hi)
         data, meta = model.prepare(
-            ds, rows(y, lo, hi), mask=rows(mask, lo, hi),
+            ds, y_c, mask=_chunk_mask(y_c, mask, lo, hi, args.chunk),
             regressors=rows(reg, lo, hi), cap=rows(cap, lo, hi, fill=1.0),
             floor=rows(floor, lo, hi), as_numpy=True,
         )
@@ -620,14 +636,22 @@ def fit_worker(args) -> int:
 
         def host_gather():
             """(y, mask, reg, cap, floor, init) rows for the host-side
-            phase-2 paths (copies the device-resident path never makes)."""
+            phase-2 paths (copies the device-resident path never makes).
+            The isfinite fallback mask is derived from the GATHERED rows
+            only — materializing it over the whole (possibly mmap'd)
+            dataset to read back a few hundred stragglers would force
+            the full y into memory."""
             g = lambda a: None if a is None else pad_rows(
                 np.ascontiguousarray(a[idx], np.float32)
             )
-            mk = (mask if mask is not None
-                  else np.isfinite(np.asarray(y)).astype(np.float32))
+            y_rows = g(y)
+            if mask is not None:
+                m_rows = g(mask)
+            else:
+                m_rows = np.zeros_like(y_rows)
+                m_rows[:idx.size] = np.isfinite(y_rows[:idx.size])
             return (
-                g(y), g(mk), g(reg), g(cap), g(floor),
+                y_rows, m_rows, g(reg), g(cap), g(floor),
                 pad_rows(theta_cat.astype(np.float32)),
             )
 
@@ -886,8 +910,9 @@ def prep_worker(args) -> int:
         hi = min(lo + args.chunk, args.series)
         if _covered(lo, hi) or os.path.exists(_prep_path(args.out, lo, hi)):
             continue
+        y_c = rows(y, lo, hi)
         data, meta = model.prepare(
-            ds, rows(y, lo, hi), mask=rows(mask, lo, hi),
+            ds, y_c, mask=_chunk_mask(y_c, mask, lo, hi, args.chunk),
             regressors=rows(reg, lo, hi), cap=rows(cap, lo, hi, fill=1.0),
             floor=rows(floor, lo, hi), as_numpy=True,
         )
@@ -1016,6 +1041,7 @@ def run_resilient(
     progress_timeout: float = 90.0,
     state: Optional[dict] = None,
     probe_accelerator: Optional[bool] = None,
+    max_fruitless_retries: Optional[int] = 8,
 ) -> dict:
     """Parent loop: drive fit workers until the series range is complete
     (phase 2 included) or the deadline's reserve is reached.
@@ -1028,6 +1054,16 @@ def run_resilient(
     runtime is probed forever because it recovers on its own schedule.
     ``probe_accelerator=None`` auto-detects (probing is pointless when
     JAX is pinned to CPU).  Returns ``state`` plus {"complete": bool}.
+
+    ``max_fruitless_retries`` bounds CONSECUTIVE zero-progress worker
+    deaths: a wedged accelerator shows up as failed probes (waited out
+    forever), but a child that starts, runs, and dies without landing a
+    single chunk every time is a deterministic failure (bad input the
+    eligibility gate missed, a poisoned chunk, a broken install) — with
+    no deadline it would otherwise respawn in an infinite loop instead
+    of surfacing the error the in-process path raises immediately.
+    ``None`` disables the cap (deadline-bounded callers like bench.py
+    prefer the budget to decide).
     """
     if state is None:
         state = {}
@@ -1122,9 +1158,20 @@ def run_resilient(
         ] + (["--no-phase1-tune"] if no_phase1_tune else []),
             timeout=budget, progress_timeout=progress_timeout)
         if rc == 0:
+            state["fruitless"] = 0
             continue  # re-scan; loop exits when nothing is missing
         state["retries"] += 1
         made_progress = len(completed_ranges(out_dir)) > before
+        fruitless = 0 if made_progress else state.get("fruitless", 0) + 1
+        state["fruitless"] = fruitless
+        if (max_fruitless_retries is not None
+                and fruitless > max_fruitless_retries):
+            raise RuntimeError(
+                f"fit worker died {fruitless} consecutive times with zero "
+                f"progress (last rc={rc}); giving up — check the worker "
+                f"log on stderr for the underlying error (scratch kept in "
+                f"{out_dir})"
+            )
         # A death with zero progress puts the runtime itself under
         # suspicion.
         check_tunnel = (
@@ -1154,6 +1201,34 @@ def run_resilient(
 # --------------------------------------------------------------------------
 # public in-memory API
 # --------------------------------------------------------------------------
+
+def _call_fingerprint(config, solver_config, arrays: dict,
+                      params: dict) -> str:
+    """Hash of everything that determines a resilient run's results:
+    configs, run params, and the spilled data itself.  Guards scratch_dir
+    resume — without it a second call with different data/config would
+    silently mix old chunk results with new ones (bench.py keys its
+    scratch on a code fingerprint for the same reason)."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.md5()
+    h.update(pickle.dumps(
+        {"model": config, "solver": solver_config, "params": params}
+    ))
+    for name in sorted(arrays):
+        a = arrays[name]
+        h.update(name.encode())
+        if a is None:
+            h.update(b"<none>")
+            continue
+        b = np.ascontiguousarray(a)
+        h.update(str(b.shape).encode())
+        h.update(str(b.dtype).encode())
+        h.update(b)
+    return h.hexdigest()
+
 
 def fit_resilient(
     config,
@@ -1209,14 +1284,45 @@ def fit_resilient(
     data_dir = os.path.join(scratch, "data")
     out_dir = os.path.join(scratch, "out")
     os.makedirs(out_dir, exist_ok=True)
-    if not os.path.exists(os.path.join(data_dir, "ds.npy")):
-        spill_data(data_dir, ds, y, mask=mask, regressors=regressors,
-                   cap=cap, floor=floor)
-    save_run_config(out_dir, config, solver_config)
     # Clamp BEFORE deriving min_chunk: min_chunk from the unclamped
     # request could exceed the effective chunk, making a zero-progress
     # "halving" retry GROW the program that just crashed.
     chunk = min(chunk, max(32, series))
+    # Resume guard: a scratch_dir may only be reused by the SAME call
+    # (same configs, params, and data bytes) — otherwise old chunk files
+    # would silently mix into the new run's results.
+    fp = _call_fingerprint(
+        config, solver_config,
+        {"ds": ds, "y": y, "mask": mask, "reg": regressors, "cap": cap,
+         "floor": floor},
+        {"series": series, "chunk": chunk, "phase1_iters": phase1_iters,
+         "segment": segment, "no_phase1_tune": no_phase1_tune},
+    )
+    fp_path = os.path.join(out_dir, "run_fingerprint")
+    if os.path.exists(fp_path):
+        with open(fp_path) as fh:
+            if fh.read().strip() != fp:
+                raise ValueError(
+                    f"scratch_dir {scratch!r} holds a DIFFERENT resilient "
+                    "run (config, data, or run params changed since its "
+                    "chunks were written); pass a fresh scratch_dir or "
+                    "delete it"
+                )
+        fresh = False
+    else:
+        if completed_ranges(out_dir):
+            raise ValueError(
+                f"scratch_dir {scratch!r} has chunk results but no run "
+                "fingerprint; refusing to resume from unidentifiable state"
+            )
+        fresh = True
+    if fresh or not os.path.exists(os.path.join(data_dir, "ds.npy")):
+        spill_data(data_dir, ds, y, mask=mask, regressors=regressors,
+                   cap=cap, floor=floor)
+    save_run_config(out_dir, config, solver_config)
+    if fresh:
+        with open(fp_path, "w") as fh:
+            fh.write(fp)
     state = run_resilient(
         data_dir=data_dir,
         out_dir=out_dir,

@@ -22,7 +22,7 @@ Flow per micro-batch:
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -49,6 +49,10 @@ class RefitStats:
     cold_starts: int = 0
     fit_seconds: float = 0.0
     last_batch_seconds: float = 0.0
+    # Per-micro-batch refit wall seconds, in arrival order: the latency
+    # distribution is the streaming SLO (eval config 5 records mean/p50/
+    # max from it), and a scalar total can't show the warm-path speedup.
+    batch_seconds: List[float] = field(default_factory=list)
 
 
 class StreamingForecaster:
@@ -64,12 +68,18 @@ class StreamingForecaster:
         ds_col: str = "ds",
         y_col: str = "y",
         store: Optional[ParamStore] = None,
+        warm_start: bool = True,
         **backend_kwargs,
     ):
+        """``warm_start=False`` disables the parameter-store transfer:
+        every refit starts from the ridge init as if the series were new.
+        Exists for the warm-vs-cold comparison eval config 5 records —
+        production streaming always wants the default."""
         self.config = config
         self.backend = get_backend(backend, config, solver_config,
                                    **backend_kwargs)
         self.store = store if store is not None else ParamStore(config)
+        self.warm_start = warm_start
         self.max_history = max_history
         self.id_col, self.ds_col, self.y_col = id_col, ds_col, y_col
         self._hist = native.HistoryStore(max_history)
@@ -112,10 +122,15 @@ class StreamingForecaster:
         # Cold-start series get the same ridge warm start the batch path
         # uses; warm series are overwritten by the transferred params below.
         theta0 = initial_theta(data, self.config, self.backend.solver_config)
-        old_theta, old_meta, found = self.store.lookup(touched)
-        if old_theta is not None:
-            warm = transfer_theta(old_theta, old_meta, meta, self.config)
-            theta0 = jnp.where(jnp.asarray(found)[:, None], warm, theta0)
+        if self.warm_start:
+            old_theta, old_meta, found = self.store.lookup(touched)
+            if old_theta is not None:
+                warm = transfer_theta(old_theta, old_meta, meta, self.config)
+                theta0 = jnp.where(
+                    jnp.asarray(found)[:, None], warm, theta0
+                )
+        else:
+            found = np.zeros(len(touched), bool)
         state = self.backend.fit(
             jnp.asarray(grid), jnp.asarray(y), init=theta0
         )
@@ -128,6 +143,7 @@ class StreamingForecaster:
         self.stats.cold_starts += int((~found).sum())
         self.stats.fit_seconds += dt
         self.stats.last_batch_seconds = dt
+        self.stats.batch_seconds.append(dt)
 
     def run(self, source: MicroBatchSource,
             max_batches: Optional[int] = None) -> RefitStats:
