@@ -602,6 +602,11 @@ def main() -> None:
         on_idle=_overlap_cpu_work,
         progress_timeout=90.0,
         state=state,
+        # The BUDGET decides when this run stops (round-3 verdict item 1:
+        # a crash loop is re-probed and retried until the reserve), never
+        # a retry counter — and an uncaught RuntimeError here would break
+        # the one-JSON-line contract.
+        max_fruitless_retries=None,
     )
     note = None if result.get("complete") else "fit budget exhausted; partial"
     if note:
@@ -618,6 +623,7 @@ def main() -> None:
             ep.wait(timeout=max(15.0, deadline - time.time() - 15.0))
         except subprocess.TimeoutExpired:
             ep.kill()
+            ep.wait()  # reap, or _side_child sees it as still running
     # Re-run when coverage grew past what an overlapped mid-wedge eval
     # scored (eval.json records its n_eval; the worker overwrites it) —
     # through the same _side_child plumbing, waited on with the leftover

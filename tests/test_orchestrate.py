@@ -360,6 +360,49 @@ def test_fit_resilient_public_api(tmp_path, monkeypatch):
         )
 
 
+def test_forecaster_resilient_end_to_end(tmp_path, monkeypatch):
+    """The user-facing spelling: Forecaster(cfg, backend="tpu",
+    resilient=True) routes the DataFrame fit through the orchestrator's
+    subprocess workers and still produces a normal forecast."""
+    import pandas as pd
+
+    import tsspark_tpu as tt
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig
+
+    monkeypatch.delenv("TSSPARK_TEST_CRASH_AFTER", raising=False)
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=4,
+    )
+    rng = np.random.default_rng(3)
+    n = 200
+    ds = pd.date_range("2023-01-01", periods=n, freq="D")
+    rows = []
+    for sid in range(6):
+        yv = 5 + sid + 0.01 * np.arange(n) + rng.normal(0, 0.1, n)
+        rows.append(pd.DataFrame(
+            {"series_id": f"s{sid}", "ds": ds, "y": yv}
+        ))
+    df = pd.concat(rows, ignore_index=True)
+    called = {"n": 0}
+    orig = orchestrate.fit_resilient
+
+    def counting(*a, **k):
+        called["n"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(orchestrate, "fit_resilient", counting)
+    f = tt.Forecaster(
+        cfg, backend="tpu", resilient=True,
+        resilient_opts={"scratch_dir": str(tmp_path / "s"),
+                        "phase1_iters": 6, "no_phase1_tune": True},
+    ).fit(df)
+    assert called["n"] == 1, "Forecaster fit did not route to fit_resilient"
+    fc = f.predict(horizon=7)
+    assert np.isfinite(fc["yhat"].to_numpy()).all()
+    assert len(fc) == 6 * 7
+
+
 def test_run_resilient_gives_up_on_deterministic_failure(tmp_path,
                                                          monkeypatch):
     """A child that dies with ZERO progress every attempt (here: the data

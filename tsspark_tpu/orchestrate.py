@@ -1191,9 +1191,9 @@ def run_resilient(
             f"[orchestrate] fit worker died (rc={rc}), chunk {old} -> "
             f"{state['chunk']}, retry {state['retries']}", file=sys.stderr,
         )
-        # No retry cap: a crash loop is re-probed (check_tunnel above)
-        # and retried until the deadline's reserve — the budget, not a
-        # counter, decides when to stop.
+        # A crash loop that keeps LANDING chunks is re-probed and retried
+        # until the deadline's reserve; only max_fruitless_retries
+        # consecutive zero-progress deaths (see docstring) cut it short.
         time.sleep(2.0 if os.environ.get("TSSPARK_TEST_CRASH_AFTER")
                    else 10.0)  # let a crashed accelerator worker restart
 
