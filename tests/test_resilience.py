@@ -1,0 +1,615 @@
+"""Resilience subsystem: retry policy schedules, deterministic fault
+injection, chunk integrity (CRC + quarantine), poison-series
+quarantine/bisection, CPU degradation, and the crash-recovery acceptance
+scenario (worker killed twice + corrupt chunk + NaN-poisoned series ->
+fit completes, healthy series bit-identical to the fault-free run).
+
+Everything runs on CPU: the fault harness (resilience.faults) provokes
+the failures a real TPU deployment meets, deterministically.
+"""
+
+import glob
+import os
+import sys
+import warnings as warnings_mod
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tsspark_tpu import orchestrate  # noqa: E402
+from tsspark_tpu.resilience import faults, integrity  # noqa: E402
+from tsspark_tpu.resilience.policy import (  # noqa: E402
+    PROBE,
+    STREAM_POLL,
+    WORKER_RETRY,
+    RetryPolicy,
+)
+from tsspark_tpu.resilience.report import (  # noqa: E402
+    STATUS_QUARANTINED,
+    ResilienceWarning,
+    get_report,
+)
+
+# Fast schedules for subprocess tests: real sleeps stay, but short.
+FAST_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.2, backoff=1.0,
+                         max_delay_s=0.5)
+
+
+def _model_config():
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig
+
+    return ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 3),),
+        n_changepoints=6,
+    )
+
+
+def _batch(series=48, days=128):
+    from tsspark_tpu.data import datasets
+
+    b = datasets.m5_like(n_series=series, n_days=days)
+    y = np.nan_to_num(b.y).astype(np.float32)
+    return b.ds.astype(np.float64), y, b.mask.astype(np.float32)
+
+
+def _fit(tmp_path, name, ds, y, mask, **kw):
+    from tsspark_tpu.config import SolverConfig
+
+    kw.setdefault("chunk", 16)
+    kw.setdefault("phase1_iters", 4)
+    kw.setdefault("no_phase1_tune", True)
+    kw.setdefault("retry_policy", FAST_RETRY)
+    return orchestrate.fit_resilient(
+        _model_config(), SolverConfig(max_iters=60), ds, y, mask=mask,
+        scratch_dir=str(tmp_path / name), **kw,
+    )
+
+
+# -- policy ----------------------------------------------------------------
+
+
+def test_retry_policy_schedules():
+    # The named defaults reproduce the historical hard-coded schedules.
+    assert WORKER_RETRY.delay_s(0) == 10.0
+    assert WORKER_RETRY.delay_s(7) == 10.0  # fixed sleep, no backoff
+    assert WORKER_RETRY.allows(8) and not WORKER_RETRY.allows(9)
+    assert [PROBE.attempt_timeout(k) for k in (0, 1, 4, 99)] == \
+        [30.0, 45.0, 90.0, 90.0]
+    assert PROBE.delay_s(0) == 5.0
+    assert PROBE.delay_s(1) == 7.5
+    assert PROBE.delay_s(50) == 30.0  # capped
+    assert PROBE.allows(10 ** 9)  # probes never give up
+    # Jitter is deterministic: same (seed, retry) -> same delay.
+    p = RetryPolicy(base_delay_s=4.0, jitter=0.25, seed=11)
+    assert p.delay_s(3) == p.delay_s(3)
+    assert 3.0 <= p.delay_s(3) <= 5.0
+    assert p.delay_s(3) != RetryPolicy(
+        base_delay_s=4.0, jitter=0.25, seed=12
+    ).delay_s(3)
+
+
+def test_retry_policy_call_retries_then_raises():
+    calls = {"n": 0}
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky) == "ok" and calls["n"] == 3
+    calls["n"] = 0
+
+    def always_bad():
+        calls["n"] += 1
+        raise OSError("persistent")
+
+    with pytest.raises(OSError):
+        pol.call(always_bad)
+    assert calls["n"] == 3  # attempts bounded
+
+
+# -- faults ----------------------------------------------------------------
+
+
+def test_fault_plan_windows_and_series_targeting(tmp_path, monkeypatch):
+    plan = (
+        faults.FaultPlan(state_dir=str(tmp_path / "st"))
+        .fail("a", after=1, attempts=2)
+        .fail("b", series=37, attempts=5)
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    fired = 0
+    for _ in range(6):
+        try:
+            faults.inject("a")
+        except faults.FaultInjected:
+            fired += 1
+    assert fired == 2  # skip 1, fire 2, then spent
+
+    faults.inject("b", lo=0, hi=32)  # series 37 not in range: no-op
+    with pytest.raises(faults.FaultInjected):
+        faults.inject("b", lo=32, hi=64)
+
+    monkeypatch.delenv(faults.ENV_VAR)
+    faults.inject("a")  # unarmed: pure no-op
+
+
+def test_fault_plan_counts_across_processes(tmp_path, monkeypatch):
+    """Call slots are claimed via the filesystem, so a respawned process
+    continues the count instead of resetting it."""
+    import subprocess
+
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "st")).fail(
+        "x", after=1, attempts=1
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    code = (
+        "from tsspark_tpu.resilience import faults\n"
+        "try:\n"
+        "    faults.inject('x')\n"
+        "    print('clean')\n"
+        "except faults.FaultInjected:\n"
+        "    print('fired')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    outs = [
+        subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env).stdout.strip()
+        for _ in range(3)
+    ]
+    assert outs == ["clean", "fired", "clean"]
+
+
+# -- integrity -------------------------------------------------------------
+
+
+def _fake_state(n=4, p=3):
+    from tsspark_tpu.models.prophet.design import ScalingMeta
+    from tsspark_tpu.models.prophet.model import FitState
+
+    z = lambda *s: np.zeros(s)
+    return FitState(
+        theta=np.arange(n * p, dtype=np.float32).reshape(n, p),
+        loss=z(n), grad_norm=z(n), converged=np.ones(n, bool),
+        n_iters=np.ones(n, np.int32), status=np.ones(n, np.int32),
+        meta=ScalingMeta(
+            y_scale=np.ones(n), floor=z(n), ds_start=z(n),
+            ds_span=np.ones(n), reg_mean=z(n, 1), reg_std=np.ones((n, 1)),
+            changepoints=z(n, 2),
+        ),
+    )
+
+
+def test_chunk_crc_detects_silent_corruption(tmp_path):
+    out = str(tmp_path)
+    orchestrate.save_chunk_atomic(out, 0, 4, _fake_state())
+    path = orchestrate._chunk_path(out, 0, 4)
+    assert integrity.verify_file(path)
+    assert integrity.sweep_chunks(out) == []  # healthy: untouched
+
+    # Tamper with the payload but keep the (now stale) stamp: the zip
+    # layer cannot catch this — our CRC must.
+    z = dict(np.load(path))
+    z["theta"] = z["theta"] + 1.0
+    np.savez(path, **z)
+    assert not integrity.verify_file(path)
+    assert integrity.sweep_chunks(out) == [(0, 4)]
+    assert os.path.exists(path + ".corrupt") and not os.path.exists(path)
+    assert orchestrate.completed_ranges(out) == []  # range re-queued
+
+
+def test_torn_chunk_quarantined_and_requeued(tmp_path):
+    out = str(tmp_path)
+    orchestrate.save_chunk_atomic(out, 0, 4, _fake_state())
+    path = orchestrate._chunk_path(out, 0, 4)
+    with open(path, "r+b") as fh:  # torn write: truncate mid-file
+        fh.truncate(os.path.getsize(path) // 2)
+    assert integrity.sweep_chunks(out) == [(0, 4)]
+    with pytest.raises(RuntimeError, match="incomplete chunk coverage"):
+        orchestrate.load_fit_state(out, 4)
+
+
+def test_load_fit_state_raises_typed_integrity_error(tmp_path):
+    out = str(tmp_path)
+    orchestrate.save_chunk_atomic(out, 0, 4, _fake_state())
+    path = orchestrate._chunk_path(out, 0, 4)
+    z = dict(np.load(path))
+    z["loss"] = z["loss"] + 7.0
+    np.savez(path, **z)
+    with pytest.raises(integrity.ChunkIntegrityError) as ei:
+        orchestrate.load_fit_state(out, 4)
+    assert ei.value.ranges == [(0, 4)]
+
+
+def test_load_prep_rejects_corrupt_cache(tmp_path):
+    """A corrupt prep payload must fall through to local prep (None) and
+    be deleted, never fed to the fit."""
+    from collections import namedtuple
+
+    out = str(tmp_path)
+    Packed = namedtuple("Packed", ["y"])
+    Meta = namedtuple("Meta", ["y_scale"])
+    orchestrate.save_prep_atomic(
+        out, 0, 8, 8, Packed(y=np.ones((8, 4), np.float32)),
+        Meta(y_scale=np.ones(8)),
+    )
+    path = orchestrate._prep_path(out, 0, 8)
+    z = dict(np.load(path))
+    z["packed_y"] = z["packed_y"] * 2
+    np.savez(path, **z)
+    assert orchestrate.load_prep(out, 0, 8) is None
+    assert not os.path.exists(path)
+
+
+def test_completed_ranges_sorts_numerically_past_1e6(tmp_path):
+    """ADVICE r5 regression: 7-digit chunk names sort lexicographically
+    BEFORE 6-digit ones; completed_ranges must return numeric order or
+    load_fit_state concatenates chunks into the wrong series rows."""
+    out = str(tmp_path)
+    ranges = [(999_936, 1_000_448), (998_912, 999_936),
+              (1_000_448, 1_000_960), (0, 512)]
+    for lo, hi in ranges:
+        open(orchestrate._chunk_path(out, lo, hi), "w").close()
+    got = orchestrate.completed_ranges(out)
+    assert got == sorted(ranges)
+    # and the glob order it replaced really was wrong:
+    names = sorted(os.path.basename(p) for p in
+                   glob.glob(os.path.join(out, "chunk_*.npz")))
+    lex = [tuple(map(int, n[len("chunk_"):-len(".npz")].split("_")))
+           for n in names]
+    assert lex != got
+
+
+# -- finite-observed-y pre-validation (ADVICE r5) --------------------------
+
+
+def test_finite_contract_raises_immediately_without_quarantine(tmp_path):
+    ds, y, mask = _batch(series=8)
+    y = y.copy()
+    mask = mask.copy()
+    y[3, 10] = np.nan
+    mask[3, 10] = 1.0  # observed-but-NaN: the pack contract violation
+    with pytest.raises(ValueError, match="finite y wherever mask == 1"):
+        _fit(tmp_path, "s", ds, y, mask, quarantine=False)
+    # Raised BEFORE spilling data / spawning workers: no scratch content.
+    assert not os.path.exists(str(tmp_path / "s" / "data" / "y.npy"))
+
+
+# -- crash-recovery resume (fault harness) ---------------------------------
+
+
+def test_crash_resume_bit_identical(tmp_path, monkeypatch):
+    """Kill the fit worker mid-run via the fault harness, let the parent
+    respawn/resume, and require the final FitState byte-identical to an
+    uninterrupted run."""
+    # Pin ONE phase-2 mechanism for both runs: a resumed worker has only
+    # partial device-resident coverage and would take the host gather
+    # path, which agrees with the resident path only to f32 noise
+    # (tests/test_orchestrate.py pins that equivalence separately).
+    monkeypatch.setenv("BENCH_NO_RESIDENT", "1")
+    ds, y, mask = _batch(series=48)
+    ref = _fit(tmp_path, "ref", ds, y, mask)
+
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "fit_worker_chunk", after=1, attempts=1, mode="exit", rc=31
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    got = _fit(tmp_path, "faulted", ds, y, mask)
+    monkeypatch.delenv(faults.ENV_VAR)
+
+    assert get_report(got).retries >= 1  # the kill really happened
+    for field in ("theta", "loss", "grad_norm", "converged", "n_iters",
+                  "status"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, field)),
+            np.asarray(getattr(ref, field)), err_msg=field,
+        )
+    for field in ref.meta._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got.meta, field)),
+            np.asarray(getattr(ref.meta, field)), err_msg=field,
+        )
+
+
+# -- acceptance: kills + corruption + poison in one run --------------------
+
+
+def test_acceptance_faulted_fit_completes_and_matches(tmp_path,
+                                                      monkeypatch):
+    """The issue's acceptance scenario: worker killed twice, one chunk
+    checksum-corrupted, one series NaN-poisoned.  fit_resilient must
+    complete on CPU, re-fit the corrupt chunk, quarantine + report the
+    poisoned series, and leave every healthy series bit-for-bit equal to
+    the fault-free run."""
+    monkeypatch.setenv("BENCH_NO_RESIDENT", "1")  # see crash-resume test
+    ds, y, mask = _batch(series=48)
+    ref = _fit(tmp_path, "ref", ds, y, mask)
+
+    y_bad = y.copy()
+    mask_bad = mask.copy()
+    poison = 21
+    y_bad[poison, 5] = np.nan
+    mask_bad[poison, 5] = 1.0
+    plan = (
+        faults.FaultPlan(state_dir=str(tmp_path / "faults"))
+        # two worker deaths, each after landing one more chunk
+        .fail("fit_worker_chunk", after=1, attempts=2, mode="exit", rc=29)
+        # silently corrupt the saved chunk that covers series 0..15
+        .fail("chunk_save", series=0, attempts=1, mode="corrupt")
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    got = _fit(tmp_path, "faulted", ds, y_bad, mask_bad)
+    monkeypatch.delenv(faults.ENV_VAR)
+
+    report = get_report(got)
+    assert report is not None
+    assert report.quarantined_indices == (poison,)
+    assert "non-finite observed y" in report.quarantined[0].reason
+    assert report.retries >= 2  # both kills hit
+    # The corrupted chunk was quarantined on disk and re-fit.
+    scratch_out = str(tmp_path / "faulted" / "out")
+    assert glob.glob(os.path.join(scratch_out, "chunk_*.npz.corrupt"))
+    assert not orchestrate.missing_ranges(
+        orchestrate.completed_ranges(scratch_out), 48
+    )
+    # Quarantined row: NaN params, explicit status, not converged.
+    assert np.isnan(np.asarray(got.theta)[poison]).all()
+    assert np.asarray(got.status)[poison] == STATUS_QUARANTINED
+    assert not np.asarray(got.converged)[poison]
+    # Every healthy series matches the fault-free run bit for bit.
+    healthy = np.asarray([i for i in range(48) if i != poison])
+    for field in ("theta", "loss", "grad_norm", "converged", "n_iters",
+                  "status"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, field))[healthy],
+            np.asarray(getattr(ref, field))[healthy], err_msg=field,
+        )
+
+
+# -- poison bisection + CPU degradation ------------------------------------
+
+
+def test_spawn_always_failing_degrades_to_cpu(tmp_path, monkeypatch):
+    """When the worker path is environmentally dead (every spawn fails,
+    zero progress ever), the fit must NOT raise: it bisects, concludes
+    the failures are not data-bound, and degrades to the CPU backend
+    with a loud ResilienceWarning."""
+    ds, y, mask = _batch(series=12)
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "worker_spawn", attempts=10_000, mode="flag"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    with pytest.warns(ResilienceWarning, match="DEGRADING"):
+        got = _fit(tmp_path, "s", ds, y, mask,
+                   retry_policy=RetryPolicy(max_attempts=2,
+                                            base_delay_s=0.05),
+                   max_quarantine=2)
+    monkeypatch.delenv(faults.ENV_VAR)
+    report = get_report(got)
+    assert report.degraded_to_cpu
+    assert np.asarray(got.theta).shape[0] == 12
+    assert np.isfinite(np.asarray(got.loss)).all()
+    assert np.isfinite(np.asarray(got.theta)).all()
+    # scipy may hit the 60-iteration cap on a few series; most converge.
+    assert np.asarray(got.converged).sum() >= 8
+
+
+def test_degrade_disabled_raises(tmp_path, monkeypatch):
+    ds, y, mask = _batch(series=8)
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "worker_spawn", attempts=10_000, mode="flag"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    with pytest.raises(orchestrate.WorkerCrashLoopError):
+        _fit(tmp_path, "s", ds, y, mask,
+             retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.05),
+             max_quarantine=1, degrade_to_cpu=False)
+    monkeypatch.delenv(faults.ENV_VAR)
+
+
+@pytest.mark.slow
+def test_poison_series_isolated_by_bisection(tmp_path, monkeypatch):
+    """A series whose chunk kills the worker wherever it lands is
+    bisected down, quarantined, and reported; survivors complete."""
+    ds, y, mask = _batch(series=16)
+    poison = 9
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "fit_chunk", series=poison, attempts=10_000, mode="exit", rc=33
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    with pytest.warns(ResilienceWarning, match="quarantined 1 poison"):
+        got = _fit(tmp_path, "s", ds, y, mask, chunk=8,
+                   retry_policy=RetryPolicy(max_attempts=2,
+                                            base_delay_s=0.05))
+    monkeypatch.delenv(faults.ENV_VAR)
+    report = get_report(got)
+    assert report.quarantined_indices == (poison,)
+    assert "bisection" in report.quarantined[0].reason
+    assert np.asarray(got.status)[poison] == STATUS_QUARANTINED
+    assert np.isnan(np.asarray(got.theta)[poison]).all()
+    healthy = np.asarray([i for i in range(16) if i != poison])
+    assert np.isfinite(np.asarray(got.loss)[healthy]).all()
+    assert np.isfinite(np.asarray(got.theta)[healthy]).all()
+    # Stuck exits (FLOOR/STALLED) legitimately stay unconverged under
+    # two-phase semantics; most series should converge though.
+    assert np.asarray(got.converged)[healthy].sum() >= 0.6 * healthy.size
+
+
+def test_quarantine_placeholder_rows_assemble(tmp_path):
+    """Placeholder chunks written for quarantined series must satisfy
+    load_fit_state's coverage/shape contract and carry the quarantine
+    markers (fast unit path for what the slow bisection test proves end
+    to end)."""
+    from tsspark_tpu.resilience.report import ResilienceReport
+
+    out = str(tmp_path)
+    st = _fake_state(n=4)
+    orchestrate.save_chunk_atomic(out, 0, 4, st)
+    orchestrate.save_chunk_atomic(out, 5, 8, _fake_state(n=3))
+    report = orchestrate._write_quarantine_placeholders(
+        out, [4], "test poison", ResilienceReport()
+    )
+    assert report.quarantined_indices == (4,)
+    assembled = orchestrate.load_fit_state(out, 8)
+    assert np.isnan(np.asarray(assembled.theta)[4]).all()
+    assert np.asarray(assembled.status)[4] == STATUS_QUARANTINED
+    assert not np.asarray(assembled.converged)[4]
+    np.testing.assert_array_equal(np.asarray(assembled.theta)[:4],
+                                  np.asarray(st.theta))
+    # The placeholder is flagged so a phase-2 pass never gathers it.
+    z = np.load(orchestrate._chunk_path(out, 4, 5))
+    assert z["phase2"] == 1 and z["quarantined"] == 1
+
+
+def test_annotated_state_pickles_to_base_fitstate():
+    """The report-annotated state must survive pickle (Spark transfer,
+    multiprocessing queues): the generated subclass is not importable,
+    so pickling rebuilds the plain FitState (report dropped, like under
+    jax.tree transforms)."""
+    import pickle
+
+    from tsspark_tpu.models.prophet.model import FitState
+    from tsspark_tpu.resilience.report import (
+        ResilienceReport, attach_report, get_report,
+    )
+
+    st = _fake_state(n=3)
+    ann = attach_report(st, ResilienceReport(warnings=("w",)))
+    assert get_report(ann) is not None
+    back = pickle.loads(pickle.dumps(ann))
+    assert type(back) is FitState
+    np.testing.assert_array_equal(np.asarray(back.theta),
+                                  np.asarray(st.theta))
+    # Re-annotation (add_warning on an annotated state, the resilient
+    # gate's path) reuses the same class and still pickles clean.
+    from tsspark_tpu.resilience.report import add_warning
+
+    ann2 = add_warning(ann, "again")
+    assert type(ann2) is type(ann)
+    assert get_report(ann2).warnings == ("w", "again")
+    assert type(pickle.loads(pickle.dumps(ann2))) is FitState
+
+
+# -- resilient-gate warning (ADVICE r5) ------------------------------------
+
+
+def test_resilient_gate_warns_once_and_annotates(tmp_path, monkeypatch):
+    from tsspark_tpu.backends import tpu as tpu_mod
+    from tsspark_tpu.backends.tpu import TpuBackend
+    from tsspark_tpu.config import SolverConfig
+
+    monkeypatch.setattr(tpu_mod, "_RESILIENT_GATE_WARNED", False)
+    ds, y, mask = _batch(series=8)
+    bk = TpuBackend(
+        _model_config(), SolverConfig(max_iters=60), chunk_size=16,
+        resilient=True,
+        resilient_opts={"scratch_dir": str(tmp_path / "s"),
+                        "phase1_iters": 4, "no_phase1_tune": True,
+                        "retry_policy": FAST_RETRY},
+    )
+    with pytest.warns(ResilienceWarning, match="two-phase worker path"):
+        state = bk.fit(ds, y, mask=mask)
+    report = get_report(state)
+    assert report is not None and any(
+        "rescue" in w for w in report.warnings
+    )
+    # One-time: the second eligible fit stays quiet (fresh scratch, same
+    # process) but still annotates.
+    bk2 = TpuBackend(
+        _model_config(), SolverConfig(max_iters=60), chunk_size=16,
+        resilient=True,
+        resilient_opts={"scratch_dir": str(tmp_path / "s2"),
+                        "phase1_iters": 4, "no_phase1_tune": True,
+                        "retry_policy": FAST_RETRY},
+    )
+    with warnings_mod.catch_warnings():
+        warnings_mod.simplefilter("error", ResilienceWarning)
+        state2 = bk2.fit(ds, y, mask=mask)
+    assert any("rescue" in w for w in get_report(state2).warnings)
+
+
+# -- streaming poll resilience ---------------------------------------------
+
+
+def test_streaming_poll_retries_transient_faults(tmp_path, monkeypatch):
+    import pandas as pd
+
+    from tsspark_tpu.streaming.source import InMemorySource, ResilientSource
+
+    batches = [
+        pd.DataFrame({
+            "series_id": ["a"] * 30,
+            "ds": np.arange(30, dtype=float) + 60 * i,
+            "y": np.random.default_rng(i).normal(10, 1, 30),
+        })
+        for i in range(2)
+    ]
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "stream_poll", attempts=2, mode="raise"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    src = ResilientSource(
+        InMemorySource(batches),
+        RetryPolicy(max_attempts=5, base_delay_s=0.0),
+    )
+    got = [src.poll(), src.poll(), src.poll()]
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert got[0] is batches[0] and got[1] is batches[1] and got[2] is None
+
+
+def test_streaming_poll_policy_exhaustion_reraises(tmp_path, monkeypatch):
+    from tsspark_tpu.streaming.source import InMemorySource, ResilientSource
+
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "stream_poll", attempts=100, mode="raise"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    src = ResilientSource(
+        InMemorySource([]), RetryPolicy(max_attempts=2, base_delay_s=0.0)
+    )
+    with pytest.raises(faults.FaultInjected):
+        src.poll()
+    monkeypatch.delenv(faults.ENV_VAR)
+
+
+def test_driver_run_with_poll_policy(tmp_path, monkeypatch):
+    """StreamingForecaster.run(poll_policy=...) survives transient poll
+    faults end to end and still refits every batch."""
+    import pandas as pd
+
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig
+    from tsspark_tpu.streaming.driver import StreamingForecaster
+    from tsspark_tpu.streaming.source import InMemorySource
+
+    rng = np.random.default_rng(0)
+    batches = [
+        pd.DataFrame({
+            "series_id": ["s0"] * 40 + ["s1"] * 40,
+            "ds": np.tile(np.arange(40, dtype=float) + 40 * i, 2),
+            "y": rng.normal(5, 0.5, 80),
+        })
+        for i in range(2)
+    ]
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "stream_poll", attempts=1, mode="raise"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    fc = StreamingForecaster(
+        ProphetConfig(seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+                      n_changepoints=3),
+        backend="tpu",
+    )
+    stats = fc.run(
+        InMemorySource(batches),
+        poll_policy=RetryPolicy(max_attempts=4, base_delay_s=0.0),
+    )
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert stats.micro_batches == 2
+    assert stats.rows_ingested == 160

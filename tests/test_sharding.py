@@ -447,3 +447,38 @@ def test_mesh_with_length_bucketing():
     ))
     assert worse < 2e-3, worse
     assert np.isfinite(np.asarray(shard.theta)).all()
+
+
+def test_resolve_time_axis_prefers_time_on_3_axis_mesh():
+    """ADVICE r5: with time_axis unset, an axis literally NAMED "time"
+    must win over the first non-series axis — on ("series", "x", "time")
+    the positional fallback would lay time-major leaves on "x" and leave
+    the declared time axis unused."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.devices()).reshape(2, 2, 2)
+    mesh3 = Mesh(devs, axis_names=("series", "x", "time"))
+    cfg = ShardingConfig(series_axis="series", time_axis=None)
+    assert sharding._resolve_time_axis(mesh3, cfg) == "time"
+    # An explicit declaration still wins over the conventional name.
+    assert sharding._resolve_time_axis(
+        mesh3, ShardingConfig(series_axis="series", time_axis="x")
+    ) == "x"
+    # No "time" axis: first non-series fallback is unchanged.
+    mesh2 = Mesh(devs.reshape(4, 2), axis_names=("series", "seq"))
+    assert sharding._resolve_time_axis(
+        mesh2, ShardingConfig(series_axis="series", time_axis=None)
+    ) == "seq"
+    # And the spec builders agree with the resolution end to end: the
+    # (B, T) leaves carry ("series", ..., "time") on the 3-axis mesh.
+    from tsspark_tpu.models.prophet.design import FitData
+
+    fake = FitData(
+        t=np.zeros((8, 16)), y=np.zeros((8, 16)), mask=np.zeros((8, 16)),
+        s=np.zeros((8, 1)), cap=np.zeros((8, 16)),
+        X_season=np.zeros((16, 4)), X_reg=np.zeros((8, 16, 0)),
+        prior_scales=np.zeros(4), mult_mask=np.zeros(4),
+    )
+    specs = sharding.data_shardings(mesh3, fake, cfg)
+    assert tuple(specs.y) == ("series", "time")

@@ -45,6 +45,13 @@ from tsspark_tpu.models.holidays import (
 )
 from tsspark_tpu.models.prophet.model import FitState, McmcState, ProphetModel
 from tsspark_tpu.models.prophet.seasonality import auto_seasonalities
+from tsspark_tpu.resilience import (
+    FaultPlan,
+    ResilienceReport,
+    ResilienceWarning,
+    RetryPolicy,
+    get_report,
+)
 
 __version__ = "0.4.0"
 
@@ -68,8 +75,13 @@ __all__ = [
     "SolverConfig",
     "WEEKLY",
     "YEARLY",
+    "FaultPlan",
+    "ResilienceReport",
+    "ResilienceWarning",
+    "RetryPolicy",
     "cross_validation",
     "get_backend",
+    "get_report",
     "list_backends",
     "performance_metrics",
     "register_backend",

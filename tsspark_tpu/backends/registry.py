@@ -97,6 +97,27 @@ def get_backend(
     )
 
 
+#: Where resilient fits degrade to when the accelerator path's retry
+#: budget is exhausted: the per-series scipy reference path — slow, but
+#: it has no accelerator runtime, no XLA program size limits, and no
+#: lockstep batch to poison, so it finishes runs the batched path cannot.
+DEGRADED_BACKEND = "cpu"
+
+
+def degraded_backend(
+    config: Optional[ProphetConfig] = None,
+    solver_config: Optional[SolverConfig] = None,
+    **kwargs,
+) -> ForecastBackend:
+    """The graceful-degradation backend (see ``DEGRADED_BACKEND``).
+
+    Lives here rather than in the orchestrator so the which-backend-
+    degrades-to-what decision sits with the registry, next to the
+    backends themselves; ``orchestrate.fit_resilient`` calls this after
+    exhausting the TPU path (docs/RESILIENCE.md)."""
+    return get_backend(DEGRADED_BACKEND, config, solver_config, **kwargs)
+
+
 def list_backends():
     _ensure_builtins()
     return sorted(_REGISTRY)

@@ -13,6 +13,7 @@ code runs on any JAX backend (tests exercise it on the forced-CPU mesh).
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Optional
 
 import jax
@@ -20,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from tsspark_tpu.backends.registry import ForecastBackend, register_backend
+from tsspark_tpu.resilience import faults
+from tsspark_tpu.resilience.report import ResilienceWarning, add_warning
 from tsspark_tpu.models.prophet import predict as predict_mod
 from tsspark_tpu.models.prophet.design import (
     _indicator_reg_cols,
@@ -31,6 +34,12 @@ from tsspark_tpu.models.prophet.model import (
     ProphetModel,
     select_better_state,
 )
+
+
+# One-time flag for the resilient-gate semantic-switch warning: every
+# eligible fit after the first stays quiet (the note still rides each
+# returned state's resilience report).
+_RESILIENT_GATE_WARNED = False
 
 
 def _pad_batch(arr, b_pad):
@@ -229,6 +238,7 @@ class TpuBackend(ForecastBackend):
             init=None, conditions=None, max_iters_dynamic=None,
             gn_precond_dynamic=None, use_init_dynamic=None,
             reg_u8_cols=None):
+        faults.inject("backend_fit")
         dyn_used = any(
             v is not None for v in
             (max_iters_dynamic, gn_precond_dynamic, use_init_dynamic)
@@ -242,14 +252,36 @@ class TpuBackend(ForecastBackend):
                 and packable_batch(ds, mask)):
             from tsspark_tpu import orchestrate
 
+            # The resilient route serves fit_twophase semantics: no
+            # rescue pass, no length bucketing.  With rescue=True (the
+            # backend default) or length_buckets set, two calls differing
+            # only in eligibility (say, mask fractionality) would return
+            # different-quality stuck-exit tails with no signal — so the
+            # semantic switch is announced once and recorded on the
+            # returned state (ADVICE r5).
+            note = None
+            if self.rescue or self.length_buckets not in (None, 1):
+                note = (
+                    "TpuBackend(resilient=True): this fit is served by "
+                    "the two-phase worker path, which ignores "
+                    f"rescue={self.rescue!r} and length_buckets="
+                    f"{self.length_buckets!r}; ineligible batches "
+                    "(fractional mask, 2-D ds, conditions, init) fall "
+                    "back to the in-process fit WITH those features"
+                )
+                global _RESILIENT_GATE_WARNED
+                if not _RESILIENT_GATE_WARNED:
+                    _RESILIENT_GATE_WARNED = True
+                    warnings.warn(note, ResilienceWarning, stacklevel=2)
             opts = dict(chunk=self.chunk_size)
             if self.iter_segment:
                 opts["segment"] = self.iter_segment
             opts.update(self.resilient_opts)
-            return orchestrate.fit_resilient(
+            state = orchestrate.fit_resilient(
                 self.config, self.solver_config, ds, y, mask=mask,
                 regressors=regressors, cap=cap, floor=floor, **opts,
             )
+            return add_warning(state, note) if note else state
         # Indicator-column split decided ONCE here so the main fit and the
         # rescue pass share it (it is a static argument of the jitted fit
         # and an O(B*T*R) host scan — see _fit_main).  Segmented solves

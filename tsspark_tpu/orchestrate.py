@@ -43,6 +43,7 @@ Public surface:
 
 from __future__ import annotations
 
+import dataclasses
 import glob
 import json
 import os
@@ -50,9 +51,39 @@ import pickle
 import subprocess
 import sys
 import time
-from typing import Callable, Optional
+import warnings
+from typing import Callable, List, Optional, Tuple
+
+# Resilience layer: policies/faults/report are stdlib-only at import
+# time and integrity defers numpy, so the parent process stays as light
+# as before (children import the heavy stack themselves).
+from tsspark_tpu.resilience import faults, integrity
+from tsspark_tpu.resilience.integrity import ChunkIntegrityError
+from tsspark_tpu.resilience.policy import (
+    PROBE as PROBE_POLICY,
+    WORKER_RETRY as WORKER_RETRY_POLICY,
+    RetryPolicy,
+)
+from tsspark_tpu.resilience.report import (
+    QuarantineRecord,
+    ResilienceReport,
+    ResilienceWarning,
+    STATUS_QUARANTINED,
+    attach_report,
+)
 
 MIN_CHUNK = 512
+
+
+class WorkerCrashLoopError(RuntimeError):
+    """The fit worker died with zero progress too many consecutive times
+    (a deterministic failure, not a wedge).  Carries the still-missing
+    ranges so ``fit_resilient`` can bisect them for poison series."""
+
+    def __init__(self, msg: str, missing: List[Tuple[int, int]], rc: int):
+        super().__init__(msg)
+        self.missing = missing
+        self.rc = rc
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Live worker subprocesses: a caller's signal handler must kill them or an
@@ -154,7 +185,8 @@ def _prep_path(out_dir: str, lo: int, hi: int) -> str:
 def save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None) -> None:
     """One chunk's FitState -> chunk_<lo>_<hi>.npz.  Dotfile prefix + an
     atomic rename so a half-written file can never match the resume/eval
-    glob."""
+    glob; a payload CRC32 (resilience.integrity) so silent corruption is
+    caught at load time and quarantined instead of assembled."""
     import numpy as np
 
     tmp = os.path.join(out_dir, f".tmp_{lo:06d}_{hi:06d}.npz")
@@ -175,8 +207,10 @@ def save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None) -> None:
         changepoints=np.asarray(state.meta.changepoints),
     )
     arrays.update(extra_arrays or {})
-    np.savez(tmp, **arrays)
-    os.replace(tmp, _chunk_path(out_dir, lo, hi))
+    np.savez(tmp, **integrity.stamp(arrays))
+    path = _chunk_path(out_dir, lo, hi)
+    os.replace(tmp, path)
+    faults.corrupt_file("chunk_save", path, lo=lo, hi=hi)
 
 
 def _state_from_chunk(z):
@@ -202,6 +236,12 @@ def load_fit_state(out_dir: str, n_series: int):
     import jax
     import numpy as np
 
+    # Integrity gate: a corrupt/torn chunk is quarantined (*.corrupt)
+    # and its range re-queued via missing_ranges — NEVER silently
+    # concatenated into the full-batch result.
+    bad = integrity.sweep_chunks(out_dir)
+    if bad:
+        raise ChunkIntegrityError(out_dir, bad)
     done = completed_ranges(out_dir)
     if missing_ranges(done, n_series):
         raise RuntimeError(
@@ -230,8 +270,10 @@ def save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
     for k, v in meta._asdict().items():
         arrays[f"meta_{k}"] = np.asarray(v)
     tmp = os.path.join(out_dir, f".tmp_prep_{lo:06d}_{hi:06d}.npz")
-    np.savez(tmp, **arrays)
-    os.replace(tmp, _prep_path(out_dir, lo, hi))
+    np.savez(tmp, **integrity.stamp(arrays))
+    path = _prep_path(out_dir, lo, hi)
+    os.replace(tmp, path)
+    faults.corrupt_file("prep_save", path, lo=lo, hi=hi)
 
 
 def load_prep(out_dir, lo, hi, chunk=None):
@@ -250,6 +292,12 @@ def load_prep(out_dir, lo, hi, chunk=None):
         return None
     try:
         z = np.load(path)
+        if not integrity.verify_arrays(z):
+            # A corrupt prep cache must not feed the fit; drop it so the
+            # worker re-preps locally (prep files are pure cache).
+            z.close()
+            os.remove(path)
+            return None
         packed = PackedFitData(**{
             k: z[f"packed_{k}"] for k in PackedFitData._fields
         })
@@ -265,11 +313,16 @@ def load_prep(out_dir, lo, hi, chunk=None):
 
 def completed_ranges(out_dir: str):
     done = []
-    for f in sorted(glob.glob(os.path.join(out_dir, "chunk_*.npz"))):
+    for f in glob.glob(os.path.join(out_dir, "chunk_*.npz")):
         base = os.path.basename(f)[len("chunk_"):-len(".npz")]
         lo, hi = base.split("_")
         done.append((int(lo), int(hi)))
-    return done
+    # NUMERIC sort, never filename sort: past 999,999 series the lo field
+    # grows to 7 digits and sorts lexicographically BEFORE 6-digit names
+    # (chunk_1000448_* < chunk_999936_*), which would let load_fit_state
+    # concatenate chunks out of order and silently assign results to the
+    # wrong series rows (ADVICE r5).
+    return sorted(done)
 
 
 def missing_ranges(done, total):
@@ -348,6 +401,11 @@ def fit_worker(args) -> int:
         FitState, fit_core_packed, fitstate_from_packed,
     )
 
+    faults.inject("fit_worker_start")
+    # Resume never trusts a corrupt chunk: quarantine torn/mismatched
+    # files NOW so their ranges land back in this worker's todo list and
+    # phase 2 can never np.load garbage.
+    integrity.sweep_chunks(args.out)
     model_config, solver_config = load_run_config(args.out)
     ds, d = _load_data(args.data)
     y, mask, reg = d["y"], d["mask"], d["reg"]
@@ -425,11 +483,18 @@ def fit_worker(args) -> int:
                                   collapse_cap=collapse_cap)
         return lo, hi, b_real, packed, meta
 
+    # Todo = the still-MISSING coverage inside [lo, hi), each gap walked
+    # on its own chunk grid.  COVERAGE, not exact file names: after a
+    # poison-series bisection (or a chunk-size change) a region may be
+    # covered by differently-named sub-range files, and a name-based
+    # check would refit it — worse, the refit would write a chunk file
+    # OVERLAPPING the existing ones, and load_fit_state's concatenation
+    # would then duplicate rows.
     todo = []
-    for lo in range(args.lo, args.hi, args.chunk):
-        hi = min(lo + args.chunk, args.hi)
-        if not os.path.exists(_chunk_path(args.out, lo, hi)):
-            todo.append((lo, hi))
+    for m_lo, m_hi in missing_ranges(completed_ranges(args.out), args.hi):
+        m_lo = max(m_lo, args.lo)
+        for lo in range(m_lo, min(m_hi, args.hi), args.chunk):
+            todo.append((lo, min(lo + args.chunk, m_hi, args.hi)))
     prefetch_depth = 3
     # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
     # program, so it can change per chunk for free.  One adjustment after
@@ -514,6 +579,7 @@ def fit_worker(args) -> int:
         }
         for i in range(len(todo)):
             t0 = time.time()
+            faults.inject("fit_chunk", lo=todo[i][0], hi=todo[i][1])
             lo, hi, b_real, payload, meta = futs.pop(i).result()
             t_wait = time.time() - t0
             nxt = i + prefetch_depth
@@ -575,6 +641,15 @@ def fit_worker(args) -> int:
                 for f in write_futs:
                     f.result()
                 os._exit(17)  # simulated mid-run worker death
+            if os.environ.get(faults.ENV_VAR):
+                # Flush pending writer-thread saves first so an "exit"
+                # fault kills the worker with exactly the chunks the
+                # plan's call count says are on disk (no-op without an
+                # armed plan, so production keeps the save pipeline).
+                for f in write_futs:
+                    f.result()
+                write_futs.clear()
+                faults.inject("fit_worker_chunk", lo=lo, hi=hi)
         for f in write_futs:
             f.result()  # surface writer-thread failures before phase 2
 
@@ -608,8 +683,11 @@ def fit_worker(args) -> int:
             continue
         # Unconverged only: fit_twophase's straggler selection (stuck
         # exits are the rescue pass's job — see TpuBackend.fit_twophase
-        # for the measured rationale).
-        bad = np.flatnonzero(~z["converged"])
+        # for the measured rationale).  Quarantined placeholder rows are
+        # never gathered: their data is exactly what killed a worker.
+        bad = np.flatnonzero(
+            ~z["converged"] & (z["status"] != STATUS_QUARANTINED)
+        )
         straggler_idx.extend(int(lo + i) for i in bad)
         straggler_theta.append(z["theta"][bad])
         straggler_gn.append(z["grad_norm"][bad])
@@ -932,6 +1010,8 @@ def tunnel_preflight(timeout: float = 90.0) -> bool:
     ``jax.devices()`` forever (observed repeatedly on the tunneled dev
     chip).  Probe it in a disposable subprocess so the decision takes
     <= ``timeout`` seconds instead of a fit-worker stall cycle."""
+    if faults.inject("device_probe"):
+        return False  # injected wedge: the probe loop's test hook
     code = (
         "import jax, jax.numpy as jnp\n"
         "jax.devices()\n"
@@ -966,11 +1046,23 @@ def _child_env(force_cpu: bool = False) -> dict:
 def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
                  timeout: Optional[float] = None,
                  progress_timeout: Optional[float] = None,
-                 log_stream=None) -> int:
+                 log_stream=None,
+                 policy: Optional[RetryPolicy] = None) -> int:
     """Run a child worker; kill it on overall timeout OR when no new chunk
     result / heartbeat has appeared for ``progress_timeout`` seconds (a
     wedged runtime blocks client creation forever — stalling is
-    indistinguishable from working except by watching the output dir)."""
+    indistinguishable from working except by watching the output dir).
+
+    ``policy``: the policy's per-attempt deadline (``attempt_timeout_s``,
+    when set) caps this spawn's ``timeout`` — how a RetryPolicy bounds
+    each worker attempt independently of the run's overall budget."""
+    if faults.inject("worker_spawn"):
+        return -9  # injected spawn failure (same rc as a killed worker)
+    if policy is not None:
+        per_attempt = policy.attempt_timeout(0)
+        if per_attempt is not None:
+            timeout = (per_attempt if timeout is None
+                       else min(timeout, per_attempt))
     cmd = [sys.executable, "-m", "tsspark_tpu.orchestrate", mode,
            "--data", data_dir, "--out", out_dir] + extra
     proc = subprocess.Popen(
@@ -1042,6 +1134,8 @@ def run_resilient(
     state: Optional[dict] = None,
     probe_accelerator: Optional[bool] = None,
     max_fruitless_retries: Optional[int] = 8,
+    retry_policy: Optional[RetryPolicy] = None,
+    probe_policy: Optional[RetryPolicy] = None,
 ) -> dict:
     """Parent loop: drive fit workers until the series range is complete
     (phase 2 included) or the deadline's reserve is reached.
@@ -1064,7 +1158,29 @@ def run_resilient(
     of surfacing the error the in-process path raises immediately.
     ``None`` disables the cap (deadline-bounded callers like bench.py
     prefer the budget to decide).
+
+    ``retry_policy`` / ``probe_policy`` (resilience.policy.RetryPolicy):
+    the post-crash respawn schedule and the accelerator-probe schedule.
+    Defaults reproduce the historical behavior exactly — a fixed 10 s
+    respawn sleep with ``max_fruitless_retries + 1`` consecutive
+    zero-progress attempts, and 5 s x1.5-backoff probe sleeps (30 s cap)
+    with 30 + 15*consec <= 90 s per-probe patience.  An explicit
+    ``retry_policy`` overrides ``max_fruitless_retries``.
     """
+    if retry_policy is None:
+        retry_policy = dataclasses.replace(
+            WORKER_RETRY_POLICY,
+            max_attempts=(None if max_fruitless_retries is None
+                          else max_fruitless_retries + 1),
+            # Crash-loop tests fault on purpose; don't make them wait
+            # out the production respawn sleep.
+            base_delay_s=(
+                2.0 if os.environ.get("TSSPARK_TEST_CRASH_AFTER")
+                else WORKER_RETRY_POLICY.base_delay_s
+            ),
+        )
+    if probe_policy is None:
+        probe_policy = PROBE_POLICY
     if state is None:
         state = {}
     state.setdefault("chunk", chunk)
@@ -1091,7 +1207,6 @@ def run_resilient(
         probe_accelerator if probe_accelerator is not None
         else os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
     )
-    probe_sleep = 5.0
     two_phase = phase1_iters > 0
     while True:
         missing = missing_ranges(completed_ranges(out_dir), series)
@@ -1112,11 +1227,14 @@ def run_resilient(
         # deadline - reserve, the wait overlapped by on_idle work.
         if check_tunnel:
             t_probe = time.time()
-            # Escalating timeout: cheap 30 s probes while wedged, but a
-            # healthy runtime whose client creation is merely SLOW must
-            # not fail every probe forever — each consecutive failure
-            # buys the next probe more patience.
-            patience = min(30.0 + 15.0 * probes.get("consec", 0), 90.0)
+            # Escalating per-probe patience (probe_policy.attempt_timeout:
+            # 30 + 15*consec <= 90 s by default): cheap probes while
+            # wedged, but a healthy runtime whose client creation is
+            # merely SLOW must not fail every probe forever — each
+            # consecutive failure buys the next probe more patience.
+            patience = probe_policy.attempt_timeout(
+                probes.get("consec", 0)
+            ) or 90.0
             if deadline:
                 patience = min(
                     patience, max(10.0, remaining - reserve())
@@ -1132,14 +1250,18 @@ def run_resilient(
                 )
                 if on_idle is not None:
                     on_idle()
+                # Backoff between failed probes (probe_policy.delay_s:
+                # 5 s x1.5 capped at 30 s by default, reset on success
+                # since the retry index is the consec-failure count).
+                probe_sleep = probe_policy.delay_s(
+                    max(0, probes["consec"] - 1)
+                )
                 sleep_cap = (
                     max(0.0, deadline - time.time() - reserve())
                     if deadline else probe_sleep
                 )
                 time.sleep(min(probe_sleep, sleep_cap))
-                probe_sleep = min(probe_sleep * 1.5, 30.0)
                 continue
-            probe_sleep = 5.0
             check_tunnel = False
         remaining = (deadline - time.time()) if deadline else None
         budget = (
@@ -1156,7 +1278,8 @@ def run_resilient(
             "--series", str(series),
             "--phase1-iters", str(phase1_iters),
         ] + (["--no-phase1-tune"] if no_phase1_tune else []),
-            timeout=budget, progress_timeout=progress_timeout)
+            timeout=budget, progress_timeout=progress_timeout,
+            policy=retry_policy)
         if rc == 0:
             state["fruitless"] = 0
             continue  # re-scan; loop exits when nothing is missing
@@ -1164,13 +1287,14 @@ def run_resilient(
         made_progress = len(completed_ranges(out_dir)) > before
         fruitless = 0 if made_progress else state.get("fruitless", 0) + 1
         state["fruitless"] = fruitless
-        if (max_fruitless_retries is not None
-                and fruitless > max_fruitless_retries):
-            raise RuntimeError(
+        if not retry_policy.allows(fruitless):
+            raise WorkerCrashLoopError(
                 f"fit worker died {fruitless} consecutive times with zero "
                 f"progress (last rc={rc}); giving up — check the worker "
                 f"log on stderr for the underlying error (scratch kept in "
-                f"{out_dir})"
+                f"{out_dir})",
+                missing=missing_ranges(completed_ranges(out_dir), series),
+                rc=rc,
             )
         # A death with zero progress puts the runtime itself under
         # suspicion.
@@ -1192,10 +1316,216 @@ def run_resilient(
             f"{state['chunk']}, retry {state['retries']}", file=sys.stderr,
         )
         # A crash loop that keeps LANDING chunks is re-probed and retried
-        # until the deadline's reserve; only max_fruitless_retries
-        # consecutive zero-progress deaths (see docstring) cut it short.
-        time.sleep(2.0 if os.environ.get("TSSPARK_TEST_CRASH_AFTER")
-                   else 10.0)  # let a crashed accelerator worker restart
+        # until the deadline's reserve; only the retry policy's attempt
+        # budget on consecutive zero-progress deaths cuts it short.  The
+        # sleep lets a crashed accelerator worker restart; its retry
+        # index is the consecutive-fruitless count so a backoff>1 policy
+        # escalates exactly when nothing is landing.
+        retry_policy.sleep(fruitless)
+
+
+# --------------------------------------------------------------------------
+# poison-batch quarantine: bisect / placeholder rows / CPU degradation
+# --------------------------------------------------------------------------
+
+def _write_quarantine_placeholders(out_dir: str, indices, reason: str,
+                                   report: ResilienceReport
+                                   ) -> ResilienceReport:
+    """Cover each quarantined series with a 1-row placeholder chunk:
+    NaN parameters, ``converged=False``, ``status=STATUS_QUARANTINED``,
+    inert scaling meta — so ``load_fit_state`` assembles a complete
+    batch and downstream consumers can mask the row.  Shapes/dtypes are
+    taken from an existing healthy chunk (the caller guarantees one)."""
+    import numpy as np
+
+    from tsspark_tpu.models.prophet.design import ScalingMeta
+    from tsspark_tpu.models.prophet.model import FitState
+
+    done = completed_ranges(out_dir)
+    tmpl = dict(np.load(_chunk_path(out_dir, *done[0])))
+
+    def row(key, fill):
+        a = tmpl[key]
+        return np.full((1,) + a.shape[1:], fill, a.dtype)
+
+    for q in sorted(indices):
+        state = FitState(
+            theta=row("theta", np.nan),
+            loss=row("loss", np.nan),
+            grad_norm=row("grad_norm", np.nan),
+            converged=row("converged", False),
+            n_iters=row("n_iters", 0),
+            status=np.full((1,), STATUS_QUARANTINED, np.int32),
+            meta=ScalingMeta(
+                y_scale=row("y_scale", 1.0),
+                floor=row("floor", 0.0),
+                ds_start=row("ds_start", 0.0),
+                ds_span=row("ds_span", 1.0),
+                reg_mean=row("reg_mean", 0.0),
+                reg_std=row("reg_std", 1.0),
+                changepoints=row("changepoints", 0.0),
+            ),
+        )
+        # phase2=1: the straggler pass must never gather this row — its
+        # data is exactly what killed a worker.
+        save_chunk_atomic(out_dir, q, q + 1, state,
+                          extra_arrays={"phase2": np.asarray(1),
+                                        "quarantined": np.asarray(1)})
+        report = dataclasses.replace(
+            report,
+            quarantined=report.quarantined + (
+                QuarantineRecord(int(q), reason),
+            ),
+        )
+    return report
+
+
+_CPU_FILL_CHUNK = 256  # bound the scipy loop's per-call batch
+
+
+def _cpu_fill(out_dir: str, data_dir: str, series: int,
+              model_config, solver_config,
+              deadline: Optional[float] = None) -> None:
+    """Graceful degradation: fit every still-missing range in-process on
+    the CPU reference backend and persist normal chunk files.  Slow, but
+    it finishes the run when the accelerator path's retry budget is
+    exhausted — the loud-warning alternative to raising.  A caller's
+    ``deadline`` (fit_resilient's budget_s) still bounds it: landed
+    chunks persist, so a resumed call continues the fill."""
+    import numpy as np
+
+    from tsspark_tpu.backends.registry import degraded_backend
+
+    ds, d = _load_data(data_dir)
+    backend = degraded_backend(model_config, solver_config)
+    for lo, hi in missing_ranges(completed_ranges(out_dir), series):
+        for lo2 in range(lo, hi, _CPU_FILL_CHUNK):
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    f"budget exhausted during CPU degradation fill; "
+                    f"partial chunks kept in {out_dir}"
+                )
+            hi2 = min(lo2 + _CPU_FILL_CHUNK, hi)
+            sl = lambda a: None if a is None else np.asarray(a[lo2:hi2])
+            state = backend.fit(
+                ds, np.asarray(d["y"][lo2:hi2]), mask=sl(d["mask"]),
+                regressors=sl(d["reg"]), cap=sl(d["cap"]),
+                floor=sl(d["floor"]),
+            )
+            # phase2=1: the CPU oracle runs at full depth; there is no
+            # straggler pass owed for these rows.
+            save_chunk_atomic(out_dir, lo2, hi2, state,
+                              extra_arrays={"phase2": np.asarray(1)})
+    marker = os.path.join(out_dir, "phase2_done")
+    if not os.path.exists(marker):
+        # The accelerator path is gone; nothing will come back to run a
+        # straggler pass, so close the run out (phase-1-depth rows in
+        # pre-existing chunks keep their honest converged=False flags).
+        with open(marker, "w") as fh:
+            fh.write("degraded-to-cpu\n")
+
+
+def _bisect_quarantine(
+    *, data_dir: str, out_dir: str, series: int, chunk: int, segment: int,
+    phase1_iters: int, no_phase1_tune: bool, progress_timeout: float,
+    retry_policy: RetryPolicy, report: ResilienceReport,
+    model_config, solver_config, max_quarantine: int,
+    degrade_to_cpu: bool, deadline: Optional[float],
+) -> ResilienceReport:
+    """A chunk kept killing the worker: bisect the failing ranges down to
+    single series, quarantine the isolated poison, and fit the survivors
+    through the normal worker path (their sub-range chunk files count as
+    ordinary coverage).  When the failures look environmental instead of
+    data-bound — more than ``max_quarantine`` series "poisoned", or no
+    chunk has EVER landed — degrade the remaining ranges to the CPU
+    backend (loud warning) rather than quarantining the world.
+    """
+
+    def extra(lo: int, hi: int) -> list:
+        return ([
+            "--lo", str(lo), "--hi", str(hi), "--chunk", str(chunk),
+            "--segment", str(segment), "--series", str(series),
+            "--phase1-iters", str(phase1_iters),
+        ] + (["--no-phase1-tune"] if no_phase1_tune else []))
+
+    def covered(lo: int, hi: int) -> bool:
+        holes = missing_ranges(completed_ranges(out_dir), series)
+        return not any(h_lo < hi and h_hi > lo for h_lo, h_hi in holes)
+
+    def probe(lo: int, hi: int) -> bool:
+        for attempt in range(2):
+            try:
+                spawn_worker(
+                    "--_fit", data_dir, out_dir, extra(lo, hi),
+                    timeout=retry_policy.attempt_timeout(attempt),
+                    progress_timeout=progress_timeout,
+                )
+            except faults.FaultInjected:
+                pass  # an injected spawn failure is still a failure
+            if covered(lo, hi):
+                return True
+            time.sleep(min(1.0, retry_policy.delay_s(attempt)))
+        return False
+
+    quarantined: list = []
+    degrade = False
+    stack = list(missing_ranges(completed_ranges(out_dir), series))
+    while stack:
+        if deadline is not None and time.time() > deadline:
+            raise TimeoutError(
+                f"budget exhausted while bisecting poison ranges; "
+                f"partial chunks kept in {out_dir}"
+            )
+        lo, hi = stack.pop(0)
+        if probe(lo, hi):
+            continue
+        if hi - lo <= 1:
+            quarantined.append(lo)
+            if len(quarantined) > max_quarantine:
+                degrade = True
+                break
+            continue
+        mid = (lo + hi) // 2
+        stack[:0] = [(lo, mid), (mid, hi)]
+
+    if degrade or (quarantined and not completed_ranges(out_dir)):
+        if not degrade_to_cpu:
+            raise WorkerCrashLoopError(
+                f"worker crash loop looks environmental ("
+                f"{len(quarantined)} single-series probes failed, cap "
+                f"{max_quarantine}) and degrade_to_cpu is disabled",
+                missing=missing_ranges(completed_ranges(out_dir), series),
+                rc=-9,
+            )
+        msg = (
+            f"accelerator-path retry budget exhausted "
+            f"({len(quarantined)} single-series probes failed — an "
+            f"environmental fault, not poison data); DEGRADING the "
+            f"remaining ranges to the CPU backend.  This completes the "
+            f"fit but is orders of magnitude slower; phase-1-depth rows "
+            f"in already-completed chunks keep converged=False."
+        )
+        warnings.warn(msg, ResilienceWarning, stacklevel=3)
+        _cpu_fill(out_dir, data_dir, series, model_config, solver_config,
+                  deadline=deadline)
+        return dataclasses.replace(
+            report, degraded_to_cpu=True, warnings=report.warnings + (msg,)
+        )
+    if quarantined:
+        report = _write_quarantine_placeholders(
+            out_dir, quarantined,
+            "worker died repeatedly fitting this series (isolated by "
+            "bisection); poison-series quarantine",
+            report,
+        )
+        warnings.warn(
+            f"quarantined {len(quarantined)} poison series "
+            f"{sorted(quarantined)[:8]}{'...' if len(quarantined) > 8 else ''}"
+            f" after bisection; their rows carry NaN parameters and "
+            f"status=STATUS_QUARANTINED (see FitState's resilience report)",
+            ResilienceWarning, stacklevel=3,
+        )
+    return report
 
 
 # --------------------------------------------------------------------------
@@ -1248,6 +1578,11 @@ def fit_resilient(
     scratch_dir: Optional[str] = None,
     keep_scratch: bool = False,
     progress_timeout: float = 90.0,
+    retry_policy: Optional[RetryPolicy] = None,
+    probe_policy: Optional[RetryPolicy] = None,
+    quarantine: bool = True,
+    max_quarantine: int = 32,
+    degrade_to_cpu: bool = True,
 ):
     """Process-isolated, crash-resumable batched fit.
 
@@ -1264,7 +1599,31 @@ def fit_resilient(
 
     ``budget_s=None`` runs until complete (a wedged accelerator is probed
     indefinitely); with a budget, raises TimeoutError when it ends with
-    coverage incomplete.  Returns the full-batch FitState.
+    coverage incomplete.  Returns the full-batch FitState, annotated with
+    a ``resilience`` report (resilience.report.get_report).
+
+    Robustness semantics (docs/RESILIENCE.md):
+
+    * The finite-observed-y contract (``isfinite(y)`` wherever
+      ``mask == 1``) is validated HERE, before any data is spilled or a
+      worker spawned: with ``quarantine=False`` the contract error is
+      raised immediately (the in-process path's behavior) instead of
+      crash-looping through ~9 child spawns; with ``quarantine=True``
+      (default) the offending series are quarantined up front and the
+      survivors fit normally.
+    * A chunk that kills the worker repeatedly is bisected down to the
+      poison series (``quarantine=True``): survivors are fit, the poison
+      rows return NaN parameters with ``status=STATUS_QUARANTINED`` and
+      are listed in the report — one bad series cannot stall a
+      million-series run.  More than ``max_quarantine`` "poison" series
+      is read as an environmental fault instead: the remaining ranges
+      degrade to the CPU backend with a loud ``ResilienceWarning``
+      (``degrade_to_cpu=False`` raises instead).
+    * Chunk files carry payload CRCs; corrupt/torn ones are quarantined
+      (``*.corrupt``) and re-fit automatically before assembly.
+
+    ``retry_policy``/``probe_policy`` tune the respawn and accelerator
+    probe schedules (resilience.policy.RetryPolicy).
     """
     import shutil
     import tempfile
@@ -1279,6 +1638,31 @@ def fit_resilient(
         )
     y = np.asarray(y)
     series = y.shape[0]
+
+    # Finite-observed-y pre-validation (the pack_fit_data contract): a
+    # violating batch would kill EVERY worker at pack time with zero
+    # progress, so the parent used to crash-loop through the whole
+    # fruitless-retry budget before surfacing the error the in-process
+    # path raises immediately (ADVICE r5).
+    mask_spill = mask
+    poisoned: list = []
+    if mask is not None:
+        m = np.asarray(mask)
+        bad_rows = np.flatnonzero(
+            ((m > 0) & ~np.isfinite(y)).any(axis=tuple(range(1, y.ndim)))
+        )
+        if bad_rows.size:
+            if not quarantine:
+                raise ValueError(
+                    f"fit_resilient requires finite y wherever mask == 1 "
+                    f"(the packed chunk-worker contract); series "
+                    f"{bad_rows[:8].tolist()} violate it.  Fix the data, "
+                    f"drop the mask (NaN then counts as missing), or pass "
+                    f"quarantine=True to fit the survivors."
+                )
+            poisoned = [int(i) for i in bad_rows]
+            mask_spill = m.copy()
+            mask_spill[bad_rows] = 0.0  # inert rows; overwritten below
     own_scratch = scratch_dir is None
     scratch = scratch_dir or tempfile.mkdtemp(prefix="tsspark_resilient_")
     data_dir = os.path.join(scratch, "data")
@@ -1296,7 +1680,8 @@ def fit_resilient(
         {"ds": ds, "y": y, "mask": mask, "reg": regressors, "cap": cap,
          "floor": floor},
         {"series": series, "chunk": chunk, "phase1_iters": phase1_iters,
-         "segment": segment, "no_phase1_tune": no_phase1_tune},
+         "segment": segment, "no_phase1_tune": no_phase1_tune,
+         "quarantine": quarantine},
     )
     fp_path = os.path.join(out_dir, "run_fingerprint")
     if os.path.exists(fp_path):
@@ -1317,13 +1702,20 @@ def fit_resilient(
             )
         fresh = True
     if fresh or not os.path.exists(os.path.join(data_dir, "ds.npy")):
-        spill_data(data_dir, ds, y, mask=mask, regressors=regressors,
+        spill_data(data_dir, ds, y, mask=mask_spill, regressors=regressors,
                    cap=cap, floor=floor)
     save_run_config(out_dir, config, solver_config)
     if fresh:
         with open(fp_path, "w") as fh:
             fh.write(fp)
-    state = run_resilient(
+    deadline = (time.time() + budget_s) if budget_s else None
+    report = ResilienceReport(quarantined=tuple(
+        QuarantineRecord(
+            i, "non-finite observed y (mask == 1 on a non-finite cell); "
+               "contract violation quarantined before fitting",
+        ) for i in poisoned
+    ))
+    run_kwargs = dict(
         data_dir=data_dir,
         out_dir=out_dir,
         series=series,
@@ -1332,19 +1724,93 @@ def fit_resilient(
         segment=segment,
         phase1_iters=phase1_iters,
         no_phase1_tune=no_phase1_tune,
-        deadline=(time.time() + budget_s) if budget_s else None,
+        deadline=deadline,
         progress_timeout=progress_timeout,
+        retry_policy=retry_policy,
+        probe_policy=probe_policy,
     )
-    if not state.get("complete"):
-        raise TimeoutError(
-            f"fit_resilient budget exhausted with incomplete coverage; "
-            f"partial chunks kept in {scratch} (pass scratch_dir="
-            f"{scratch!r} to resume)"
-        )
-    result = load_fit_state(out_dir, series)
+    # Outer recovery loop: each round either completes coverage, turns a
+    # crash loop into quarantines/degradation (quarantine=True), or
+    # re-queues ranges whose chunk files failed the integrity check.
+    # Bounded: a persistent corruptor or crash source must not spin the
+    # parent forever.
+    crash_rounds = integrity_rounds = 0
+    run_state: dict = {}
+    while True:
+        try:
+            run_state = run_resilient(state=run_state, **run_kwargs)
+        except WorkerCrashLoopError:
+            if not quarantine:
+                raise
+            crash_rounds += 1
+            if crash_rounds > 3:
+                raise
+            report = _bisect_quarantine(
+                data_dir=data_dir, out_dir=out_dir, series=series,
+                chunk=chunk, segment=segment, phase1_iters=phase1_iters,
+                no_phase1_tune=no_phase1_tune,
+                progress_timeout=progress_timeout,
+                retry_policy=run_kwargs["retry_policy"] or WORKER_RETRY_POLICY,
+                report=report, model_config=config,
+                solver_config=solver_config, max_quarantine=max_quarantine,
+                degrade_to_cpu=degrade_to_cpu, deadline=deadline,
+            )
+            run_state = {}
+            continue  # re-enter for the phase-2 pass / remaining ranges
+        if not run_state.get("complete"):
+            raise TimeoutError(
+                f"fit_resilient budget exhausted with incomplete coverage; "
+                f"partial chunks kept in {scratch} (pass scratch_dir="
+                f"{scratch!r} to resume)"
+            )
+        try:
+            result = load_fit_state(out_dir, series)
+            break
+        except ChunkIntegrityError as e:
+            # The corrupt chunks are already quarantined (*.corrupt) and
+            # their ranges missing again; drop the phase-2 marker so the
+            # refit chunks get their straggler pass too, then go again.
+            integrity_rounds += 1
+            report = dataclasses.replace(
+                report,
+                corrupt_chunks=report.corrupt_chunks + tuple(e.ranges),
+            )
+            if integrity_rounds > 3:
+                raise
+            marker = os.path.join(out_dir, "phase2_done")
+            if os.path.exists(marker):
+                os.remove(marker)
+            run_state = {}
+    report = dataclasses.replace(
+        report, retries=int(run_state.get("retries", 0))
+    )
+    if report.quarantined:
+        result = _mark_quarantined_rows(result, report.quarantined_indices)
     if own_scratch and not keep_scratch:
         shutil.rmtree(scratch, ignore_errors=True)
-    return result
+    return attach_report(result, report)
+
+
+def _mark_quarantined_rows(state, indices):
+    """NaN out quarantined rows in the assembled FitState (their chunk
+    slots were fit as inert all-masked rows or placeholders): theta/loss
+    NaN, converged False, status STATUS_QUARANTINED."""
+    import numpy as np
+
+    idx = np.asarray(sorted(indices), np.int64)
+    theta = np.asarray(state.theta).copy()
+    loss = np.asarray(state.loss).copy()
+    grad = np.asarray(state.grad_norm).copy()
+    conv = np.asarray(state.converged).copy()
+    theta[idx] = np.nan
+    loss[idx] = np.nan
+    grad[idx] = np.nan
+    conv[idx] = False
+    status = (np.asarray(state.status).copy() if state.status is not None
+              else np.zeros(conv.shape[0], np.int32))
+    status[idx] = STATUS_QUARANTINED
+    return state._replace(theta=theta, loss=loss, grad_norm=grad,
+                          converged=conv, status=status)
 
 
 # --------------------------------------------------------------------------

@@ -46,14 +46,20 @@ def pad_to_multiple(n: int, multiple: int) -> int:
 
 def _resolve_time_axis(mesh: Mesh, config: ShardingConfig):
     """Time axis for a layout: the config's declared name wins; otherwise
-    fall back to the first mesh axis that is NOT the series axis.  Taking
-    axis_names[1] positionally put the SERIES axis on the time dimension
-    for a mesh declared ("time", "series") (ADVICE r4).  Shared by the
-    plain and packed spec builders so the two feeds can never resolve
-    different time axes for the same mesh."""
+    an axis literally named "time" (the convention TpuBackend's default
+    layout honors — on a 3-axis mesh like ("series", "x", "time") the
+    first-non-series fallback would lay time-major leaves on "x" and
+    leave the declared "time" axis unused, ADVICE r5); otherwise the
+    first mesh axis that is NOT the series axis.  Taking axis_names[1]
+    positionally put the SERIES axis on the time dimension for a mesh
+    declared ("time", "series") (ADVICE r4).  Shared by the plain and
+    packed spec builders so the two feeds can never resolve different
+    time axes for the same mesh."""
     t_ax = config.time_axis
     if t_ax is None:
         rest = [n for n in mesh.axis_names if n != config.series_axis]
+        if "time" in rest:
+            return "time"
         t_ax = rest[0] if rest else None
     return t_ax
 

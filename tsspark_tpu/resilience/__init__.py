@@ -1,0 +1,54 @@
+"""Resilience subsystem: unified retry policy, deterministic fault
+injection, checkpoint integrity, and quarantine reporting.
+
+The paper's pitch is Spark-matching scale; at millions of series,
+single-series failures, flaky accelerator tunnels, and torn checkpoint
+files are the steady state, not the exception.  This package is the one
+place that behavior is defined:
+
+  policy.py    — ``RetryPolicy``: max attempts, exponential backoff +
+                 deterministic jitter, per-attempt deadlines, total
+                 budget.  Replaces the ad-hoc sleep/retry constants that
+                 used to be scattered through ``orchestrate.py`` and the
+                 streaming poll loops.
+  faults.py    — ``FaultPlan`` / ``inject``: env-driven, deterministic
+                 fault injection at named points (worker spawn, device
+                 probe, chunk save, chunk fit, streaming poll), so every
+                 recovery path is unit-testable on CPU without a real
+                 TPU failure.
+  integrity.py — CRC32 payload checksums in every chunk/prep npz;
+                 corrupt or torn files are quarantined (``*.corrupt``)
+                 and their ranges re-queued instead of crashing or
+                 silently loading garbage.
+  report.py    — ``ResilienceReport`` attached to the ``FitState`` a
+                 resilient fit returns: quarantined series + reasons,
+                 integrity quarantines, CPU degradation, warnings.
+
+See ``docs/RESILIENCE.md`` for the operator-facing walkthrough.
+"""
+
+from tsspark_tpu.resilience.faults import FaultInjected, FaultPlan, inject
+from tsspark_tpu.resilience.integrity import ChunkIntegrityError
+from tsspark_tpu.resilience.policy import RetryPolicy
+from tsspark_tpu.resilience.report import (
+    QuarantineRecord,
+    ResilienceReport,
+    ResilienceWarning,
+    STATUS_QUARANTINED,
+    attach_report,
+    get_report,
+)
+
+__all__ = [
+    "ChunkIntegrityError",
+    "FaultInjected",
+    "FaultPlan",
+    "QuarantineRecord",
+    "ResilienceReport",
+    "ResilienceWarning",
+    "RetryPolicy",
+    "STATUS_QUARANTINED",
+    "attach_report",
+    "get_report",
+    "inject",
+]

@@ -1,0 +1,232 @@
+"""Deterministic fault injection: make every recovery path testable on CPU.
+
+The orchestrator's retry/quarantine/integrity machinery exists for
+failures (worker OOM, wedged tunnel, torn chunk file, poison series)
+that cannot be provoked on demand without real hardware faults.  This
+harness plants named injection points on those paths; a ``FaultPlan``
+arms some of them, and the plan travels through the environment
+(``TSSPARK_FAULTS``) so the orchestrator's CHILD PROCESSES see the same
+plan the test armed in the parent.
+
+Determinism & cross-process accounting: each armed rule carries a fixed
+call window (``after`` skipped calls, then ``attempts`` firings).  Call
+slots are claimed by atomic ``O_CREAT|O_EXCL`` file creation under the
+plan's ``state_dir``, so the N-th matching call fires the same way no
+matter which process makes it, and a respawned worker does not reset the
+count — exactly how a real flaky environment behaves.
+
+Named points currently wired (see docs/RESILIENCE.md):
+
+  worker_spawn      parent, before launching a child       (flag/raise)
+  device_probe      tunnel_preflight                       (flag)
+  fit_worker_start  child entry                            (exit/raise)
+  fit_chunk         child, before a chunk's fit dispatch   (exit/raise)
+  fit_worker_chunk  child, after a chunk save              (exit/raise)
+  chunk_save        after save_chunk_atomic's rename       (corrupt)
+  prep_save         after save_prep_atomic's rename        (corrupt)
+  backend_fit       TpuBackend.fit entry                   (raise)
+  stream_poll       streaming source poll                  (raise)
+
+Production safety: with ``TSSPARK_FAULTS`` unset, ``inject`` is a single
+dict lookup returning immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional
+
+ENV_VAR = "TSSPARK_FAULTS"
+
+_MODES = ("raise", "exit", "flag", "corrupt")
+
+# Guard against a runaway call counter chewing the state dir: no test
+# plan legitimately sees this many calls at one point.
+_MAX_CALLS = 100_000
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``raise``-mode injection point."""
+
+    def __init__(self, point: str, rule_id: str):
+        super().__init__(
+            f"fault injected at {point!r} (rule {rule_id}); this error is "
+            f"deliberate — a FaultPlan armed this point"
+        )
+        self.point = point
+        self.rule_id = rule_id
+
+
+class FaultPlan:
+    """A seeded, serializable set of armed failure rules.
+
+    Usage (tests)::
+
+        plan = (FaultPlan(state_dir=tmp)
+                .fail("fit_worker_chunk", after=1, attempts=2, mode="exit")
+                .fail("chunk_save", series=40, mode="corrupt"))
+        monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+
+    ``fail(point, ...)``:
+      attempts — how many matching calls fire (after the skip window).
+      after    — matching calls to let through before firing (e.g. "kill
+                 the worker after it lands 2 chunks").
+      mode     — "raise" (FaultInjected), "exit" (``os._exit(rc)``,
+                 simulates a worker death), "flag" (``inject`` returns
+                 True; the site fails soft, e.g. a probe returning
+                 False), "corrupt" (``corrupt_file`` flips bytes in the
+                 file the site just wrote).
+      series   — only fire when the call's ``(lo, hi)`` context covers
+                 this series index (how a poison SERIES is simulated:
+                 the chunk containing it dies wherever it lands).
+      rc       — exit code for "exit" mode.
+    """
+
+    def __init__(self, state_dir: Optional[str] = None):
+        self.state_dir = state_dir or tempfile.mkdtemp(
+            prefix="tsspark_faults_"
+        )
+        self.rules: List[dict] = []
+
+    def fail(self, point: str, *, attempts: int = 1, after: int = 0,
+             mode: str = "raise", series: Optional[int] = None,
+             rc: int = 23) -> "FaultPlan":
+        if mode not in _MODES:
+            raise ValueError(f"mode {mode!r} not in {_MODES}")
+        if attempts < 1 or after < 0:
+            raise ValueError("attempts must be >= 1 and after >= 0")
+        self.rules.append({
+            "id": f"r{len(self.rules)}_{point}",
+            "point": point, "attempts": int(attempts), "after": int(after),
+            "mode": mode, "series": series, "rc": int(rc),
+        })
+        return self
+
+    def to_env(self) -> str:
+        os.makedirs(self.state_dir, exist_ok=True)
+        return json.dumps({"state_dir": self.state_dir, "rules": self.rules})
+
+    def install(self, env: Optional[Dict[str, str]] = None) -> None:
+        """Arm the plan for this process tree (``os.environ`` default)."""
+        (os.environ if env is None else env)[ENV_VAR] = self.to_env()
+
+    @classmethod
+    def from_env(cls, spec: str) -> "FaultPlan":
+        d = json.loads(spec)
+        plan = cls(state_dir=d["state_dir"])
+        plan.rules = list(d["rules"])
+        return plan
+
+
+_plan_cache: Dict[str, Optional[FaultPlan]] = {}
+
+
+def _active_plan() -> Optional[FaultPlan]:
+    spec = os.environ.get(ENV_VAR)
+    if not spec:
+        return None
+    plan = _plan_cache.get(spec)
+    if plan is None:
+        try:
+            plan = FaultPlan.from_env(spec)
+        except (ValueError, KeyError, TypeError):
+            plan = None  # malformed spec: fail open, never break prod
+        _plan_cache[spec] = plan
+    return plan
+
+
+def _matches(rule: dict, lo: Optional[int], hi: Optional[int]) -> bool:
+    s = rule.get("series")
+    if s is None:
+        return True
+    if lo is None:
+        return True  # series-targeted rule at a context-free call site
+    return lo <= s < (hi if hi is not None else lo + 1)
+
+
+def _claim_call(state_dir: str, rule: dict) -> Optional[int]:
+    """Atomically claim this call's global 0-based sequence number for
+    ``rule`` (cross-process: first O_CREAT|O_EXCL success wins a slot)."""
+    for n in range(_MAX_CALLS):
+        path = os.path.join(state_dir, f"{rule['id']}.{n}")
+        try:
+            os.close(os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            return n
+        except FileExistsError:
+            continue
+        except OSError:
+            return None  # unwritable state dir: fail open
+    return None
+
+
+def _armed_call(rule: dict, state_dir: str,
+                lo: Optional[int], hi: Optional[int]) -> bool:
+    """True when this call falls inside the rule's firing window."""
+    if not _matches(rule, lo, hi):
+        return False
+    n = _claim_call(state_dir, rule)
+    if n is None:
+        return False
+    return rule["after"] <= n < rule["after"] + rule["attempts"]
+
+
+def inject(point: str, *, lo: Optional[int] = None,
+           hi: Optional[int] = None) -> bool:
+    """Fault injection point.  No-op (False) unless a plan arms ``point``.
+
+    ``lo``/``hi``: the series range this call is operating on, matched
+    against series-targeted rules.  Returns True when a "flag"-mode rule
+    fires (the caller fails soft); "raise" raises ``FaultInjected``;
+    "exit" kills the process like a real worker death.
+    """
+    plan = _active_plan()
+    if plan is None:
+        return False
+    flagged = False
+    for rule in plan.rules:
+        if rule["point"] != point or rule["mode"] == "corrupt":
+            continue
+        if not _armed_call(rule, plan.state_dir, lo, hi):
+            continue
+        if rule["mode"] == "exit":
+            os._exit(rule["rc"])
+        if rule["mode"] == "raise":
+            raise FaultInjected(point, rule["id"])
+        flagged = True
+    return flagged
+
+
+def corrupt_file(point: str, path: str, *, lo: Optional[int] = None,
+                 hi: Optional[int] = None) -> bool:
+    """Corruption injection point: when a "corrupt"-mode rule at
+    ``point`` fires, flip bytes in the middle of ``path`` (simulating
+    silent media corruption of a just-written checkpoint).  Returns True
+    when corruption was applied."""
+    plan = _active_plan()
+    if plan is None:
+        return False
+    hit = False
+    for rule in plan.rules:
+        if rule["point"] != point or rule["mode"] != "corrupt":
+            continue
+        if not _armed_call(rule, plan.state_dir, lo, hi):
+            continue
+        try:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                # Flip 16 bytes at several offsets spread across the
+                # file: a single mid-file flip can land entirely inside
+                # npz/zip alignment padding that no loader ever parses,
+                # which would make the "corruption" silently benign.
+                for k in range(1, 8):
+                    off = size * k // 8
+                    fh.seek(off)
+                    chunk = fh.read(16)
+                    fh.seek(off)
+                    fh.write(bytes(b ^ 0xFF for b in chunk))
+            hit = True
+        except OSError:
+            pass
+    return hit

@@ -1,0 +1,128 @@
+"""Checkpoint integrity: CRC32 payload checksums + quarantine.
+
+Chunk and prep files are written atomically (dotfile + rename), which
+protects against a reader seeing a half-written file — but not against
+silent media corruption, a torn write surviving a power loss, or a stale
+tool rewriting a payload.  Every npz now carries a CRC32 of its payload
+bytes (``integrity_crc``, computed over name/dtype/shape/bytes of every
+array); loaders verify it and QUARANTINE failures — the file is renamed
+``*.corrupt`` (kept for forensics, invisible to the resume globs) so its
+range reappears in ``missing_ranges`` and is re-fit, instead of the run
+crashing or silently assembling garbage into a million-series result.
+
+Verification treats "unreadable" (torn zip, truncated file) and "reads
+but mismatches" identically: both quarantine.  Files written by older
+versions (no ``integrity_crc`` entry) pass — np.load's zip CRCs already
+vouch for their payload bytes.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+INTEGRITY_KEY = "integrity_crc"
+
+
+class ChunkIntegrityError(RuntimeError):
+    """Corrupt/torn chunk files were found and quarantined; the caller
+    should re-queue the attached ranges (they are now missing)."""
+
+    def __init__(self, out_dir: str, ranges: List[Tuple[int, int]]):
+        super().__init__(
+            f"{len(ranges)} corrupt chunk file(s) quarantined in "
+            f"{out_dir}: {ranges} — ranges re-queued for refit"
+        )
+        self.out_dir = out_dir
+        self.ranges = ranges
+
+
+def payload_crc(arrays: Dict) -> int:
+    """CRC32 over every array's name, dtype, shape, and raw bytes, in
+    name-sorted order (dict insertion order must not matter)."""
+    import numpy as np
+
+    crc = 0
+    for name in sorted(arrays):
+        if name == INTEGRITY_KEY:
+            continue
+        a = np.ascontiguousarray(np.asarray(arrays[name]))
+        for token in (name, str(a.dtype), str(a.shape)):
+            crc = zlib.crc32(token.encode(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def stamp(arrays: Dict) -> Dict:
+    """Return ``arrays`` plus its ``integrity_crc`` entry (uint32)."""
+    import numpy as np
+
+    out = dict(arrays)
+    out[INTEGRITY_KEY] = np.uint32(payload_crc(arrays))
+    return out
+
+
+def verify_arrays(z) -> bool:
+    """Verify a loaded npz (or dict of arrays) against its stamp.
+    Unstamped (legacy) payloads pass."""
+    import numpy as np
+
+    try:
+        keys = list(getattr(z, "files", None) or z.keys())
+        # Read the FULL payload before deciding anything: corruption can
+        # mangle the zip central directory so the stamp entry vanishes
+        # from the key list — an unstamped-looking file only passes as
+        # "legacy" if every array in it is actually readable.
+        arrays = {k: z[k] for k in keys if k != INTEGRITY_KEY}
+        if INTEGRITY_KEY not in keys:
+            return True
+        return int(np.asarray(z[INTEGRITY_KEY])) == payload_crc(arrays)
+    except Exception:
+        return False  # a payload that cannot even be read is corrupt
+
+
+def verify_file(path: str) -> bool:
+    """True when ``path`` loads cleanly and matches its stamp."""
+    import numpy as np
+
+    try:
+        with np.load(path) as z:
+            return verify_arrays(z)
+    except Exception:
+        return False  # torn/truncated/garbage file
+
+
+def quarantine(path: str) -> str:
+    """Rename a corrupt file out of the resume globs (kept for
+    forensics); returns the new path."""
+    dest = path + ".corrupt"
+    # A repeat offender at the same range overwrites the previous
+    # quarantined copy — the latest evidence is the interesting one.
+    os.replace(path, dest)
+    return dest
+
+
+def sweep_chunks(out_dir: str, pattern: str = "chunk_*.npz"
+                 ) -> List[Tuple[int, int]]:
+    """Verify every chunk file in ``out_dir``; quarantine failures.
+
+    Returns the (lo, hi) ranges quarantined — each is now missing from
+    coverage and will be re-fit by the normal retry machinery.  Called
+    at fit-worker start (so a resume never trusts a corrupt chunk) and
+    before final assembly in ``load_fit_state``.
+    """
+    bad: List[Tuple[int, int]] = []
+    for path in sorted(glob.glob(os.path.join(out_dir, pattern))):
+        if verify_file(path):
+            continue
+        quarantine(path)
+        base = os.path.basename(path)
+        stem = base[base.index("_") + 1:-len(".npz")]
+        try:
+            lo_s, hi_s = stem.split("_")
+            bad.append((int(lo_s), int(hi_s)))
+        except ValueError:
+            continue  # foreign file name matched the glob; just renamed
+    return sorted(bad)

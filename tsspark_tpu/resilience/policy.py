@@ -1,0 +1,155 @@
+"""Unified retry policy: one dataclass for every retry loop in the repo.
+
+Before this module, retry behavior lived as hard-coded constants spread
+across ``orchestrate.py`` (fixed 10 s post-crash sleeps, a fixed
+fruitless-retry cap of 8, the 5 s -> x1.5 -> 30 s probe backoff, the
+30 + 15*consec <= 90 s probe-patience escalation) and the streaming
+driver's poll loop.  ``RetryPolicy`` expresses all of those as data, so
+call sites accept a policy and tests/operators tune recovery behavior
+without editing control flow.  The module-level default policies below
+reproduce the exact pre-existing schedules.
+
+Jitter is DETERMINISTIC: it is derived from ``(seed, attempt)``, never
+from global RNG state or wall-clock entropy, so a replayed run sleeps
+the same intervals — the property the fault-injection harness
+(faults.py) relies on to make recovery paths reproducible.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How many times to try, how long to wait, and when to give up.
+
+    ``max_attempts``: total attempts allowed (``None`` = unbounded; the
+    probe loop uses this — a wedged runtime recovers on its own
+    schedule).  ``allows(n)`` answers "may attempt number ``n`` (0-based
+    count of attempts already made) start?".
+
+    ``base_delay_s`` / ``backoff`` / ``max_delay_s`` / ``jitter``: the
+    sleep before retry ``k`` (0-based) is
+    ``min(base * backoff**k, max_delay)``, scaled by a deterministic
+    jitter factor in ``[1 - jitter, 1 + jitter]`` drawn from
+    ``(seed, k)``.
+
+    ``attempt_timeout_s`` (+ ``attempt_timeout_step_s``, capped at
+    ``attempt_timeout_max_s``): per-attempt deadline, escalating with
+    consecutive failures — a healthy-but-slow dependency must not fail
+    every probe forever, so each failure buys the next attempt more
+    patience.
+
+    ``total_budget_s``: overall wall budget across all attempts
+    (``deadline_from(start)`` converts it to an absolute deadline).
+    """
+
+    max_attempts: Optional[int] = 9
+    base_delay_s: float = 10.0
+    backoff: float = 1.0
+    max_delay_s: float = 30.0
+    jitter: float = 0.0
+    attempt_timeout_s: Optional[float] = None
+    attempt_timeout_step_s: float = 0.0
+    attempt_timeout_max_s: Optional[float] = None
+    total_budget_s: Optional[float] = None
+    seed: int = 0
+
+    def allows(self, attempts_made: int) -> bool:
+        """True if another attempt may start after ``attempts_made``."""
+        return self.max_attempts is None or attempts_made < self.max_attempts
+
+    def delay_s(self, retry: int) -> float:
+        """Sleep before 0-based retry number ``retry`` (deterministic)."""
+        d = min(
+            self.base_delay_s * (self.backoff ** max(0, retry)),
+            self.max_delay_s,
+        )
+        if self.jitter:
+            # String seeding: deterministic across processes and Python
+            # versions (tuple seeds are hash-based and deprecated).
+            u = random.Random(f"{self.seed}:{retry}").uniform(-1.0, 1.0)
+            d *= 1.0 + self.jitter * u
+        return max(0.0, d)
+
+    def attempt_timeout(self, consecutive_failures: int = 0
+                        ) -> Optional[float]:
+        """Per-attempt deadline after ``consecutive_failures`` failures."""
+        if self.attempt_timeout_s is None:
+            return None
+        t = (self.attempt_timeout_s
+             + self.attempt_timeout_step_s * max(0, consecutive_failures))
+        if self.attempt_timeout_max_s is not None:
+            t = min(t, self.attempt_timeout_max_s)
+        return t
+
+    def deadline_from(self, start: float) -> Optional[float]:
+        """Absolute deadline for the whole retry loop, or None."""
+        if self.total_budget_s is None:
+            return None
+        return start + self.total_budget_s
+
+    def sleep(self, retry: int, deadline: Optional[float] = None) -> float:
+        """Sleep ``delay_s(retry)``, clamped to ``deadline``; returns the
+        seconds actually slept."""
+        d = self.delay_s(retry)
+        if deadline is not None:
+            d = max(0.0, min(d, deadline - time.time()))
+        if d > 0:
+            time.sleep(d)
+        return d
+
+    def call(self, fn: Callable, *,
+             retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+             on_retry: Optional[Callable[[int, BaseException], None]] = None):
+        """Run ``fn()`` under this policy: retry on ``retry_on`` with the
+        backoff schedule until an attempt succeeds, the attempt budget is
+        exhausted, or the total budget runs out — then re-raise the last
+        error.  The streaming poll loops ride this helper."""
+        deadline = self.deadline_from(time.time())
+        for attempt in itertools.count():
+            try:
+                return fn()
+            except retry_on as e:
+                out_of_attempts = not self.allows(attempt + 1)
+                out_of_budget = (
+                    deadline is not None and time.time() >= deadline
+                )
+                if out_of_attempts or out_of_budget:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                self.sleep(attempt, deadline)
+
+
+# The pre-existing schedules, named.  Call sites default to these so the
+# refactor preserves behavior exactly; callers override per run.
+
+#: orchestrate.run_resilient's post-crash schedule: fixed 10 s sleep
+#: between worker respawns, give up after 9 consecutive zero-progress
+#: deaths (the old ``max_fruitless_retries=8`` semantics: raise when the
+#: count EXCEEDS 8).
+WORKER_RETRY = RetryPolicy(
+    max_attempts=9, base_delay_s=10.0, backoff=1.0, max_delay_s=10.0,
+)
+
+#: The accelerator probe loop: 5 s sleeps escalating x1.5 to a 30 s cap
+#: between failed probes (reset on success), per-probe patience
+#: 30 + 15*consec capped at 90 s, never giving up (a wedged runtime
+#: recovers on its own schedule).
+PROBE = RetryPolicy(
+    max_attempts=None, base_delay_s=5.0, backoff=1.5, max_delay_s=30.0,
+    attempt_timeout_s=30.0, attempt_timeout_step_s=15.0,
+    attempt_timeout_max_s=90.0,
+)
+
+#: Streaming micro-batch poll: transient source errors (broker hiccup,
+#: network blip) retried with 1 s -> x2 -> 30 s backoff, five attempts.
+STREAM_POLL = RetryPolicy(
+    max_attempts=5, base_delay_s=1.0, backoff=2.0, max_delay_s=30.0,
+)

@@ -35,7 +35,8 @@ from tsspark_tpu.config import ProphetConfig, SolverConfig
 from tsspark_tpu.frame import _days_to_ts, _ds_to_days
 from tsspark_tpu.models.prophet.design import prepare_fit_data
 from tsspark_tpu.models.prophet.init import initial_theta
-from tsspark_tpu.streaming.source import MicroBatchSource
+from tsspark_tpu.resilience.policy import RetryPolicy
+from tsspark_tpu.streaming.source import MicroBatchSource, ResilientSource
 from tsspark_tpu.streaming.state import ParamStore
 from tsspark_tpu.streaming.warmstart import transfer_theta
 
@@ -146,8 +147,16 @@ class StreamingForecaster:
         self.stats.batch_seconds.append(dt)
 
     def run(self, source: MicroBatchSource,
-            max_batches: Optional[int] = None) -> RefitStats:
-        """Drain the source (or up to ``max_batches``)."""
+            max_batches: Optional[int] = None,
+            poll_policy: Optional[RetryPolicy] = None) -> RefitStats:
+        """Drain the source (or up to ``max_batches``).
+
+        ``poll_policy``: wrap the source so transient poll failures are
+        retried with backoff (resilience.policy.RetryPolicy) instead of
+        killing the driver mid-stream; commits still happen only after
+        a refit lands, so retries preserve at-least-once delivery."""
+        if poll_policy is not None:
+            source = ResilientSource(source, poll_policy)
         n = 0
         for batch in source:
             self.process(batch)

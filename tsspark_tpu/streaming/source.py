@@ -13,9 +13,15 @@ Contract: ``poll()`` returns a long-format DataFrame of NEW observations
 from __future__ import annotations
 
 import abc
+import itertools
+import sys
+import time
 from typing import Iterable, List, Optional
 
 import pandas as pd
+
+from tsspark_tpu.resilience import faults
+from tsspark_tpu.resilience.policy import STREAM_POLL, RetryPolicy
 
 
 class MicroBatchSource(abc.ABC):
@@ -38,6 +44,40 @@ class MicroBatchSource(abc.ABC):
     def __iter__(self):
         while (batch := self.poll()) is not None:
             yield batch
+
+
+class ResilientSource(MicroBatchSource):
+    """Retry wrapper for any source's poll loop.
+
+    A transient poll failure (broker hiccup, network blip, injected
+    ``stream_poll`` fault) is retried under a ``RetryPolicy`` with
+    backoff instead of killing the streaming driver; a failure that
+    outlives the policy's attempt/budget limits re-raises.  ``commit``
+    passes through untouched — offsets are only ever acknowledged by the
+    driver after a refit lands, so retried polls stay at-least-once.
+    """
+
+    def __init__(self, source: MicroBatchSource,
+                 policy: Optional[RetryPolicy] = None):
+        self._source = source
+        self._policy = policy or STREAM_POLL
+
+    def poll(self) -> Optional[pd.DataFrame]:
+        for attempt in itertools.count():
+            try:
+                faults.inject("stream_poll")
+                return self._source.poll()
+            except Exception as e:
+                if not self._policy.allows(attempt + 1):
+                    raise
+                print(
+                    f"[streaming] poll failed ({type(e).__name__}: {e}); "
+                    f"retry {attempt + 1}", file=sys.stderr,
+                )
+                time.sleep(self._policy.delay_s(attempt))
+
+    def commit(self) -> None:
+        self._source.commit()
 
 
 class InMemorySource(MicroBatchSource):
@@ -66,10 +106,18 @@ class KafkaSource(MicroBatchSource):
     {partition: [records with .value]}``) — how the tests exercise this
     path without a broker, and how alternative clients plug in.  Without
     it, a ``kafka-python``-compatible package must be importable.
+
+    ``retry_policy``: when given, transient consumer-poll errors are
+    retried under it (e.g. resilience.policy.STREAM_POLL) before
+    propagating.  Default None — no built-in retry, so wrapping the
+    source in ``ResilientSource`` (or ``run(poll_policy=...)``) stays
+    the ONE retry layer; configuring both would multiply attempts.
     """
 
     def __init__(self, topic: Optional[str] = None, max_records: int = 10000,
-                 consumer=None, **consumer_kwargs):
+                 consumer=None, retry_policy: Optional[RetryPolicy] = None,
+                 **consumer_kwargs):
+        self._retry_policy = retry_policy
         if consumer is not None:
             self._consumer = consumer
         else:
@@ -92,8 +140,11 @@ class KafkaSource(MicroBatchSource):
         self._max_records = max_records
 
     def poll(self) -> Optional[pd.DataFrame]:
-        records = self._consumer.poll(timeout_ms=1000,
-                                      max_records=self._max_records)
+        do_poll = lambda: self._consumer.poll(
+            timeout_ms=1000, max_records=self._max_records
+        )
+        records = (self._retry_policy.call(do_poll)
+                   if self._retry_policy is not None else do_poll())
         rows = [msg.value for part in records.values() for msg in part]
         if not rows:
             return None
