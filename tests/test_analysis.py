@@ -1,0 +1,379 @@
+"""The static-analysis gate (tsspark_tpu.analysis, docs/ANALYSIS.md).
+
+Two layers: each checker must CATCH its seeded-violation fixture (a
+checker that silently passes everything is worse than no checker), and
+the full pass over this repo must be clean — the tier-1 gate every
+subsequent PR runs under.
+"""
+
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tsspark_tpu import analysis
+from tsspark_tpu.analysis import contracts, fileproto, tracelint
+from tsspark_tpu.analysis.config import (
+    AnalysisSettings, KernelMatrix, load_settings, repo_root,
+)
+from tsspark_tpu.analysis.findings import Finding, apply_suppressions
+from tsspark_tpu.utils.atomic import atomic_write, atomic_write_text
+
+
+# ---------------------------------------------------------------------------
+# trace-safety lint: seeded violations
+# ---------------------------------------------------------------------------
+
+_BAD_MODULE = textwrap.dedent(
+    '''
+    import functools
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @functools.partial(jax.jit, static_argnames=("depth",))
+    def kernel(x, y, depth):
+        if x > 0:                       # trace-branch
+            y = y + 1.0
+        z = float(y)                    # host-sync (builtin)
+        w = np.asarray(x)               # host-sync (numpy pull)
+        v = x.item()                    # host-sync (method)
+        u = jnp.zeros((3,), np.float64) # f64-dtype
+        return y + z + w + v + u.sum()
+
+
+    def helper(x, y=[]):                # static-hash (mutable default)
+        return x
+
+
+    @functools.partial(jax.jit, static_argnames=("ghost",))
+    def misnamed(x):                    # static-hash (ghost static)
+        return x
+
+
+    def rejitter(x):
+        f = jax.jit(lambda t: t + 1)    # static-hash (jit of lambda)
+        return f(x)
+
+
+    def flip():
+        jax.config.update("jax_enable_x64", True)  # f64-dtype (x64 flip)
+    '''
+)
+
+
+@pytest.fixture()
+def bad_module(tmp_path):
+    p = tmp_path / "badmod.py"
+    p.write_text(_BAD_MODULE)
+    return str(tmp_path), str(p)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def test_tracelint_catches_seeded_violations(bad_module):
+    root, path = bad_module
+    found = tracelint.lint_paths([path], root)
+    rules = _rules(found)
+    assert "trace-branch" in rules
+    assert "host-sync" in rules
+    assert "f64-dtype" in rules
+    assert "static-hash" in rules
+    # Each seeded hazard is caught individually, not via one noisy rule.
+    msgs = "\n".join(f.message for f in found)
+    assert "float()" in msgs
+    assert "np.asarray" in msgs
+    assert ".item()" in msgs
+    assert "mutable default" in msgs
+    assert "ghost" in msgs
+    assert "lambda" in msgs
+    assert "jax_enable_x64" in msgs
+
+
+def test_tracelint_inline_suppression(tmp_path):
+    src = textwrap.dedent(
+        """
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            if x > 0:  # lint-ok[trace-branch]: fixture justification
+                return x
+            return -x
+        """
+    )
+    p = tmp_path / "ok.py"
+    p.write_text(src)
+    found = tracelint.lint_paths([str(p)], str(tmp_path))
+    assert not found
+    # The same code WITHOUT the justification comment is flagged.
+    p.write_text(src.replace(
+        "  # lint-ok[trace-branch]: fixture justification", ""
+    ))
+    assert _rules(tracelint.lint_paths([str(p)], str(tmp_path))) == {
+        "trace-branch"
+    }
+
+
+def test_tracelint_static_params_not_flagged(tmp_path):
+    # Branching on a static argument (or shape/None-ness of a traced
+    # one) is trace-safe and must NOT be flagged: the gate stays
+    # credible only while it is quiet on correct idioms.
+    src = textwrap.dedent(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("config",))
+        def kernel(x, theta0, config):
+            if config.growth == "logistic":
+                x = x + 1.0
+            if theta0 is None:
+                theta0 = x
+            if x.shape[0] > 4:
+                x = x[:4]
+            return x + theta0
+        """
+    )
+    p = tmp_path / "good.py"
+    p.write_text(src)
+    assert not tracelint.lint_paths([str(p)], str(tmp_path))
+
+
+def test_baseline_suppression_applies():
+    f = Finding("host-sync", "tsspark_tpu/x.py", 12, "fn", "msg")
+    settings = AnalysisSettings(
+        suppressions=("host-sync @ tsspark_tpu/x.py::fn",)
+    )
+    kept, suppressed = apply_suppressions((f,), settings)
+    assert not kept and suppressed == (f,)
+    with pytest.raises(ValueError):
+        AnalysisSettings(suppressions=("garbage",)).suppression_keys()
+
+
+# ---------------------------------------------------------------------------
+# contract checker: seeded violations
+# ---------------------------------------------------------------------------
+
+_ONE_CASE = KernelMatrix(
+    batch_sizes=(4,), lengths=(16,), n_changepoints=(0,),
+    num_regressors=(0,), mesh_shapes=(),
+)
+
+
+def test_contracts_catch_f64_leak():
+    bad = contracts.KernelContract(
+        "bad.f64",
+        lambda case: jax.eval_shape(
+            lambda x: x.astype(jnp.float64), contracts._sds((case.b,))
+        ),
+    )
+    found = contracts.check_kernels(_ONE_CASE, kernels=[bad])
+    assert _rules(found) == {"f64-leak"}
+
+
+def test_contracts_catch_shape_violation():
+    bad = contracts.KernelContract(
+        "bad.shape",
+        lambda case: jax.eval_shape(
+            lambda x: x[None], contracts._sds((case.b,))
+        ),
+        lambda case, out: contracts._expect(
+            out, (case.b,), "float32", "out"
+        ),
+    )
+    found = contracts.check_kernels(_ONE_CASE, kernels=[bad])
+    assert _rules(found) == {"contract-shape"}
+
+
+def test_contracts_catch_trace_failure():
+    bad = contracts.KernelContract(
+        "bad.trace",
+        lambda case: jax.eval_shape(
+            lambda x: x.reshape((3, 5, 7)), contracts._sds((case.b,))
+        ),
+    )
+    found = contracts.check_kernels(_ONE_CASE, kernels=[bad])
+    assert _rules(found) == {"contract-trace"}
+
+
+def test_contracts_x64_mode_is_what_catches_drift():
+    # The seeded f64 cast is INVISIBLE with x64 off (jax truncates it
+    # to f32) — the checker must trace in x64 mode or the gate is
+    # vacuous.  This pins that mode choice.
+    def run(case):
+        return jax.eval_shape(
+            lambda x: x.astype(jnp.float64), contracts._sds((case.b,))
+        )
+
+    out = run(contracts.ShapeCase(4, 16, 0, 0))
+    assert str(out.dtype) == "float32"  # x64 off: silently truncated
+    found = contracts.check_kernels(
+        _ONE_CASE, kernels=[contracts.KernelContract("bad", run)]
+    )
+    assert _rules(found) == {"f64-leak"}
+
+
+# ---------------------------------------------------------------------------
+# file-protocol race checker: seeded violations
+# ---------------------------------------------------------------------------
+
+def test_fileproto_catches_non_atomic_write(tmp_path):
+    src = textwrap.dedent(
+        """
+        import numpy as np
+
+        def bad_writer(out_dir, state):
+            np.savez(out_dir + "/chunk_000000_000256.npz", **state)
+
+        def bad_sentinel(out_dir):
+            with open(out_dir + "/phase2_done", "w") as fh:
+                fh.write("ok")
+        """
+    )
+    rel = "tsspark_tpu/badproto.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True)
+    p.write_text(src)
+    found = fileproto.check_write_sites(str(tmp_path), modules=[rel])
+    assert _rules(found) == {"non-atomic-write"}
+    assert len(found) == 2
+    assert any("chunk-result" in f.message for f in found)
+    assert any("phase2-sentinel" in f.message for f in found)
+
+
+def test_fileproto_accepts_atomic_idioms(tmp_path):
+    src = textwrap.dedent(
+        """
+        import os
+        import numpy as np
+        from tsspark_tpu.utils.atomic import atomic_write
+
+        def save_chunk_atomic(out_dir, arrays):
+            atomic_write(out_dir + "/chunk_000000_000256.npz",
+                         lambda fh: np.savez(fh, **arrays))
+
+        def manual_idiom(path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(payload)
+            os.replace(tmp, path)
+        """
+    )
+    rel = "tsspark_tpu/okproto.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True)
+    p.write_text(src)
+    assert not fileproto.check_write_sites(str(tmp_path), modules=[rel])
+
+
+def test_fileproto_flags_unregistered_artifact(tmp_path):
+    src = textwrap.dedent(
+        """
+        def mystery(out_dir):
+            with open(out_dir + "/mystery_state.bin", "w") as fh:
+                fh.write("?")
+        """
+    )
+    rel = "tsspark_tpu/mystery.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True)
+    p.write_text(src)
+    found = fileproto.check_write_sites(str(tmp_path), modules=[rel])
+    assert len(found) == 1
+    assert found[0].rule == "non-atomic-write"
+
+
+def test_claim_model_catches_overlapping_planner():
+    def broken_plan(done, lo, hi, chunk):
+        # Ignores completed coverage: refits everything in the window.
+        return [(c_lo, min(c_lo + chunk, hi))
+                for c_lo in range(lo, hi, chunk)]
+
+    found = fileproto.check_claim_invariants(plan_fn=broken_plan)
+    assert "claim-overlap" in _rules(found)
+    assert any("overlaps completed coverage" in f.message for f in found)
+
+
+def test_claim_model_catches_hole_leaving_planner():
+    def lazy_plan(done, lo, hi, chunk):
+        from tsspark_tpu.orchestrate import plan_chunks
+
+        return plan_chunks(done, lo, hi, chunk)[:-1]  # drops a claim
+
+    found = fileproto.check_claim_invariants(plan_fn=lazy_plan)
+    assert any("do not tile" in f.message for f in found)
+
+
+def test_real_claim_protocol_is_clean():
+    assert not fileproto.check_claim_invariants()
+    assert not fileproto.check_completed_ranges_order()
+
+
+# ---------------------------------------------------------------------------
+# the shared atomic helper
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_roundtrip_and_cleanup(tmp_path):
+    target = str(tmp_path / "artifact.npz")
+    arrays = {"a": np.arange(5), "b": np.ones((2, 2))}
+    atomic_write(target, lambda fh: np.savez(fh, **arrays))
+    z = np.load(target)
+    np.testing.assert_array_equal(z["a"], arrays["a"])
+
+    atomic_write_text(str(tmp_path / "sentinel"), "ok\n")
+    assert (tmp_path / "sentinel").read_text() == "ok\n"
+
+    # A writer crash leaves NEITHER a torn target nor a stray temp.
+    with pytest.raises(RuntimeError):
+        atomic_write(str(tmp_path / "never.npz"),
+                     lambda fh: (_ for _ in ()).throw(RuntimeError("x")))
+    leftovers = sorted(os.listdir(tmp_path))
+    assert "never.npz" not in leftovers
+    assert not [f for f in leftovers if ".tmp" in f]
+
+
+# ---------------------------------------------------------------------------
+# the gate itself: this repo must be clean
+# ---------------------------------------------------------------------------
+
+def test_settings_load_from_pyproject():
+    settings = load_settings()
+    assert isinstance(settings.kernel_matrix.batch_sizes, tuple)
+    settings.suppression_keys()  # every committed entry parses
+
+
+def test_repo_passes_full_analysis():
+    """THE tier-1 gate: trace lint + kernel contracts + file protocol
+    over the repository, with only the committed baseline suppressed.
+    A finding here means a new hazard (or an unjustified suppression) —
+    fix it or baseline it WITH a justification, never skip this test."""
+    report = analysis.run_all(root=repo_root())
+    assert report.ok, "\n".join(str(f) for f in report.findings)
+
+
+def test_sweep_stale_temps_bounds_orphans(tmp_path):
+    """A SIGKILLed writer's pid-suffixed temp is uniquely named, so no
+    retry ever overwrites it — the sweep is what bounds scratch growth.
+    Fresh temps (a live writer mid-save) must survive the sweep."""
+    from tsspark_tpu.utils.atomic import sweep_stale_temps
+
+    stale = tmp_path / ".chunk_000000_000512.npz.tmp.12345"
+    stale.write_bytes(b"dead writer payload")
+    os.utime(stale, (1.0, 1.0))  # ancient mtime
+    fresh = tmp_path / ".chunk_000512_001024.npz.tmp.12346"
+    fresh.write_bytes(b"live writer payload")
+    regular = tmp_path / "chunk_000000_000512.npz"
+    regular.write_bytes(b"completed result")
+    os.utime(regular, (1.0, 1.0))  # old but NOT a temp: must survive
+
+    removed = sweep_stale_temps(str(tmp_path))
+    assert removed == 1
+    assert not stale.exists()
+    assert fresh.exists() and regular.exists()

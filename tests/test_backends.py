@@ -474,3 +474,39 @@ def test_partial_dynamic_flags_keep_static_semantics():
             np.asarray(st_d.n_iters), np.asarray(st_s.n_iters),
             err_msg=label,
         )
+
+
+def test_resilient_fallback_warns_once_with_reason(monkeypatch):
+    """resilient=True on an ineligible batch must say WHICH eligibility
+    check failed — once — instead of silently dropping process
+    isolation (the gate at backends/tpu.py's resilient route)."""
+    import warnings
+
+    from tsspark_tpu.backends import tpu as tpu_mod
+    from tsspark_tpu.resilience.report import ResilienceWarning
+
+    monkeypatch.setattr(tpu_mod, "_RESILIENT_FALLBACK_WARNED", False)
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=2,
+    )
+    backend = tpu_mod.TpuBackend(
+        cfg, SolverConfig(max_iters=4), resilient=True, rescue=False
+    )
+    ds = np.arange(60, dtype=np.float64)
+    y = np.sin(ds / 7.0)[None, :].repeat(3, axis=0).astype(np.float32)
+    init = np.zeros((3, cfg.num_params), np.float32)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        backend.fit(ds, y, init=init)  # init => ineligible
+    msgs = [w for w in rec if issubclass(w.category, ResilienceWarning)]
+    assert len(msgs) == 1
+    text = str(msgs[0].message)
+    assert "INELIGIBLE" in text
+    assert "init=" in text
+    # Second ineligible fit: the announcement stays one-time.
+    with warnings.catch_warnings(record=True) as rec2:
+        warnings.simplefilter("always")
+        backend.fit(ds, y, init=init)
+    assert not [w for w in rec2
+                if issubclass(w.category, ResilienceWarning)]

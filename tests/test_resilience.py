@@ -613,3 +613,27 @@ def test_driver_run_with_poll_policy(tmp_path, monkeypatch):
     monkeypatch.delenv(faults.ENV_VAR)
     assert stats.micro_batches == 2
     assert stats.rows_ingested == 160
+
+
+def test_streaming_poll_honors_total_budget(tmp_path, monkeypatch):
+    """total_budget_s must bound the poll retry loop in WALL time: with
+    unlimited attempts against a permanently-failing source, the policy's
+    budget (not an attempt count) is what re-raises."""
+    import time as _time
+
+    from tsspark_tpu.streaming.source import InMemorySource, ResilientSource
+
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "stream_poll", attempts=10_000, mode="raise"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    src = ResilientSource(
+        InMemorySource([]),
+        RetryPolicy(max_attempts=None, base_delay_s=0.05,
+                    total_budget_s=0.2),
+    )
+    t0 = _time.time()
+    with pytest.raises(faults.FaultInjected):
+        src.poll()
+    assert _time.time() - t0 < 5.0  # budget fired, not 10k attempts
+    monkeypatch.delenv(faults.ENV_VAR)

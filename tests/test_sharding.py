@@ -482,3 +482,32 @@ def test_resolve_time_axis_prefers_time_on_3_axis_mesh():
     )
     specs = sharding.data_shardings(mesh3, fake, cfg)
     assert tuple(specs.y) == ("series", "time")
+
+
+def test_mesh_chunked_fit_matches_single_device_chunked():
+    """Mesh-scale chunked behavior (VERDICT Next #8): a >= 4-chunk batch
+    through TpuBackend(mesh=..., chunk_size=...) must equal the
+    single-device chunked path — chunking and sharding compose, with no
+    per-chunk routing drift (every chunk rides the sharded program, and
+    the chunk boundaries land on the same rows)."""
+    from tsspark_tpu.backends.tpu import TpuBackend
+
+    batch = datasets.m4_hourly_like(n_series=64, max_len=240, seed=11)
+    ds, y = batch.ds, batch.y
+    m = mesh_mod.make_mesh(n_series_shards=8, n_time_shards=1)
+    ref = TpuBackend(CFG, SOLVER, chunk_size=16).fit(ds, y)
+    shard = TpuBackend(CFG, SOLVER, chunk_size=16, mesh=m).fit(ds, y)
+    # 64 series / chunk 16 = 4 chunks on both paths.
+    assert np.asarray(shard.theta).shape == np.asarray(ref.theta).shape
+    assert np.asarray(shard.loss).shape == (64,)
+    scale = np.maximum(np.abs(np.asarray(ref.loss)), 1.0)
+    np.testing.assert_allclose(
+        np.asarray(shard.loss) / scale, np.asarray(ref.loss) / scale,
+        rtol=0, atol=2e-3,
+    )
+    assert np.isfinite(np.asarray(shard.theta)).all()
+    # The scaling meta must be bit-identical: chunk-local prep sees the
+    # same rows in the same order on both paths.
+    np.testing.assert_array_equal(
+        np.asarray(shard.meta.y_scale), np.asarray(ref.meta.y_scale)
+    )

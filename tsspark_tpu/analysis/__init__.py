@@ -1,0 +1,80 @@
+"""JAX/TPU-aware static analysis gating every PR (``docs/ANALYSIS.md``).
+
+Three checkers, all device-free:
+
+* ``tracelint``  — AST trace-safety lint over the package (tracer
+  branching, host syncs in jitted scopes, f64 drift, silent-recompile
+  hazards), with a committed suppression baseline.
+* ``contracts``  — ``jax.eval_shape`` shape/dtype contracts for every
+  registered jitted kernel across the committed shape matrix.
+* ``fileproto``  — static model of the orchestrator/streaming/
+  checkpoint artifact lifecycle: atomic-write enforcement plus a
+  small-model check that range claims can never overlap.
+
+Run locally with ``python -m tsspark_tpu.analysis``; the same pass runs
+as a default-on tier-1 test (``tests/test_analysis.py``), so a PR that
+introduces a hazard fails CI before it ever touches a TPU.
+
+Importing this package stays light (stdlib + tomli); JAX loads only
+when the contract checker actually runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+from tsspark_tpu.analysis.config import (
+    AnalysisSettings,
+    KernelMatrix,
+    load_settings,
+    repo_root,
+)
+from tsspark_tpu.analysis.findings import Finding, apply_suppressions
+
+__all__ = [
+    "AnalysisReport", "AnalysisSettings", "Finding", "KernelMatrix",
+    "load_settings", "repo_root", "run_all",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisReport:
+    findings: Tuple[Finding, ...]     # kept (unsuppressed) findings
+    suppressed: Tuple[Finding, ...]   # baselined findings, for -v
+    counts: Tuple[Tuple[str, int], ...]  # per-checker raw finding count
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def run_all(
+    root: Optional[str] = None,
+    settings: Optional[AnalysisSettings] = None,
+    checkers: Tuple[str, ...] = ("trace", "contracts", "fileproto"),
+) -> AnalysisReport:
+    """The full pass over the repo at ``root`` (default: the installed
+    package's parent)."""
+    from tsspark_tpu.analysis import contracts, fileproto, tracelint
+
+    root = root or repo_root()
+    settings = settings or load_settings(root)
+    package_dir = os.path.join(root, "tsspark_tpu")
+    raw = []
+    counts = []
+    if "trace" in checkers:
+        found = tracelint.lint_package(root, package_dir)
+        counts.append(("trace", len(found)))
+        raw += found
+    if "contracts" in checkers:
+        found = contracts.check_kernels(settings.kernel_matrix)
+        counts.append(("contracts", len(found)))
+        raw += found
+    if "fileproto" in checkers:
+        found = fileproto.check_fileproto(root)
+        counts.append(("fileproto", len(found)))
+        raw += found
+    kept, suppressed = apply_suppressions(tuple(raw), settings)
+    return AnalysisReport(kept, suppressed, tuple(counts))

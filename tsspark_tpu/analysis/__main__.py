@@ -1,0 +1,73 @@
+"""``python -m tsspark_tpu.analysis`` — run the static-analysis gate.
+
+Exit code 0 when every checker is clean (after the committed
+suppression baseline), 1 otherwise.  ``--checker`` narrows to one pass;
+``-v`` also prints what the baseline suppressed.
+
+The contract checker needs a JAX backend with enough devices for the
+mesh matrix: like the test suite's conftest, this entry point pins
+JAX to CPU with 8 virtual devices BEFORE jax initializes — the gate
+must never touch (or wait on) a real TPU tunnel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # Must precede any jax import anywhere in the process.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tsspark_tpu.analysis",
+        description="JAX/TPU-aware static analysis (docs/ANALYSIS.md)",
+    )
+    ap.add_argument(
+        "--checker", choices=("trace", "contracts", "fileproto"),
+        action="append",
+        help="run only this checker (repeatable; default: all)",
+    )
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the package's parent)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print baseline-suppressed findings")
+    args = ap.parse_args(argv)
+
+    from tsspark_tpu import analysis
+
+    # The machine image may pre-register a TPU plugin at interpreter
+    # start; pin the config level too (same defense as tests/conftest).
+    if any("contracts" in c for c in (args.checker or ["contracts"])):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    report = analysis.run_all(
+        root=args.root,
+        checkers=tuple(args.checker) if args.checker
+        else ("trace", "contracts", "fileproto"),
+    )
+    for f in report.findings:
+        print(f)
+    if args.verbose:
+        for f in report.suppressed:
+            print(f"[suppressed] {f}")
+    per = ", ".join(f"{name}: {n}" for name, n in report.counts)
+    kept = len(report.findings)
+    print(
+        f"tsspark_tpu.analysis: {kept} finding(s) "
+        f"({len(report.suppressed)} baselined; raw per checker: {per})"
+    )
+    return 1 if kept else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""Settings for the static-analysis pass: ``[tool.tsspark.analysis]``.
+
+The analysis subsystem is configured from the repo's ``pyproject.toml``
+so the suppression baseline and the kernel-contract shape matrix are
+COMMITTED artifacts reviewed like code — a PR that needs a new
+suppression shows it in the diff.
+
+Suppression entry format (one string per finding)::
+
+    "<rule> @ <relpath>::<qualname>"
+
+e.g. ``"host-sync @ tsspark_tpu/models/prophet/model.py::select_better_state"``.
+A suppression matches every finding of that rule inside that function
+(line numbers churn; rule+symbol identity does not).  Inline
+suppressions use a ``# lint-ok[<rule>]: <reason>`` comment on the
+flagged line; the reason is mandatory — a bare marker does not count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelMatrix:
+    """The shape grid the contract checker drives ``jax.eval_shape`` over.
+
+    Every combination of (batch, length) x (n_changepoints, regressors)
+    is checked on the single-device kernels; ``mesh_shapes`` adds
+    (series_shards, time_shards) layouts for the sharded programs —
+    batches/lengths must stay divisible by the widest mesh axes.
+    """
+
+    batch_sizes: Tuple[int, ...] = (8, 32)
+    lengths: Tuple[int, ...] = (64, 256)
+    n_changepoints: Tuple[int, ...] = (0, 4)
+    num_regressors: Tuple[int, ...] = (0, 2)
+    mesh_shapes: Tuple[Tuple[int, int], ...] = ((8, 1), (4, 2))
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisSettings:
+    suppressions: Tuple[str, ...] = ()
+    kernel_matrix: KernelMatrix = KernelMatrix()
+
+    def suppression_keys(self) -> Tuple[Tuple[str, str, str], ...]:
+        """Parsed (rule, relpath, qualname) triples; malformed entries
+        raise (a typo'd suppression silently matching nothing would
+        quietly re-open the finding it was meant to justify)."""
+        out = []
+        for s in self.suppressions:
+            try:
+                rule, rest = s.split("@", 1)
+                relpath, qualname = rest.strip().split("::", 1)
+            except ValueError:
+                raise ValueError(
+                    f"malformed analysis suppression {s!r}; expected "
+                    "'<rule> @ <relpath>::<qualname>'"
+                )
+            out.append((rule.strip(), relpath.strip(), qualname.strip()))
+        return tuple(out)
+
+
+def repo_root() -> str:
+    """The directory holding ``pyproject.toml`` — the package's parent."""
+    import tsspark_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(
+        tsspark_tpu.__file__
+    )))
+
+
+def _load_toml(path: str) -> Dict:
+    try:
+        import tomllib as toml_mod  # Python >= 3.11
+    except ModuleNotFoundError:
+        import tomli as toml_mod
+    with open(path, "rb") as fh:
+        return toml_mod.load(fh)
+
+
+def load_settings(root: Optional[str] = None) -> AnalysisSettings:
+    """AnalysisSettings from ``<root>/pyproject.toml`` (defaults when the
+    file or the ``[tool.tsspark.analysis]`` block is absent, so the
+    analysis also runs on an installed wheel without its repo)."""
+    root = root or repo_root()
+    path = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(path):
+        return AnalysisSettings()
+    block = (
+        _load_toml(path).get("tool", {}).get("tsspark", {})
+        .get("analysis", {})
+    )
+    km = block.get("kernel_matrix", {})
+    matrix = KernelMatrix(
+        batch_sizes=tuple(km.get("batch_sizes",
+                                 KernelMatrix.batch_sizes)),
+        lengths=tuple(km.get("lengths", KernelMatrix.lengths)),
+        n_changepoints=tuple(km.get("n_changepoints",
+                                    KernelMatrix.n_changepoints)),
+        num_regressors=tuple(km.get("num_regressors",
+                                    KernelMatrix.num_regressors)),
+        mesh_shapes=tuple(
+            tuple(m) for m in km.get("mesh_shapes",
+                                     KernelMatrix.mesh_shapes)
+        ),
+    )
+    settings = AnalysisSettings(
+        suppressions=tuple(block.get("suppressions", ())),
+        kernel_matrix=matrix,
+    )
+    settings.suppression_keys()  # validate eagerly
+    return settings

@@ -1,0 +1,487 @@
+"""Abstract shape/dtype contract checker for the registered jitted kernels.
+
+``jax.eval_shape`` traces a kernel with ``ShapeDtypeStruct`` inputs —
+zero FLOPs, zero device time, no XLA compile — and returns the abstract
+outputs.  Driving every registered kernel across the committed
+``[tool.tsspark.analysis] kernel_matrix`` of (batch, length,
+changepoints, regressors, mesh) shapes proves, on CPU and in seconds:
+
+* the output SHAPES match the documented contracts (theta ``(B, P)``,
+  packed stats ``(5, B)``, ...) for every shape the fleet dispatches;
+* no kernel LEAKS float64 (or int64) into any output leaf — the classic
+  f32-on-TPU drift bug where one host-side f64 scalar silently promotes
+  a whole result tree;
+* the sharded programs trace under every supported mesh layout (shape
+  errors in sharding constraints surface at trace time, not on an
+  8-chip reservation).
+
+The registry is data: tests inject broken kernels to prove the checker
+catches contract violations, and new kernels register by adding a
+``KernelContract``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from tsspark_tpu.analysis.config import KernelMatrix
+from tsspark_tpu.analysis.findings import Finding
+
+# Dtypes that must never appear in a kernel output leaf: x64 is off by
+# package contract, so their presence means a weak-type promotion or an
+# explicit f64 request survived into traced code.
+_BANNED_DTYPES = ("float64", "complex128", "int64", "uint64")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapeCase:
+    """One point of the kernel matrix."""
+
+    b: int                                # series batch
+    t: int                                # time-grid length
+    n_cp: int                             # changepoints
+    r: int                                # external regressors
+    mesh_shape: Optional[Tuple[int, int]] = None  # (series, time) shards
+
+    @property
+    def label(self) -> str:
+        mesh = (f" mesh={self.mesh_shape[0]}x{self.mesh_shape[1]}"
+                if self.mesh_shape else "")
+        return f"B={self.b} T={self.t} cp={self.n_cp} r={self.r}{mesh}"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelContract:
+    """One kernel's abstract check.
+
+    ``run(case)`` returns the ``jax.eval_shape`` result pytree;
+    ``check(case, out)`` returns human-readable violations (the banned-
+    dtype sweep over every leaf runs regardless, so ``check`` only
+    asserts kernel-specific shapes).  ``wants_mesh`` routes the case
+    grid: mesh kernels run once per mesh shape, others once with
+    ``mesh_shape=None``.
+    """
+
+    name: str
+    run: Callable[[ShapeCase], Any]
+    check: Callable[[ShapeCase, Any], List[str]] = lambda case, out: []
+    wants_mesh: bool = False
+
+
+def _configs(case: ShapeCase):
+    from tsspark_tpu.config import (
+        ProphetConfig, RegressorConfig, SeasonalityConfig, SolverConfig,
+    )
+
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=case.n_cp,
+        regressors=tuple(
+            RegressorConfig(f"x{i}") for i in range(case.r)
+        ),
+    )
+    # Shallow solver: trace structure is depth-independent (the solve is
+    # a while_loop), so the cheap setting checks the same contracts.
+    return cfg, SolverConfig(max_iters=8)
+
+
+def _sds(shape, dtype="float32"):
+    import jax
+
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _fit_data(case: ShapeCase, cfg):
+    from tsspark_tpu.models.prophet.design import FitData
+
+    f = cfg.num_features
+    return FitData(
+        t=_sds((case.b, case.t)),
+        y=_sds((case.b, case.t)),
+        mask=_sds((case.b, case.t)),
+        s=_sds((case.b, case.n_cp)),
+        cap=_sds((case.b, case.t)),
+        X_season=_sds((case.t, cfg.num_seasonal_features)),
+        X_reg=_sds((case.b, case.t, case.r)),
+        prior_scales=_sds((f,)),
+        mult_mask=_sds((f,)),
+    )
+
+
+def _packed_data(case: ShapeCase, cfg):
+    from tsspark_tpu.models.prophet.design import PackedFitData
+
+    f = cfg.num_features
+    return PackedFitData(
+        y=_sds((case.b, case.t)),
+        ds_rel=_sds((case.t,)),
+        t_off=_sds((case.b,)),
+        t_inv_span=_sds((case.b,)),
+        s=_sds((case.b, case.n_cp)),
+        cap=_sds((case.b, 1)),
+        X_season=_sds((case.t, cfg.num_seasonal_features)),
+        X_reg=_sds((case.b, case.t, case.r)),
+        X_reg_bits=_sds((case.b, (case.t + 7) // 8, 0), "uint8"),
+        prior_scales=_sds((f,)),
+        mult_mask=_sds((f,)),
+    )
+
+
+def _leaf_items(out) -> List[Tuple[str, Any]]:
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten_with_path(out)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in leaves]
+
+
+def _expect(out_field, shape, dtype, what: str) -> List[str]:
+    errs = []
+    if tuple(out_field.shape) != tuple(shape):
+        errs.append(f"{what}: shape {tuple(out_field.shape)} != "
+                    f"expected {tuple(shape)}")
+    if dtype is not None and str(out_field.dtype) != dtype:
+        errs.append(f"{what}: dtype {out_field.dtype} != expected {dtype}")
+    return errs
+
+
+def _check_result(case: ShapeCase, cfg, res) -> List[str]:
+    """LbfgsResult contract: the per-series solver outputs."""
+    p = cfg.num_params
+    return (
+        _expect(res.theta, (case.b, p), "float32", "theta")
+        + _expect(res.f, (case.b,), "float32", "f")
+        + _expect(res.grad_norm, (case.b,), "float32", "grad_norm")
+        + _expect(res.converged, (case.b,), "bool", "converged")
+        + _expect(res.n_iters, (case.b,), "int32", "n_iters")
+        + _expect(res.status, (case.b,), "int32", "status")
+    )
+
+
+# ---- the registered kernels ------------------------------------------------
+
+
+def _k_fit_core(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.models.prophet.model import fit_core
+
+    cfg, solver = _configs(case)
+    return jax.eval_shape(
+        lambda d: fit_core(d, None, cfg, solver), _fit_data(case, cfg)
+    )
+
+
+def _c_fit_core(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    return _check_result(case, cfg, out)
+
+
+def _k_fit_core_packed(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.models.prophet.model import fit_core_packed
+
+    cfg, solver = _configs(case)
+    theta0 = _sds((case.b, cfg.num_params))
+    return jax.eval_shape(
+        lambda p, th: fit_core_packed(p, th, cfg, solver,
+                                      reg_u8_cols=()),
+        _packed_data(case, cfg), theta0,
+    )
+
+
+def _c_fit_core_packed(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    theta, stats = out
+    return (
+        _expect(theta, (case.b, cfg.num_params), "float32", "theta")
+        + _expect(stats, (5, case.b), "float32", "stats")
+    )
+
+
+def _k_fit_segment(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.models.prophet.model import (
+        fit_init_core, fit_segment_core,
+    )
+
+    cfg, solver = _configs(case)
+    data = _fit_data(case, cfg)
+    state = jax.eval_shape(lambda d: fit_init_core(d, None, cfg, solver),
+                           data)
+    # The segment must round-trip the FULL LbfgsState unchanged — that
+    # is what makes chained segments bit-equal to one fit_core run.
+    state2 = jax.eval_shape(
+        lambda d, s: fit_segment_core(d, s, cfg, solver, 4), data, state
+    )
+    return {"init": state, "segment": state2}
+
+
+def _c_fit_segment(case: ShapeCase, out) -> List[str]:
+    errs = []
+    init, seg = out["init"], out["segment"]
+    for field in type(init)._fields:
+        a, b = getattr(init, field), getattr(seg, field)
+        if tuple(a.shape) != tuple(b.shape) or str(a.dtype) != str(b.dtype):
+            errs.append(
+                f"LbfgsState.{field}: segment changed the state contract "
+                f"({a.shape}/{a.dtype} -> {b.shape}/{b.dtype}); chained "
+                "segments would diverge from fit_core"
+            )
+    return errs
+
+
+def _k_design_unpack(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.models.prophet.design import unpack_fit_data
+
+    cfg, _ = _configs(case)
+    return jax.eval_shape(
+        lambda p: unpack_fit_data(p, ()), _packed_data(case, cfg)
+    )
+
+
+def _c_design_unpack(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    return (
+        _expect(out.t, (case.b, case.t), "float32", "t")
+        + _expect(out.y, (case.b, case.t), "float32", "y")
+        + _expect(out.mask, (case.b, case.t), "float32", "mask")
+        + _expect(out.X_reg, (case.b, case.t, case.r), "float32", "X_reg")
+    )
+
+
+def _k_loss(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.models.prophet.loss import value_and_grad_batch
+
+    cfg, _ = _configs(case)
+    theta = _sds((case.b, cfg.num_params))
+    return jax.eval_shape(
+        lambda th, d: value_and_grad_batch(th, d, cfg),
+        theta, _fit_data(case, cfg),
+    )
+
+
+def _c_loss(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    f, g = out
+    return (
+        _expect(f, (case.b,), "float32", "loss value")
+        + _expect(g, (case.b, cfg.num_params), "float32", "loss grad")
+    )
+
+
+def _k_trend(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.models.prophet.trend import piecewise_linear
+
+    return jax.eval_shape(
+        piecewise_linear,
+        _sds((case.b, case.t)), _sds((case.b,)), _sds((case.b,)),
+        _sds((case.b, case.n_cp)), _sds((case.b, case.n_cp)),
+    )
+
+
+def _c_trend(case: ShapeCase, out) -> List[str]:
+    return _expect(out, (case.b, case.t), "float32", "trend")
+
+
+def _k_seasonality(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.models.prophet.seasonality import fourier_features
+
+    return jax.eval_shape(
+        lambda t: fourier_features(t, 7.0, 3), _sds((case.b, case.t))
+    )
+
+
+def _c_seasonality(case: ShapeCase, out) -> List[str]:
+    return _expect(out, (case.b, case.t, 6), "float32",
+                   "fourier features")
+
+
+def _k_mcmc(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.config import McmcConfig
+    from tsspark_tpu.models.prophet.model import mcmc_core
+
+    cfg, _ = _configs(case)
+    mcfg = McmcConfig(num_samples=4, num_warmup=2, num_leapfrog=2)
+    theta = _sds((case.b, cfg.num_params))
+    key = _sds((2,), "uint32")
+    return jax.eval_shape(
+        lambda d, th, k: mcmc_core(d, th, k, cfg, mcfg),
+        _fit_data(case, cfg), theta, key,
+    ), mcfg
+
+
+def _c_mcmc(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    res, mcfg = out
+    return _expect(
+        res.samples, (mcfg.num_samples, case.b, cfg.num_params),
+        "float32", "mcmc samples",
+    )
+
+
+def _mesh_for(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.parallel import mesh as mesh_mod
+
+    n_s, n_t = case.mesh_shape
+    if len(jax.devices()) < n_s * n_t:
+        return None
+    return mesh_mod.make_mesh(
+        n_series_shards=n_s, n_time_shards=n_t,
+        devices=jax.devices()[: n_s * n_t],
+    )
+
+
+def _k_sharded(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.config import ShardingConfig
+    from tsspark_tpu.parallel.sharding import _fit_sharded_core
+
+    cfg, solver = _configs(case)
+    mesh = _mesh_for(case)
+    if mesh is None:
+        return None
+    shard_cfg = ShardingConfig(
+        time_axis="time" if case.mesh_shape[1] > 1 else None
+    )
+    theta0 = _sds((case.b, cfg.num_params))
+    return jax.eval_shape(
+        lambda d, th: _fit_sharded_core(d, th, cfg, solver, mesh,
+                                        shard_cfg),
+        _fit_data(case, cfg), theta0,
+    )
+
+
+def _k_sharded_packed(case: ShapeCase):
+    import jax
+
+    from tsspark_tpu.config import ShardingConfig
+    from tsspark_tpu.parallel.sharding import _fit_sharded_packed_core
+
+    cfg, solver = _configs(case)
+    mesh = _mesh_for(case)
+    if mesh is None:
+        return None
+    shard_cfg = ShardingConfig(
+        time_axis="time" if case.mesh_shape[1] > 1 else None
+    )
+    theta0 = _sds((case.b, cfg.num_params))
+    return jax.eval_shape(
+        lambda p, th: _fit_sharded_packed_core(
+            p, th, cfg, solver, mesh, shard_cfg, ()
+        ),
+        _packed_data(case, cfg), theta0,
+    )
+
+
+def _c_sharded(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    return _check_result(case, cfg, out)
+
+
+def default_kernels() -> Tuple[KernelContract, ...]:
+    return (
+        KernelContract("model.fit_core", _k_fit_core, _c_fit_core),
+        KernelContract("model.fit_core_packed", _k_fit_core_packed,
+                       _c_fit_core_packed),
+        KernelContract("model.fit_segment", _k_fit_segment,
+                       _c_fit_segment),
+        KernelContract("design.unpack_fit_data", _k_design_unpack,
+                       _c_design_unpack),
+        KernelContract("loss.value_and_grad_batch", _k_loss, _c_loss),
+        KernelContract("trend.piecewise_linear", _k_trend, _c_trend),
+        KernelContract("seasonality.fourier_features", _k_seasonality,
+                       _c_seasonality),
+        KernelContract("model.mcmc_core", _k_mcmc, _c_mcmc),
+        KernelContract("sharding.fit_sharded", _k_sharded, _c_sharded,
+                       wants_mesh=True),
+        KernelContract("sharding.fit_sharded_packed", _k_sharded_packed,
+                       _c_sharded, wants_mesh=True),
+    )
+
+
+def _cases(matrix: KernelMatrix, mesh: bool) -> List[ShapeCase]:
+    out = []
+    for b in matrix.batch_sizes:
+        for t in matrix.lengths:
+            for n_cp in matrix.n_changepoints:
+                for r in matrix.num_regressors:
+                    if not mesh:
+                        out.append(ShapeCase(b, t, n_cp, r))
+                        continue
+                    for ms in matrix.mesh_shapes:
+                        # The raw kernels require divisibility (the
+                        # public fit_sharded wrappers pad); the matrix
+                        # checks the layouts the wrappers produce.
+                        if b % ms[0] == 0 and t % ms[1] == 0:
+                            out.append(ShapeCase(b, t, n_cp, r, ms))
+    return out
+
+
+def check_kernels(
+    matrix: KernelMatrix,
+    kernels: Optional[Sequence[KernelContract]] = None,
+) -> List[Finding]:
+    """Run every kernel contract over the shape matrix; returns findings
+    (empty = all contracts hold).
+
+    Traces run under ``jax.experimental.enable_x64``: with x64 OFF, jax
+    silently truncates every f64 request to f32, so the f64-leak gate
+    would be vacuous — x64 ON is the mode where an undisciplined
+    dtype (a strong np.float64 scalar, a default-dtype ``random.*``
+    call) actually surfaces as a float64 leaf or a carry-mismatch trace
+    error instead of hiding until real hardware.  Kernels with explicit
+    f32 dtypes trace identically in both modes.
+    """
+    import jax
+
+    with jax.experimental.enable_x64():
+        return _check_kernels(matrix, kernels)
+
+
+def _check_kernels(
+    matrix: KernelMatrix,
+    kernels: Optional[Sequence[KernelContract]],
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for k in (default_kernels() if kernels is None else kernels):
+        for case in _cases(matrix, k.wants_mesh):
+            try:
+                out = k.run(case)
+            except Exception as e:  # a trace error IS a contract failure
+                findings.append(Finding(
+                    "contract-trace", f"<kernel:{k.name}>", 0, case.label,
+                    f"tracing failed: {type(e).__name__}: {e}",
+                ))
+                continue
+            if out is None:
+                continue  # case not runnable here (too few devices)
+            for what, leaf in _leaf_items(out):
+                dt = str(getattr(leaf, "dtype", ""))
+                if dt in _BANNED_DTYPES:
+                    findings.append(Finding(
+                        "f64-leak", f"<kernel:{k.name}>", 0, case.label,
+                        f"output leaf {what} has banned dtype {dt} "
+                        "(x64 drift leaked into a kernel result)",
+                    ))
+            for msg in k.check(case, out):
+                findings.append(Finding(
+                    "contract-shape", f"<kernel:{k.name}>", 0,
+                    case.label, msg,
+                ))
+    return findings

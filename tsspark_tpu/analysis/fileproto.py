@@ -1,0 +1,541 @@
+"""File-protocol race checker: the orchestrator/streaming/checkpoint
+artifact lifecycle, verified statically.
+
+The multi-process fit protocol (orchestrate.py) is a filesystem
+conversation: workers claim series ranges and publish ``chunk_*.npz``
+results, a prep child publishes ``prep_*.npz`` payload caches, the
+parent reads coverage and sentinels, the integrity sweep quarantines
+``*.corrupt`` files, checkpoints persist fitted state across processes.
+Its two safety properties are checked here with zero processes spawned:
+
+1. **Atomicity** — every writer of a protocol artifact goes through the
+   shared write-temp-then-rename helper (``utils.atomic``) or the
+   manual temp+``os.replace`` idiom, so a reader can never observe a
+   torn file.  An AST pass over the protocol modules finds every write
+   site (``open(..., "w")``, ``np.save*``/``json.dump``/``pickle.dump``
+   on a path), attributes it to an artifact from the committed registry
+   below, and flags:
+
+   * ``non-atomic-write`` — a protocol artifact written without the
+     atomic idiom;
+   * ``unregistered-artifact`` — a write whose target matches no
+     registry entry (new artifacts must be registered WITH their
+     lifecycle story, or they silently escape both checks);
+   * ``foreign-writer`` — a registered artifact written outside its
+     declared owner functions (single-writer-per-artifact is what makes
+     the lifecycle reasoning tractable).
+
+2. **Range-claim disjointness** — a small-model check over the claim
+   function itself (``orchestrate.plan_chunks``): for an enumerated
+   space of completed-coverage states (bisected singles, resumed
+   partial grids, chunk-size changes, 6-vs-7-digit filename regimes)
+   the claims a worker would write are verified pairwise disjoint,
+   inside the worker's window, and non-overlapping with existing
+   coverage — the invariant that keeps two workers (or one worker and
+   its own resumed past) from assembling duplicated series rows.
+   ``completed_ranges``'s numeric ordering is model-checked with real
+   files in a temp dir across the 999,999-series digit rollover.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import itertools
+import os
+import tempfile
+from typing import Callable, List, Optional, Sequence, Set, Tuple
+
+from tsspark_tpu.analysis.findings import Finding
+
+# ---------------------------------------------------------------------------
+# artifact registry: the committed lifecycle model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ArtifactSpec:
+    """One protocol artifact class.
+
+    ``markers``: string fragments that identify the artifact in a write
+    site's path expression (static analysis sees the constants, not the
+    runtime value).  ``writers``: qualnames (module-relative) allowed to
+    write it.  ``append_ok``: append-mode log whose readers tolerate a
+    torn last line (diagnostics, not protocol state).
+    """
+
+    name: str
+    markers: Tuple[str, ...]
+    writers: Tuple[str, ...]
+    lifecycle: str
+    append_ok: bool = False
+    # Test-only machinery deliberately violating atomicity (the fault
+    # injector corrupts files IN PLACE to prove readers survive it).
+    exempt: bool = False
+
+
+ARTIFACTS: Tuple[ArtifactSpec, ...] = (
+    ArtifactSpec(
+        "chunk-result", ("chunk_",),
+        ("save_chunk_atomic",),
+        "written once per claimed range by the fit worker (phase 1), "
+        "patched in place by phase 2 / quarantine placeholders via the "
+        "same helper; read by completed_ranges/load_fit_state; "
+        "quarantined to *.corrupt on CRC mismatch",
+    ),
+    ArtifactSpec(
+        "prep-cache", ("prep_",),
+        ("save_prep_atomic",),
+        "pure cache written by the CPU prep worker; consumed (and "
+        "deleted) by the fit worker; corrupt copies dropped at load",
+    ),
+    ArtifactSpec(
+        "run-config", ("runcfg.pkl",),
+        ("save_run_config",),
+        "written once by the parent before any child spawns; read-only "
+        "to children",
+    ),
+    ArtifactSpec(
+        "data-spill", (".npy",),
+        ("spill_data",),
+        "written once by the parent before any child spawns; mmap'd "
+        "read-only by children",
+    ),
+    ArtifactSpec(
+        "heartbeat", ("heartbeat",),
+        ("fit_worker.heartbeat",),
+        "liveness mtime touched by the fit worker per dispatch; read "
+        "(mtime only) by the parent watchdog",
+    ),
+    ArtifactSpec(
+        "phase2-sentinel", ("phase2_done",),
+        ("fit_worker", "_cpu_fill"),
+        "created exactly once when straggler coverage completes (or the "
+        "run degrades to CPU); presence gates the parent's done check; "
+        "removed only by the integrity re-queue path",
+    ),
+    ArtifactSpec(
+        "run-fingerprint", ("run_fingerprint",),
+        ("fit_resilient",),
+        "written once per fresh scratch dir; resume refuses a mismatch",
+    ),
+    ArtifactSpec(
+        "quarantine", (".corrupt",),
+        ("quarantine",),
+        "os.replace of a failed chunk/prep file out of the resume "
+        "globs (atomic by construction; kept for forensics)",
+    ),
+    # Specific marker specs must precede "checkpoint": its generic
+    # ".json" marker would otherwise swallow "times.jsonl" (first
+    # marker match wins).
+    ArtifactSpec(
+        "timing-log", ("times.jsonl",),
+        ("fit_worker", "fit_worker.save_and_log"),
+        "append-only per-chunk diagnostics", append_ok=True,
+    ),
+    ArtifactSpec(
+        "probe-log", ("probes.jsonl",),
+        ("run_resilient._probe_log",),
+        "append-only probe diagnostics", append_ok=True,
+    ),
+    ArtifactSpec(
+        "checkpoint", (".npz", ".json"),
+        ("save_state", "save_forecaster"),
+        "fitted-state + sidecar pair written via utils.atomic; readers "
+        "(load_state/load_forecaster, possibly concurrent processes) "
+        "never see a torn file",
+    ),
+    ArtifactSpec(
+        "fault-injection", (),
+        ("corrupt_file", "FaultPlan.corrupt_file", "inject"),
+        "deterministic test-only corruption/sentinels (resilience."
+        "faults): in-place byte flips are the FEATURE being tested",
+        exempt=True,
+    ),
+)
+
+# Modules under the package root whose write sites are in protocol scope.
+PROTOCOL_MODULES: Tuple[str, ...] = (
+    "tsspark_tpu/orchestrate.py",
+    "tsspark_tpu/streaming/state.py",
+    "tsspark_tpu/streaming/driver.py",
+    "tsspark_tpu/streaming/source.py",
+    "tsspark_tpu/streaming/warmstart.py",
+    "tsspark_tpu/utils/checkpoint.py",
+    "tsspark_tpu/resilience/integrity.py",
+    "tsspark_tpu/resilience/faults.py",
+)
+
+_WRITE_FNS = {"save", "savez", "savez_compressed", "dump"}
+_ATOMIC_FNS = {"atomic_write", "atomic_write_text"}
+
+
+@dataclasses.dataclass
+class WriteSite:
+    relpath: str
+    line: int
+    qualname: str
+    mode: str                  # "w", "wb", "a", ... ("?" when dynamic)
+    constants: Tuple[str, ...]  # string constants in the path expression
+    in_atomic_fn: bool         # enclosing function contains os.replace
+    via_helper: bool           # the call IS atomic_write(...)
+
+
+def _string_constants(node: ast.AST) -> Tuple[str, ...]:
+    return tuple(
+        n.value for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    )
+
+
+def _fn_qualname_map(tree: ast.Module):
+    """{node-id: qualname} for every function def, nested included."""
+    out = {}
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out[id(child)] = f"{prefix}{child.name}"
+                visit(child, f"{prefix}{child.name}.")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _collect_write_sites(relpath: str, source: str) -> List[WriteSite]:
+    tree = ast.parse(source, filename=relpath)
+    qualnames = _fn_qualname_map(tree)
+    sites: List[WriteSite] = []
+
+    def fn_has_replace(fn: ast.AST) -> bool:
+        for sub in ast.walk(fn):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "replace"
+                    and isinstance(sub.func.value, ast.Name)
+                    and sub.func.value.id == "os"):
+                return True
+        return False
+
+    def visit_fn(fn: ast.AST, qual: str) -> None:
+        atomic_fn = fn_has_replace(fn)
+        nested = {
+            id(sub) for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+            for sub in ast.walk(n)
+        }
+        for sub in ast.walk(fn):
+            if id(sub) in nested or not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            # open(path, mode) in a writing mode
+            if isinstance(func, ast.Name) and func.id == "open":
+                mode = "r"
+                if len(sub.args) > 1 and isinstance(sub.args[1],
+                                                    ast.Constant):
+                    mode = str(sub.args[1].value)
+                elif len(sub.args) > 1:
+                    mode = "?"
+                for kw in sub.keywords:
+                    if kw.arg == "mode":
+                        mode = (str(kw.value.value)
+                                if isinstance(kw.value, ast.Constant)
+                                else "?")
+                if any(c in mode for c in "wax+?"):
+                    sites.append(WriteSite(
+                        relpath, sub.lineno, qual, mode,
+                        _string_constants(sub.args[0]) if sub.args
+                        else (),
+                        atomic_fn, False,
+                    ))
+            # np.save/np.savez/json.dump/pickle.dump with a PATH (not an
+            # open file handle) — a handle comes from a tracked open()
+            elif (isinstance(func, ast.Attribute)
+                    and func.attr in _WRITE_FNS and sub.args):
+                target = (sub.args[1] if func.attr == "dump"
+                          and len(sub.args) > 1 else sub.args[0])
+                consts = _string_constants(target)
+                # Heuristic: writes to a bare Name with no path-ish
+                # constants are almost always file handles from an
+                # enclosing open()/atomic_write (already checked).
+                pathish = consts or not isinstance(target, ast.Name)
+                if pathish:
+                    sites.append(WriteSite(
+                        relpath, sub.lineno, qual, "wb", consts,
+                        atomic_fn, False,
+                    ))
+            elif (isinstance(func, ast.Name)
+                    and func.id in _ATOMIC_FNS):
+                sites.append(WriteSite(
+                    relpath, sub.lineno, qual, "w",
+                    _string_constants(sub.args[0]) if sub.args else (),
+                    atomic_fn, True,
+                ))
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit_fn(child, qualnames[id(child)])
+                walk(child, f"{qualnames[id(child)]}.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, prefix)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return sites
+
+
+def _classify(site: WriteSite) -> Optional[ArtifactSpec]:
+    for spec in ARTIFACTS:
+        if any(
+            marker in const
+            for marker in spec.markers for const in site.constants
+        ):
+            return spec
+    # Variable path with no literal fragment: attribute by the writing
+    # function itself — the registry maps owners to artifacts, so a
+    # registered owner's writes classify even when the path is computed
+    # elsewhere (save_chunk_atomic's path comes from _chunk_path).
+    for spec in ARTIFACTS:
+        if _writer_allowed(spec, site.qualname):
+            return spec
+    return None
+
+
+def check_write_sites(
+    root: str, modules: Sequence[str] = PROTOCOL_MODULES,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in modules:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, "r") as fh:
+            source = fh.read()
+        for site in _collect_write_sites(rel, source):
+            spec = _classify(site)
+            writes = any(c in site.mode for c in "wx+?")
+            appends = "a" in site.mode
+            if site.via_helper:
+                if spec is None:
+                    findings.append(Finding(
+                        "unregistered-artifact", site.relpath, site.line,
+                        site.qualname,
+                        "atomic_write to a path matching no registered "
+                        f"artifact (constants {site.constants!r}); add "
+                        "an ArtifactSpec with its lifecycle",
+                    ))
+                elif not _writer_allowed(spec, site.qualname):
+                    findings.append(Finding(
+                        "foreign-writer", site.relpath, site.line,
+                        site.qualname,
+                        f"{spec.name} is owned by {spec.writers}; a new "
+                        "writer needs a registry entry (and a story for "
+                        "how it cannot race the owner)",
+                    ))
+                continue
+            if spec is not None and (
+                spec.exempt or (spec.append_ok and appends)
+            ):
+                continue
+            if not (writes or appends):
+                continue
+            if site.in_atomic_fn:
+                # Manual temp+os.replace idiom inside this function: the
+                # open/np.save is the temp side of an atomic rename.
+                continue
+            if spec is None:
+                if appends:
+                    findings.append(Finding(
+                        "unregistered-artifact", site.relpath, site.line,
+                        site.qualname,
+                        "append-mode write to an unregistered path "
+                        f"(constants {site.constants!r}); register it "
+                        "(append_ok) or route through utils.atomic",
+                    ))
+                else:
+                    findings.append(Finding(
+                        "non-atomic-write", site.relpath, site.line,
+                        site.qualname,
+                        "write outside utils.atomic to an unregistered "
+                        f"path (constants {site.constants!r}); a "
+                        "concurrent reader can observe a torn file",
+                    ))
+                continue
+            findings.append(Finding(
+                "non-atomic-write", site.relpath, site.line,
+                site.qualname,
+                f"{spec.name} written without the atomic "
+                "write-temp-then-rename helper (utils.atomic); "
+                f"lifecycle: {spec.lifecycle}",
+            ))
+    return findings
+
+
+def _writer_allowed(spec: ArtifactSpec, qualname: str) -> bool:
+    return any(
+        qualname == w or qualname.endswith("." + w)
+        or w.startswith(qualname + ".") or qualname.startswith(w + ".")
+        for w in spec.writers
+    )
+
+
+# ---------------------------------------------------------------------------
+# range-claim small-model check
+# ---------------------------------------------------------------------------
+
+
+def _overlap(a: Tuple[int, int], b: Tuple[int, int]) -> bool:
+    return a[0] < b[1] and b[0] < a[1]
+
+
+def _claim_violations(
+    plan_fn: Callable, done: List[Tuple[int, int]],
+    lo: int, hi: int, chunk: int,
+) -> List[str]:
+    claims = plan_fn(done, lo, hi, chunk)
+    errs = []
+    for i, c in enumerate(claims):
+        if not (lo <= c[0] < c[1] <= hi):
+            errs.append(f"claim {c} escapes the worker window "
+                        f"[{lo}, {hi}) (done={done}, chunk={chunk})")
+        for d in done:
+            if _overlap(c, d):
+                errs.append(
+                    f"claim {c} overlaps completed coverage {d} "
+                    f"(done={done}, chunk={chunk}): the refit would "
+                    "write an overlapping chunk file and "
+                    "load_fit_state would duplicate rows"
+                )
+        for c2 in claims[i + 1:]:
+            if _overlap(c, c2):
+                errs.append(f"claims {c} and {c2} overlap "
+                            f"(done={done}, chunk={chunk})")
+    return errs
+
+
+def check_claim_invariants(
+    plan_fn: Optional[Callable] = None,
+    missing_fn: Optional[Callable] = None,
+) -> List[Finding]:
+    """Exhaustive small-model check of the range-claim protocol.
+
+    States: every completed-coverage set reachable by the protocol over
+    a small series count (non-overlapping sub-ranges, including
+    bisection singles and stale wider-grid survivors), crossed with the
+    worker-window and chunk-size moves the parent actually makes
+    (full window, split windows, halved chunks).  Small counterexamples
+    find real protocol bugs long before a million-series run does.
+    """
+    from tsspark_tpu import orchestrate
+
+    plan_fn = plan_fn or orchestrate.plan_chunks
+    missing_fn = missing_fn or orchestrate.missing_ranges
+    findings: List[Finding] = []
+
+    def emit(msg: str) -> None:
+        findings.append(Finding(
+            "claim-overlap", "tsspark_tpu/orchestrate.py", 0,
+            "plan_chunks", msg,
+        ))
+
+    series = 6
+    bounds = range(series + 1)
+    all_ranges = [
+        (a, b) for a, b in itertools.product(bounds, bounds) if a < b
+    ]
+    # Every pairwise-disjoint coverage set of size <= 3 (the protocol
+    # never writes overlapping files — that is the invariant being
+    # preserved inductively, so states assume it).
+    states: List[List[Tuple[int, int]]] = [[]]
+    for k in (1, 2, 3):
+        for combo in itertools.combinations(all_ranges, k):
+            if all(not _overlap(a, b)
+                   for a, b in itertools.combinations(combo, 2)):
+                states.append(list(combo))
+    seen_err: Set[str] = set()
+    for done in states:
+        for chunk in (1, 2, 3, 4, 8):
+            for lo, hi in ((0, series), (0, 3), (3, series), (2, 5)):
+                for msg in _claim_violations(plan_fn, done, lo, hi,
+                                             chunk):
+                    if msg not in seen_err:
+                        seen_err.add(msg)
+                        emit(msg)
+        # The parent's full-window gap scan and the claim walk must
+        # agree: claims exactly tile the missing coverage when the
+        # window spans everything.
+        claims = plan_fn(done, 0, series, 2)
+        claimed = sorted(claims)
+        gaps = missing_fn(done, series)
+        covered = []
+        cur: Optional[Tuple[int, int]] = None
+        for c in claimed:
+            if cur is not None and c[0] == cur[1]:
+                cur = (cur[0], c[1])
+            else:
+                if cur is not None:
+                    covered.append(cur)
+                cur = c
+        if cur is not None:
+            covered.append(cur)
+        if covered != list(gaps):
+            emit(
+                f"claims {claims} do not tile the missing coverage "
+                f"{gaps} for done={done}: a worker would leave holes "
+                "or refit finished rows"
+            )
+    # Two workers handed disjoint windows must claim disjoint ranges.
+    for done in states[:64]:
+        mid = 3
+        a = plan_fn(done, 0, mid, 2)
+        b = plan_fn(done, mid, series, 2)
+        for ca in a:
+            for cb in b:
+                if _overlap(ca, cb):
+                    emit(
+                        f"split-window workers claim overlapping ranges "
+                        f"{ca} / {cb} for done={done}"
+                    )
+    return findings
+
+
+def check_completed_ranges_order() -> List[Finding]:
+    """The 999,999-series digit rollover: completed_ranges must sort
+    numerically, never lexicographically (chunk_1000448 < chunk_999936
+    as strings), checked with real files."""
+    from tsspark_tpu import orchestrate
+
+    findings: List[Finding] = []
+    ranges = [(999_936, 1_000_448), (0, 512), (1_000_448, 1_000_960),
+              (512, 999_936)]
+    with tempfile.TemporaryDirectory() as td:
+        for lo, hi in ranges:
+            with open(
+                os.path.join(td, f"chunk_{lo:06d}_{hi:06d}.npz"), "wb"
+            ):
+                pass
+        got = orchestrate.completed_ranges(td)
+    if got != sorted(ranges):
+        findings.append(Finding(
+            "claim-order", "tsspark_tpu/orchestrate.py", 0,
+            "completed_ranges",
+            f"chunk files sort as {got}, not numerically "
+            f"{sorted(ranges)}: past 999,999 series load_fit_state "
+            "would concatenate chunks out of order",
+        ))
+    return findings
+
+
+def check_fileproto(root: str) -> List[Finding]:
+    return (
+        check_write_sites(root)
+        + check_claim_invariants()
+        + check_completed_ranges_order()
+    )

@@ -1,0 +1,38 @@
+"""The one finding currency every checker emits and the CLI prints."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+from tsspark_tpu.analysis.config import AnalysisSettings
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str       # e.g. "trace-branch", "non-atomic-write", "f64-leak"
+    path: str       # repo-relative file path ("<kernel>" for contracts)
+    line: int       # 1-based; 0 when the finding has no source anchor
+    qualname: str   # enclosing function / kernel case name
+    message: str
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.qualname}: {self.message}")
+
+
+def apply_suppressions(
+    findings: Tuple[Finding, ...], settings: AnalysisSettings
+) -> Tuple[Tuple[Finding, ...], Tuple[Finding, ...]]:
+    """(kept, suppressed) after the committed baseline.  Inline
+    ``# lint-ok[rule]:`` suppressions are applied by the checkers
+    themselves (they need source lines); this handles the pyproject
+    baseline, which matches on (rule, relpath, qualname)."""
+    keys = set(settings.suppression_keys())
+    kept, suppressed = [], []
+    for f in findings:
+        if (f.rule, f.path, f.qualname) in keys:
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    return tuple(kept), tuple(suppressed)

@@ -1,0 +1,524 @@
+"""Trace-safety lint: an AST pass over the package's JAX code.
+
+JAX's tracing model makes four classes of bug invisible to CPU unit
+tests but expensive (or wrong) on real hardware:
+
+* ``trace-branch`` — Python ``if``/``while`` on a traced value.  Under
+  ``jax.jit`` this either raises a ConcretizationTypeError on device or
+  — worse — silently bakes one branch into the compiled program when the
+  test happens to be concrete at trace time.
+* ``host-sync`` — ``float()``/``int()``/``bool()``/``.item()``/
+  ``.tolist()``/``np.*`` on a traced value inside a jitted scope: each
+  is a device->host round trip (~40 ms on the tunneled runtime) that
+  serializes the dispatch pipeline, or a trace error.
+* ``f64-dtype`` — ``float64`` dtype requests inside traced code.  With
+  x64 off (this package's contract) they silently produce f32; with it
+  on they double every buffer and halve TPU throughput.  Flipping
+  ``jax_enable_x64`` anywhere is flagged for the same reason.
+* ``static-hash`` — silent-recompilation hazards: mutable default
+  arguments (unhashable as jit statics, and a shared-state bug besides),
+  ``static_argnames`` naming a parameter that does not exist or whose
+  default is mutable, and ``jax.jit(lambda ...)`` inside a function body
+  (a fresh function identity per call defeats the jit cache and
+  recompiles every time).
+
+Scope discovery is static: jit ROOTS are functions decorated with
+``jax.jit`` / ``functools.partial(jax.jit, ...)`` (or wrapped via a
+module-level ``name = jax.jit(fn)``); the traced set is the closure of
+the intra-package call graph over those roots.  Parameters whose names
+appear as ``static_argnames`` anywhere in the package (``config``,
+``solver_config``, ``mesh``, ...) are treated as static in every traced
+function — the package keeps its calling convention consistent, and the
+committed suppression baseline absorbs the residue.
+
+False positives are EXPECTED at the margins of any static analysis;
+the contract is that each one is either fixed or explicitly justified —
+inline ``# lint-ok[rule]: reason`` or a ``[tool.tsspark.analysis]``
+baseline entry — so the default-on repo pass stays at zero unexplained
+findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tsspark_tpu.analysis.findings import Finding
+
+_INLINE_OK = re.compile(r"#\s*lint-ok\[(?P<rule>[a-z0-9-]+)\]\s*:\s*\S")
+
+# Value accessors that are STATIC under tracing (reading them off a
+# tracer yields a concrete Python value at trace time, no sync).
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "_fields", "sharding"}
+# Builtins whose result on a tracer is static / trace-safe.
+_STATIC_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "range"}
+# Calls that force a concrete value out of a tracer (host sync / error).
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_SYNC_METHODS = {"item", "tolist", "numpy", "__array__"}
+# numpy namespace aliases whose CALLS on traced values leave the device.
+_NP_ALIASES = {"np", "numpy", "onp"}
+_F64_NAMES = {"float64", "double", "f8"}
+# Ubiquitous builtin-container/str method names: an attribute call like
+# ``stack.append(x)`` must not create a call-graph edge to every package
+# function that happens to share the name, or host-side classes with a
+# method called ``append``/``get``/... would be linted as traced code.
+_GENERIC_METHODS = {
+    "append", "extend", "insert", "pop", "remove", "sort", "clear",
+    "copy", "get", "keys", "values", "items", "setdefault", "add",
+    "discard", "update", "write", "read", "close", "join", "format",
+    "startswith", "endswith", "strip", "encode", "decode",
+}
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` reference?"""
+    if isinstance(node, ast.Attribute):
+        return (node.attr == "jit" and isinstance(node.value, ast.Name)
+                and node.value.id == "jax")
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_of(node: ast.AST) -> Optional[ast.Call]:
+    """The ``jax.jit(...)`` / ``partial(jax.jit, ...)`` call carried by a
+    decorator or wrapper expression, else None."""
+    if isinstance(node, ast.Call):
+        if _is_jax_jit(node.func):
+            return node
+        # functools.partial(jax.jit, static_argnames=...)
+        f = node.func
+        is_partial = (
+            (isinstance(f, ast.Attribute) and f.attr == "partial")
+            or (isinstance(f, ast.Name) and f.id == "partial")
+        )
+        if is_partial and node.args and _is_jax_jit(node.args[0]):
+            return node
+    if _is_jax_jit(node):
+        return ast.Call(func=node, args=[], keywords=[])
+    return None
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames" and isinstance(
+            kw.value, (ast.Tuple, ast.List, ast.Constant)
+        ):
+            elts = (
+                [kw.value] if isinstance(kw.value, ast.Constant)
+                else kw.value.elts
+            )
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    names.add(e.value)
+    return names
+
+
+def _static_argnums(call: ast.Call) -> Set[int]:
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums" and isinstance(
+            kw.value, (ast.Tuple, ast.List, ast.Constant)
+        ):
+            elts = (
+                [kw.value] if isinstance(kw.value, ast.Constant)
+                else kw.value.elts
+            )
+            for e in elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    nums.add(e.value)
+    return nums
+
+
+class _FnInfo:
+    """One function definition's lint-relevant facts."""
+
+    def __init__(self, qualname: str, node: ast.FunctionDef,
+                 jit_call: Optional[ast.Call]):
+        self.qualname = qualname
+        self.node = node
+        self.jit_call = jit_call
+        self.calls: Set[str] = set()   # local names this function calls
+        args = node.args
+        self.param_names = [a.arg for a in args.posonlyargs + args.args
+                            + args.kwonlyargs]
+        self.static_params: Set[str] = set()
+        if jit_call is not None:
+            self.static_params |= _static_argnames(jit_call)
+            for i in _static_argnums(jit_call):
+                if i < len(self.param_names):
+                    self.static_params.add(self.param_names[i])
+
+
+class _ModuleScan:
+    def __init__(self, relpath: str, tree: ast.Module, source: str):
+        self.relpath = relpath
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.functions: Dict[str, _FnInfo] = {}
+        self.imports: Dict[str, str] = {}  # local name -> module path
+
+    def line_ok(self, lineno: int, rule: str) -> bool:
+        if 1 <= lineno <= len(self.lines):
+            m = _INLINE_OK.search(self.lines[lineno - 1])
+            return bool(m and m.group("rule") == rule)
+        return False
+
+
+def _walk_functions(scan: _ModuleScan) -> None:
+    """Collect every function def (module-level and nested/methods) with
+    its jit decoration and outgoing call names."""
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                jit_call = None
+                for dec in child.decorator_list:
+                    jit_call = jit_call or _jit_call_of(dec)
+                info = _FnInfo(qual, child, jit_call)
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call):
+                        if isinstance(sub.func, ast.Name):
+                            info.calls.add(sub.func.id)
+                        elif isinstance(sub.func, ast.Attribute) \
+                                and sub.func.attr not in _GENERIC_METHODS:
+                            info.calls.add(sub.func.attr)
+                        # Function REFERENCES passed as arguments — the
+                        # lax.while_loop(cond, body, ...) callback idiom;
+                        # those callees run traced just like direct calls.
+                        for a in list(sub.args) + [
+                            kw.value for kw in sub.keywords
+                        ]:
+                            if isinstance(a, ast.Name):
+                                info.calls.add(a.id)
+                scan.functions[qual] = info
+                visit(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.")
+            elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                continue
+            else:
+                visit(child, prefix)
+
+    visit(scan.tree, "")
+    # Module-level jit wrappers: name = jax.jit(fn) marks fn as a root.
+    for stmt in scan.tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            call = _jit_call_of(stmt.value)
+            if call is None and _is_jax_jit(stmt.value.func):
+                call = stmt.value
+            if call is not None:
+                for a in stmt.value.args:
+                    if isinstance(a, ast.Name) and a.id in scan.functions:
+                        scan.functions[a.id].jit_call = call
+                        info = scan.functions[a.id]
+                        info.static_params |= _static_argnames(call)
+
+
+def _traced_closure(scans: List[_ModuleScan]) -> Set[Tuple[str, str]]:
+    """(relpath, qualname) of every function statically reachable from a
+    jit root by simple-name calls — the set whose bodies run traced."""
+    by_name: Dict[str, List[Tuple[str, str]]] = {}
+    for scan in scans:
+        for qual, info in scan.functions.items():
+            by_name.setdefault(qual.rsplit(".", 1)[-1], []).append(
+                (scan.relpath, qual)
+            )
+    info_of = {
+        (scan.relpath, qual): info
+        for scan in scans for qual, info in scan.functions.items()
+    }
+    traced: Set[Tuple[str, str]] = {
+        key for key, info in info_of.items() if info.jit_call is not None
+    }
+    frontier = list(traced)
+    while frontier:
+        key = frontier.pop()
+        new = set()
+        for callee in info_of[key].calls:
+            new.update(by_name.get(callee, ()))
+        # Nested defs of a traced function run traced (the while_loop
+        # body / line-search closure pattern) even when only ever passed
+        # by reference through names the call-graph cannot resolve.
+        relpath, qual = key
+        new.update(
+            k for k in info_of
+            if k[0] == relpath and k[1].startswith(qual + ".")
+        )
+        for target in new:
+            if target not in traced:
+                traced.add(target)
+                frontier.append(target)
+    return traced
+
+
+def _collect_package_static_names(scans: List[_ModuleScan]) -> Set[str]:
+    names: Set[str] = set()
+    for scan in scans:
+        for info in scan.functions.values():
+            if info.jit_call is not None:
+                names |= _static_argnames(info.jit_call)
+    return names
+
+
+def _value_refs(test: ast.AST, traced_names: Set[str]) -> List[str]:
+    """Traced-parameter names referenced BY VALUE in an expression —
+    excluding static accessors (``x.shape``, ``len(x)``, ``x is None``)
+    whose results are concrete at trace time."""
+    refs: List[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return  # x.shape[...] etc: static, don't descend into x
+            visit(node.value)
+            return
+        if isinstance(node, ast.Call):
+            fname = None
+            if isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname in _STATIC_CALLS:
+                return
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                visit(a)
+            if not isinstance(node.func, ast.Name):
+                visit(node.func)
+            return
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` tests structure, not value.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) \
+                    and all(
+                        isinstance(c, ast.Constant) and c.value is None
+                        for c in node.comparators
+                    ):
+                return
+            visit(node.left)
+            for c in node.comparators:
+                visit(c)
+            return
+        if isinstance(node, ast.Name) and node.id in traced_names:
+            refs.append(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return refs
+
+
+_MUTABLE_DEFAULT = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                    ast.DictComp, ast.SetComp)
+
+
+def _check_fn_body(scan: _ModuleScan, info: _FnInfo,
+                   package_static: Set[str],
+                   findings: List[Finding]) -> None:
+    """The traced-scope rules over one function body (nested defs are
+    linted through their own _FnInfo; their statements are excluded
+    here so a finding is attributed to the innermost function)."""
+    own_static = info.static_params | package_static
+    traced_names = {p for p in info.param_names
+                    if p not in own_static and p != "self"}
+
+    def emit(rule: str, node: ast.AST, msg: str) -> None:
+        line = getattr(node, "lineno", info.node.lineno)
+        if not scan.line_ok(line, rule):
+            findings.append(Finding(rule, scan.relpath, line,
+                                    info.qualname, msg))
+
+    nested: Set[ast.AST] = set()
+    for sub in ast.walk(info.node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and sub is not info.node:
+            nested.update(ast.walk(sub))
+
+    for sub in ast.walk(info.node):
+        if sub in nested:
+            continue
+        if isinstance(sub, (ast.If, ast.While)):
+            for name in _value_refs(sub.test, traced_names):
+                emit(
+                    "trace-branch", sub,
+                    f"Python branch on traced value {name!r} (under jit "
+                    "this is a ConcretizationTypeError on device, or "
+                    "silently bakes one branch into the program; use "
+                    "jnp.where / lax.cond)",
+                )
+        elif isinstance(sub, ast.Call):
+            fname = sub.func.id if isinstance(sub.func, ast.Name) else None
+            attr = sub.func.attr if isinstance(sub.func, ast.Attribute) \
+                else None
+            arg_refs = [
+                r for a in list(sub.args)
+                + [kw.value for kw in sub.keywords]
+                for r in _value_refs(a, traced_names)
+            ]
+            if fname in _SYNC_BUILTINS and arg_refs:
+                emit(
+                    "host-sync", sub,
+                    f"{fname}() on traced value {arg_refs[0]!r} forces a "
+                    "device->host sync (or a trace error) inside a "
+                    "jitted scope",
+                )
+            elif attr in _SYNC_METHODS and _value_refs(
+                sub.func.value, traced_names
+            ):
+                emit(
+                    "host-sync", sub,
+                    f".{attr}() on a traced value is a host sync inside "
+                    "a jitted scope",
+                )
+            elif (
+                attr is not None
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id in _NP_ALIASES
+                and arg_refs
+            ):
+                emit(
+                    "host-sync", sub,
+                    f"np.{attr}() applied to traced value "
+                    f"{arg_refs[0]!r}: numpy pulls the buffer to host "
+                    "(use jnp inside jitted code)",
+                )
+        if isinstance(sub, ast.Attribute) and sub.attr in _F64_NAMES \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id in (_NP_ALIASES | {"jnp", "jax"}):
+            emit(
+                "f64-dtype", sub,
+                f"{sub.value.id}.{sub.attr} inside a traced scope: with "
+                "x64 off this silently becomes f32; with it on it "
+                "doubles every buffer (keep kernels f32 end-to-end)",
+            )
+        if isinstance(sub, ast.Constant) and sub.value == "float64":
+            emit(
+                "f64-dtype", sub,
+                "string dtype 'float64' inside a traced scope (see "
+                "f64 policy: kernels are f32 end-to-end)",
+            )
+
+
+def _check_static_hash(scan: _ModuleScan, info: _FnInfo,
+                       findings: List[Finding]) -> None:
+    node = info.node
+
+    def emit(rule: str, n: ast.AST, msg: str) -> None:
+        line = getattr(n, "lineno", node.lineno)
+        if not scan.line_ok(line, rule):
+            findings.append(Finding(rule, scan.relpath, line,
+                                    info.qualname, msg))
+
+    args = node.args
+    pos = args.posonlyargs + args.args
+    defaults = [None] * (len(pos) - len(args.defaults)) + list(args.defaults)
+    mutable_defaults = {
+        p.arg for p, d in zip(pos, defaults)
+        if isinstance(d, _MUTABLE_DEFAULT)
+    }
+    for p, d in zip(pos, defaults):
+        if isinstance(d, _MUTABLE_DEFAULT):
+            emit(
+                "static-hash", d,
+                f"mutable default for parameter {p.arg!r} (shared across "
+                "calls; unhashable if the parameter is ever a jit "
+                "static — use None or a tuple)",
+            )
+    for kw_p, d in zip(args.kwonlyargs, args.kw_defaults):
+        if isinstance(d, _MUTABLE_DEFAULT):
+            mutable_defaults.add(kw_p.arg)
+            emit(
+                "static-hash", d,
+                f"mutable default for parameter {kw_p.arg!r} (shared "
+                "across calls; unhashable if the parameter is ever a "
+                "jit static — use None or a tuple)",
+            )
+    if info.jit_call is not None:
+        declared = set(info.param_names)
+        for name in _static_argnames(info.jit_call):
+            if name not in declared:
+                emit(
+                    "static-hash", info.jit_call,
+                    f"static_argnames names {name!r}, which is not a "
+                    f"parameter of {node.name} (jit raises at first "
+                    "call — or worse, a rename left a stale static)",
+                )
+            elif name in mutable_defaults:
+                emit(
+                    "static-hash", info.jit_call,
+                    f"static parameter {name!r} has a mutable default: "
+                    "unhashable -> TypeError at dispatch, and near-miss "
+                    "values recompile silently",
+                )
+    # jax.jit(lambda ...) inside a function body: new identity per call.
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _is_jax_jit(sub.func) and sub.args:
+            if isinstance(sub.args[0], ast.Lambda):
+                emit(
+                    "static-hash", sub,
+                    "jax.jit(lambda ...) inside a function body creates "
+                    "a fresh jit cache entry per call — every invocation "
+                    "recompiles; hoist the jitted function to module "
+                    "scope",
+                )
+
+
+def lint_paths(
+    paths: List[str], root: str,
+    package_static: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Lint the given Python files; paths are reported relative to
+    ``root``.  ``package_static`` extends the static-parameter-name set
+    (the package scan seeds it from every jit decoration found)."""
+    scans: List[_ModuleScan] = []
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, "r") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse-error", os.path.relpath(path, root),
+                e.lineno or 0, "<module>", str(e),
+            ))
+            continue
+        scan = _ModuleScan(os.path.relpath(path, root), tree, source)
+        _walk_functions(scan)
+        scans.append(scan)
+
+    static_names = set(package_static or ())
+    static_names |= _collect_package_static_names(scans)
+    traced = _traced_closure(scans)
+
+    for scan in scans:
+        for qual, info in scan.functions.items():
+            _check_static_hash(scan, info, findings)
+            if (scan.relpath, qual) in traced:
+                _check_fn_body(scan, info, static_names, findings)
+        # x64 flips are a package-wide hazard regardless of scope.
+        for sub in ast.walk(scan.tree):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "update" and sub.args
+                    and isinstance(sub.args[0], ast.Constant)
+                    and sub.args[0].value == "jax_enable_x64"):
+                if not scan.line_ok(sub.lineno, "f64-dtype"):
+                    findings.append(Finding(
+                        "f64-dtype", scan.relpath, sub.lineno, "<module>",
+                        "jax_enable_x64 flip: global dtype semantics "
+                        "change under every caller (the package contract "
+                        "is f32 kernels + f64 host meta)",
+                    ))
+    return findings
+
+
+def lint_package(root: str, package_dir: str) -> List[Finding]:
+    """Lint every ``.py`` under ``package_dir`` (the shipped package —
+    tests and benches host-side code are out of scope by design)."""
+    paths = []
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    return lint_paths(sorted(paths), root)

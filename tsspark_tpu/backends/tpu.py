@@ -41,6 +41,33 @@ from tsspark_tpu.models.prophet.model import (
 # returned state's resilience report).
 _RESILIENT_GATE_WARNED = False
 
+# One-time flag for the opposite edge of the same gate: resilient=True
+# was requested but the batch is INELIGIBLE for the worker path, so the
+# fit silently loses process isolation / crash resume.  Announced once,
+# naming the failed eligibility check(s), so a user who asked for
+# resilience learns which input property cost them it.
+_RESILIENT_FALLBACK_WARNED = False
+
+
+def _resilient_ineligibility(dyn_used, init, conditions, mesh, packable):
+    """Human-readable list of the eligibility checks a resilient=True fit
+    failed (empty = eligible for the worker path)."""
+    failed = []
+    if dyn_used:
+        failed.append("traced phase controls (max_iters/gn_precond/"
+                      "use_init dynamic args) were passed")
+    if init is not None:
+        failed.append("an explicit warm start (init=) was passed")
+    if conditions is not None:
+        failed.append("conditional-seasonality data (conditions=) was "
+                      "passed")
+    if mesh is not None:
+        failed.append("the backend is mesh-sharded (mesh=)")
+    if not packable:
+        failed.append("the batch is not packable (needs a shared 1-D ds "
+                      "grid and an exact 0/1 mask)")
+    return failed
+
 
 def _pad_batch(arr, b_pad):
     """Host-side (numpy) zero-padding along the batch axis.
@@ -282,6 +309,30 @@ class TpuBackend(ForecastBackend):
                 regressors=regressors, cap=cap, floor=floor, **opts,
             )
             return add_warning(state, note) if note else state
+        if self.resilient:
+            # The gate declined this batch: the fit proceeds in-process,
+            # WITHOUT process isolation or crash resume.  Silent fallback
+            # would let a crash take the whole parent down exactly where
+            # the user asked for resilience — announce once which
+            # eligibility check failed.
+            global _RESILIENT_FALLBACK_WARNED
+            if not _RESILIENT_FALLBACK_WARNED:
+                _RESILIENT_FALLBACK_WARNED = True
+                # Diagnosis only inside the one-shot branch: the
+                # packability re-check is an O(B*T) host mask scan that
+                # a permanently-ineligible backend must not pay per fit.
+                failed = _resilient_ineligibility(
+                    dyn_used, init, conditions, self.mesh,
+                    packable_batch(ds, mask),
+                )
+                warnings.warn(
+                    "TpuBackend(resilient=True): this fit is INELIGIBLE "
+                    "for the process-isolated worker path and falls back "
+                    "to the in-process fit (no crash isolation/resume). "
+                    "Failed eligibility check(s): "
+                    + "; ".join(failed),
+                    ResilienceWarning, stacklevel=2,
+                )
         # Indicator-column split decided ONCE here so the main fit and the
         # rescue pass share it (it is a static argument of the jitted fit
         # and an O(B*T*R) host scan — see _fit_main).  Segmented solves

@@ -149,8 +149,12 @@ def _hmc_transition(key, state: _ChainState, logdensity_and_grad, config: McmcCo
 
     eps = jnp.exp(state.log_step)
     if config.step_jitter > 0:
+        # Explicit dtype: uniform's default is the x64-dependent float,
+        # and an f64 jitter here would promote the whole leapfrog carry
+        # (caught by the analysis contract checker's x64 trace).
         jit = jax.random.uniform(
-            k_jit, (b,), minval=1.0 - config.step_jitter,
+            k_jit, (b,), dtype=eps.dtype,
+            minval=1.0 - config.step_jitter,
             maxval=1.0 + config.step_jitter,
         )
         eps = eps * jit
@@ -169,7 +173,7 @@ def _hmc_transition(key, state: _ChainState, logdensity_and_grad, config: McmcCo
     divergent = ~jnp.isfinite(h1) | ((h1 - h0) > config.divergence_threshold)
     accept_prob = jnp.where(divergent, 0.0, jnp.exp(log_alpha))
 
-    u = jax.random.uniform(k_acc, (b,))
+    u = jax.random.uniform(k_acc, (b,), dtype=accept_prob.dtype)
     accept = (u < accept_prob) & ~divergent
     acc = accept[:, None]
     new_state = state._replace(
@@ -204,7 +208,7 @@ def _welford_update(state: _ChainState, theta):
 def _welford_var(state: _ChainState, regularize: bool = True):
     n = jnp.maximum(state.w_count - 1.0, 1.0)
     var = state.w_m2 / n
-    if regularize:
+    if regularize:  # lint-ok[trace-branch]: concrete Python bool — every caller passes a literal, so the branch is resolved at trace time (two cached programs, not a tracer branch)
         # Stan's shrinkage toward unit metric for short windows.
         w = state.w_count / (state.w_count + 5.0)
         var = w * var + (1.0 - w) * 1e-3

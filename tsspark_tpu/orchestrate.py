@@ -71,6 +71,11 @@ from tsspark_tpu.resilience.report import (
     STATUS_QUARANTINED,
     attach_report,
 )
+from tsspark_tpu.utils.atomic import (
+    atomic_write,
+    atomic_write_text,
+    sweep_stale_temps,
+)
 
 MIN_CHUNK = 512
 
@@ -125,10 +130,12 @@ def save_run_config(out_dir: str, model_config, solver_config) -> None:
     dataclasses of primitives — pickle round-trips them exactly).  Written
     atomically so a child racing the parent never reads a torn file."""
     os.makedirs(out_dir, exist_ok=True)
-    tmp = os.path.join(out_dir, ".tmp_runcfg.pkl")
-    with open(tmp, "wb") as fh:
-        pickle.dump({"model": model_config, "solver": solver_config}, fh)
-    os.replace(tmp, os.path.join(out_dir, "runcfg.pkl"))
+    atomic_write(
+        os.path.join(out_dir, "runcfg.pkl"),
+        lambda fh: pickle.dump(
+            {"model": model_config, "solver": solver_config}, fh
+        ),
+    )
 
 
 def load_run_config(out_dir: str):
@@ -149,13 +156,16 @@ def spill_data(data_dir: str, ds, y, mask=None, regressors=None, cap=None,
     import numpy as np
 
     os.makedirs(data_dir, exist_ok=True)
-    np.save(os.path.join(data_dir, "ds.npy"), np.asarray(ds))
+    atomic_write(os.path.join(data_dir, "ds.npy"),
+                 lambda fh: np.save(fh, np.asarray(ds)))
     arrs = dict(y=y, mask=mask, reg=regressors, cap=cap, floor=floor)
     for name in _DATA_FIELDS:
         a = arrs[name]
         if a is not None:
-            np.save(os.path.join(data_dir, f"{name}.npy"),
-                    np.asarray(a, np.float32))
+            atomic_write(
+                os.path.join(data_dir, f"{name}.npy"),
+                lambda fh, a=a: np.save(fh, np.asarray(a, np.float32)),
+            )
 
 
 def _load_data(data_dir: str):
@@ -189,7 +199,6 @@ def save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None) -> None:
     caught at load time and quarantined instead of assembled."""
     import numpy as np
 
-    tmp = os.path.join(out_dir, f".tmp_{lo:06d}_{hi:06d}.npz")
     arrays = dict(
         theta=np.asarray(state.theta),
         loss=np.asarray(state.loss),
@@ -207,9 +216,9 @@ def save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None) -> None:
         changepoints=np.asarray(state.meta.changepoints),
     )
     arrays.update(extra_arrays or {})
-    np.savez(tmp, **integrity.stamp(arrays))
     path = _chunk_path(out_dir, lo, hi)
-    os.replace(tmp, path)
+    stamped = integrity.stamp(arrays)
+    atomic_write(path, lambda fh: np.savez(fh, **stamped))
     faults.corrupt_file("chunk_save", path, lo=lo, hi=hi)
 
 
@@ -269,10 +278,9 @@ def save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
         arrays[f"packed_{k}"] = np.asarray(v)
     for k, v in meta._asdict().items():
         arrays[f"meta_{k}"] = np.asarray(v)
-    tmp = os.path.join(out_dir, f".tmp_prep_{lo:06d}_{hi:06d}.npz")
-    np.savez(tmp, **integrity.stamp(arrays))
     path = _prep_path(out_dir, lo, hi)
-    os.replace(tmp, path)
+    stamped = integrity.stamp(arrays)
+    atomic_write(path, lambda fh: np.savez(fh, **stamped))
     faults.corrupt_file("prep_save", path, lo=lo, hi=hi)
 
 
@@ -334,6 +342,31 @@ def missing_ranges(done, total):
     if cur < total:
         missing.append((cur, total))
     return missing
+
+
+def plan_chunks(done, lo, hi, chunk):
+    """The fit worker's range claims: the still-MISSING coverage inside
+    [lo, hi), each gap walked on its own chunk grid.
+
+    COVERAGE, not exact file names: after a poison-series bisection (or a
+    chunk-size change) a region may be covered by differently-named
+    sub-range files, and a name-based check would refit it — worse, the
+    refit would write a chunk file OVERLAPPING the existing ones, and
+    load_fit_state's concatenation would then duplicate rows.
+
+    This is THE claim function of the chunk-file protocol: every range a
+    fit worker writes comes out of it, so its invariants (claims pairwise
+    disjoint, inside [lo, hi), never overlapping ``done`` coverage) are
+    what keeps two workers' files from assembling duplicated series rows.
+    ``tsspark_tpu.analysis.fileproto`` model-checks exactly these
+    invariants over enumerated small states.
+    """
+    todo = []
+    for m_lo, m_hi in missing_ranges(done, hi):
+        m_lo = max(m_lo, lo)
+        for c_lo in range(m_lo, min(m_hi, hi), chunk):
+            todo.append((c_lo, min(c_lo + chunk, m_hi, hi)))
+    return todo
 
 
 def _pad_chunk_rows(a, lo, hi, chunk, fill=0.0):
@@ -404,7 +437,10 @@ def fit_worker(args) -> int:
     faults.inject("fit_worker_start")
     # Resume never trusts a corrupt chunk: quarantine torn/mismatched
     # files NOW so their ranges land back in this worker's todo list and
-    # phase 2 can never np.load garbage.
+    # phase 2 can never np.load garbage.  Predecessors killed mid-write
+    # (the watchdog's SIGKILL) left pid-suffixed temp orphans — sweep
+    # them so a crash-looping run's scratch usage stays bounded.
+    sweep_stale_temps(args.out)
     integrity.sweep_chunks(args.out)
     model_config, solver_config = load_run_config(args.out)
     ds, d = _load_data(args.data)
@@ -418,8 +454,10 @@ def fit_worker(args) -> int:
     hb_path = os.path.join(args.out, "heartbeat")
 
     def heartbeat():
-        with open(hb_path, "w") as fh:
-            fh.write(str(time.time()))
+        # Atomic like every other artifact: the parent's watchdog reads
+        # the file's mtime AND workers racing a respawned sibling must
+        # never leave a torn timestamp behind.
+        atomic_write_text(hb_path, str(time.time()))
 
     backend = get_backend(
         "tpu", model_config, solver_config,
@@ -483,18 +521,11 @@ def fit_worker(args) -> int:
                                   collapse_cap=collapse_cap)
         return lo, hi, b_real, packed, meta
 
-    # Todo = the still-MISSING coverage inside [lo, hi), each gap walked
-    # on its own chunk grid.  COVERAGE, not exact file names: after a
-    # poison-series bisection (or a chunk-size change) a region may be
-    # covered by differently-named sub-range files, and a name-based
-    # check would refit it — worse, the refit would write a chunk file
-    # OVERLAPPING the existing ones, and load_fit_state's concatenation
-    # would then duplicate rows.
-    todo = []
-    for m_lo, m_hi in missing_ranges(completed_ranges(args.out), args.hi):
-        m_lo = max(m_lo, args.lo)
-        for lo in range(m_lo, min(m_hi, args.hi), args.chunk):
-            todo.append((lo, min(lo + args.chunk, m_hi, args.hi)))
+    # Range claims come from plan_chunks (coverage-based, never file
+    # names) — see its docstring for the overlap invariants it carries.
+    todo = plan_chunks(
+        completed_ranges(args.out), args.lo, args.hi, args.chunk
+    )
     prefetch_depth = 3
     # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
     # program, so it can change per chunk for free.  One adjustment after
@@ -663,8 +694,7 @@ def fit_worker(args) -> int:
         # retry loop (a worker that never writes it would be respawned
         # forever when phase1_iters >= max_iters).
         if not missing_ranges(completed_ranges(args.out), args.series):
-            with open(marker, "w") as fh:
-                fh.write("ok\n")
+            atomic_write_text(marker, "ok\n")
         return 0
     done = completed_ranges(args.out)
     if missing_ranges(done, args.series):
@@ -926,8 +956,7 @@ def fit_worker(args) -> int:
             "stragglers": len(straggler_idx),
             "phase2_mode": phase2_mode,
         }) + "\n")
-    with open(marker, "w") as fh:
-        fh.write("ok\n")
+    atomic_write_text(marker, "ok\n")
     return 0
 
 
@@ -1421,8 +1450,7 @@ def _cpu_fill(out_dir: str, data_dir: str, series: int,
         # The accelerator path is gone; nothing will come back to run a
         # straggler pass, so close the run out (phase-1-depth rows in
         # pre-existing chunks keep their honest converged=False flags).
-        with open(marker, "w") as fh:
-            fh.write("degraded-to-cpu\n")
+        atomic_write_text(marker, "degraded-to-cpu\n")
 
 
 def _bisect_quarantine(
@@ -1706,8 +1734,7 @@ def fit_resilient(
                    cap=cap, floor=floor)
     save_run_config(out_dir, config, solver_config)
     if fresh:
-        with open(fp_path, "w") as fh:
-            fh.write(fp)
+        atomic_write_text(fp_path, fp)
     deadline = (time.time() + budget_s) if budget_s else None
     report = ResilienceReport(quarantined=tuple(
         QuarantineRecord(

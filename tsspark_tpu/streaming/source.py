@@ -13,9 +13,7 @@ Contract: ``poll()`` returns a long-format DataFrame of NEW observations
 from __future__ import annotations
 
 import abc
-import itertools
 import sys
-import time
 from typing import Iterable, List, Optional
 
 import pandas as pd
@@ -63,18 +61,21 @@ class ResilientSource(MicroBatchSource):
         self._policy = policy or STREAM_POLL
 
     def poll(self) -> Optional[pd.DataFrame]:
-        for attempt in itertools.count():
-            try:
-                faults.inject("stream_poll")
-                return self._source.poll()
-            except Exception as e:
-                if not self._policy.allows(attempt + 1):
-                    raise
-                print(
-                    f"[streaming] poll failed ({type(e).__name__}: {e}); "
-                    f"retry {attempt + 1}", file=sys.stderr,
-                )
-                time.sleep(self._policy.delay_s(attempt))
+        def attempt():
+            faults.inject("stream_poll")
+            return self._source.poll()
+
+        def log_retry(retry: int, e: BaseException) -> None:
+            print(
+                f"[streaming] poll failed ({type(e).__name__}: {e}); "
+                f"retry {retry + 1}", file=sys.stderr,
+            )
+
+        # Delegate to RetryPolicy.call — the ONE retry loop — so every
+        # policy knob is honored; a hand-rolled attempts-only loop here
+        # silently ignored total_budget_s (a wall-clock budget against a
+        # permanently-down broker never fired).
+        return self._policy.call(attempt, on_retry=log_retry)
 
     def commit(self) -> None:
         self._source.commit()

@@ -7,8 +7,9 @@ warm-starts the solver, and writes the refreshed parameters back.
 Storage is the native ParamTable (tsspark_tpu.native, C++): one micro-batch
 update/lookup is two memcpy-bound bulk calls over contiguous float64 rows —
 the Python layer only interns string series ids to int64 codes.  Persistence
-stays npz via utils.checkpoint; new series simply miss and fall back to
-data-driven init.
+stays npz via utils.checkpoint (atomic write-temp-then-rename — a driver
+checkpointing mid-stream can crash without leaving a torn store behind);
+new series simply miss and fall back to data-driven init.
 """
 
 from __future__ import annotations

@@ -1,0 +1,97 @@
+"""THE atomic write-temp-then-rename helper for every durable artifact.
+
+Every file another process may concurrently read — chunk/prep results,
+sentinels, checkpoints, run configs — must be written through one of
+these helpers: write the full payload to a dot-prefixed temp file in the
+TARGET directory (same filesystem, so the rename is atomic; the dot
+prefix keeps a torn temp out of every resume/eval glob), then
+``os.replace`` it into place.  A reader can then never observe a
+half-written artifact: it sees the old file, the new file, or no file.
+
+The static file-protocol race checker (``tsspark_tpu.analysis.fileproto``)
+enforces this: any ``open(..., "w")`` / ``np.save*`` / ``json.dump`` that
+targets a protocol artifact outside this module (or an allowlisted
+append-only log) is a finding.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+
+def _tmp_path(path: str) -> str:
+    """Dot-prefixed sibling temp name, unique per writer process.
+
+    Same directory as the target (``os.replace`` must not cross
+    filesystems); the pid suffix keeps two processes racing the same
+    artifact from clobbering each other's half-written temp — each
+    finishes its own and the LAST rename wins whole."""
+    d, base = os.path.split(os.path.abspath(path))
+    return os.path.join(d, f".{base}.tmp.{os.getpid()}")
+
+
+def atomic_write(path: str, write_fn: Callable, mode: str = "wb") -> None:
+    """Write ``path`` atomically: ``write_fn(fh)`` fills a temp file
+    which is closed and renamed into place.
+
+    ``write_fn`` receives the open file object — ``np.save(fh, a)``,
+    ``np.savez(fh, **arrays)``, ``json.dump(obj, fh)``, ``pickle.dump``
+    and plain ``fh.write`` all accept one, so every artifact format in
+    the package rides this single helper.
+    """
+    tmp = _tmp_path(path)
+    try:
+        with open(tmp, mode) as fh:
+            write_fn(fh)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Atomic text-file write (sentinels, fingerprints, heartbeats)."""
+    atomic_write(path, lambda fh: fh.write(text), mode="w")
+
+
+# A live writer keeps its temp's mtime moving (np.savez streams to the
+# fd); 10 minutes of silence means the writer is dead — far beyond the
+# orchestrator's stall watchdog, which kills a worker after ~90-270 s
+# without progress.
+STALE_TEMP_S = 600.0
+
+
+def sweep_stale_temps(dirpath: str, max_age_s: float = STALE_TEMP_S
+                      ) -> int:
+    """Remove dead writers' orphaned ``.*.tmp.<pid>`` files.
+
+    The pid suffix keeps concurrent writers off each other's temps, but
+    it also means a SIGKILLed writer (the stall watchdog's move) leaves
+    a uniquely-named orphan no retry ever overwrites — without this
+    sweep a crash-looping run grows its scratch dir without bound.
+    Age-gated so a racing LIVE writer's in-progress temp is never
+    yanked out from under its ``os.replace``.  Returns the count
+    removed."""
+    import time
+
+    removed = 0
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return 0
+    now = time.time()
+    for name in names:
+        if not (name.startswith(".") and ".tmp." in name):
+            continue
+        p = os.path.join(dirpath, name)
+        try:
+            if now - os.path.getmtime(p) > max_age_s:
+                os.remove(p)
+                removed += 1
+        except OSError:
+            continue  # already gone / racing writer finished its rename
+    return removed

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from tsspark_tpu.config import ProphetConfig
 from tsspark_tpu.models.prophet.design import ScalingMeta
 from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.utils.atomic import atomic_write
 
 
 def config_fingerprint(config: ProphetConfig) -> str:
@@ -54,15 +55,19 @@ def save_state(
     arrays.update(
         {f"meta_{k}": v for k, v in state.meta._asdict().items()}
     )
-    np.savez(path + ".npz", **{k: np.asarray(v) for k, v in arrays.items()})
+    # Atomic npz + json (utils.atomic): a reader — a concurrent predict
+    # process, a resumed streaming driver — must never np.load a torn
+    # checkpoint or parse a half-written sidecar.
+    host = {k: np.asarray(v) for k, v in arrays.items()}
+    atomic_write(path + ".npz", lambda fh: np.savez(fh, **host))
     sidecar = {
         "fingerprint": config_fingerprint(config),
         "n_series": int(state.theta.shape[0]),
         "series_ids": None if series_ids is None else [str(s) for s in series_ids],
         "format": 1,
     }
-    with open(path + ".json", "w") as f:
-        json.dump(sidecar, f)
+    atomic_write(path + ".json", lambda fh: json.dump(sidecar, fh),
+                 mode="w")
 
 
 def save_forecaster(path: str, fc) -> None:
@@ -91,7 +96,7 @@ def save_forecaster(path: str, fc) -> None:
             mcmc_step_size=np.asarray(fc.mcmc_state.step_size),
             mcmc_divergences=np.asarray(fc.mcmc_state.divergences),
         )
-        np.savez(path + ".npz", **z)
+        atomic_write(path + ".npz", lambda fh: np.savez(fh, **z))
     with open(path + ".json") as f:
         sidecar = json.load(f)
     # The model config is stored without holidays' auto-added regressor
@@ -112,8 +117,8 @@ def save_forecaster(path: str, fc) -> None:
         "freq_days": fc._freq_days,
         "solver_config": dataclasses.asdict(fc.backend.solver_config),
     }
-    with open(path + ".json", "w") as f:
-        json.dump(sidecar, f)
+    atomic_write(path + ".json", lambda fh: json.dump(sidecar, fh),
+                 mode="w")
 
 
 def _config_from_dict(d: Dict) -> ProphetConfig:
