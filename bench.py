@@ -46,6 +46,7 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 from tsspark_tpu import orchestrate
+from tsspark_tpu.perf import load_learned_chunk, summarize_times
 
 TARGET_S = 60.0        # driver target: 60 s on a v5e-8 (BASELINE.json:5)
 TARGET_CHIPS = 8       # ... which is a 480 chip-second budget
@@ -56,13 +57,20 @@ MIN_CHUNK = 512
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "900"))
 # Reserve at the end of the budget for the eval child + summary print.
 RESERVE_S = 150.0
+# The accelerator probe/backoff phase may consume at most this fraction
+# of the budget while ZERO chunks have landed; past it the run degrades
+# to CPU fit workers so it always banks (and reports) real progress —
+# BENCH_r05 spent its full 875 s probing and flushed nothing.
+PROBE_BUDGET_FRACTION = 0.3
 
 
 # Bump when a bench/orchestrate change alters fit NUMERICS (solver args,
 # phase policy, data handling).  Orchestration-only changes (probing,
 # retries, logging) must NOT bump it: the whole point of the
 # numerics-scoped fingerprint below is that resume state survives them.
-BENCH_NUMERICS_REV = 6
+# rev 7: the online chunk autotuner varies chunk widths mid-run, which
+# changes the chunk the adaptive phase-1 depth observes.
+BENCH_NUMERICS_REV = 7
 
 
 def _code_fingerprint() -> str:
@@ -338,6 +346,18 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
     }
     if note:
         extra["note"] = note
+    # Per-segment perf telemetry (docs/PERF.md): per-chunk width/live/
+    # series-per-s/compile-miss rows plus the autotuner's learned state —
+    # the block ``python -m tsspark_tpu.perf BENCH_*.json`` prints.
+    autotune_state = None
+    apath = os.path.join(args._out_dir, "autotune.json")
+    if os.path.exists(apath):
+        try:
+            with open(apath) as fh:
+                autotune_state = json.load(fh)
+        except Exception:
+            pass
+    extra["perf"] = summarize_times(times, autotune_state)
     if probes and probes.get("n"):
         # Wedge-resilience audit trail: how many tunnel probes ran, how
         # many failed, and the wall-offset of the last one — proof the
@@ -399,6 +419,10 @@ def main() -> None:
                          "adapting it from chunk 0's convergence (A/B "
                          "instrument: the tuner deepens 12 -> 24 on the "
                          "M5 shape and the payoff is under measurement)")
+    ap.add_argument("--no-autotune", action="store_true",
+                    help="pin the chunk size to --chunk instead of "
+                         "hill-climbing it online from measured series/s "
+                         "(tsspark_tpu.perf.ChunkAutotuner)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for a quick pipeline check")
     ap.add_argument("--keep", action="store_true",
@@ -430,6 +454,7 @@ def main() -> None:
         "/tmp",
         f"tsbench_run_{args.series}x{args.days}_c{args.chunk}"
         f"_p{args.phase1_iters}{'f' if args.no_phase1_tune else ''}"
+        f"{'na' if args.no_autotune else ''}"
         f"_{_code_fingerprint()}",
     )
     args._out_dir = os.path.join(scratch, "out")
@@ -580,11 +605,27 @@ def main() -> None:
                 "--n-eval", str(min(512, n_done)),
             ])
         if orchestrate.missing_ranges(done, args.series):
+            # Pre-pack at the width the fit worker will actually request
+            # (it rejects width-mismatched prep payloads): the tuner's
+            # learned width when one exists, else — when autotuning — the
+            # tuner's starting floor (a fresh run's first claims are
+            # floor-sized, so cap-width payloads would all be rejected).
+            # Clamped to the current (possibly crash-halved) chunk cap,
+            # above which the tuner can never dispatch.
+            learned = load_learned_chunk(
+                os.path.join(args._out_dir, "autotune.json")
+            )
+            if learned:
+                prep_chunk = min(learned, state["chunk"])
+            elif not args.no_autotune:
+                prep_chunk = min(128, state["chunk"])
+            else:
+                prep_chunk = state["chunk"]
             _side_child("prep", [
                 sys.executable, "-m", "tsspark_tpu.orchestrate", "--_prep",
                 "--data", args._data_dir, "--out", args._out_dir,
                 "--series", str(args.series),
-                "--chunk", str(state["chunk"]),
+                "--chunk", str(prep_chunk),
                 "--max-ahead", "6",
             ])
 
@@ -597,6 +638,15 @@ def main() -> None:
         segment=args.segment,
         phase1_iters=args.phase1_iters,
         no_phase1_tune=args.no_phase1_tune,
+        # Online chunk autotuner: start small (first chunk flushes in
+        # seconds, whatever the runtime), hill-climb series/s along the
+        # pow-2 ladder up to --chunk, persist the learned size for
+        # resumes (tsspark_tpu.perf.ChunkAutotuner).
+        autotune=not args.no_autotune,
+        # Bound the probe/backoff phase: a tunnel-down run degrades to
+        # CPU workers after this share of the budget instead of probing
+        # to the reserve with nothing flushed (BENCH_r05).
+        probe_budget_s=BUDGET_S * PROBE_BUDGET_FRACTION,
         deadline=deadline,
         reserve=_reserve,
         on_idle=_overlap_cpu_work,
@@ -609,6 +659,9 @@ def main() -> None:
         max_fruitless_retries=None,
     )
     note = None if result.get("complete") else "fit budget exhausted; partial"
+    if result.get("degraded_cpu"):
+        note = ((note + "; ") if note else "") + \
+            "degraded to CPU workers after probe budget"
     if note:
         print(f"[bench] {note}", file=sys.stderr)
 

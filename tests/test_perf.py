@@ -1,0 +1,322 @@
+"""tsspark_tpu.perf: recorder telemetry, the online chunk autotuner, the
+FitState annotation, bench-extras summarization, and the __main__
+printer — plus the orchestrate wiring (autotune.json persisted, times
+rows carrying telemetry)."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tsspark_tpu.perf import (  # noqa: E402
+    ChunkAutotuner,
+    CompileWatch,
+    PerfRecorder,
+    PerfReport,
+    SegmentRecord,
+    attach_perf,
+    get_perf,
+    load_learned_chunk,
+    summarize_times,
+)
+
+
+# -- recorder ---------------------------------------------------------------
+
+class _FakeWatch:
+    def __init__(self):
+        self.n = 0
+
+    def size(self):
+        return self.n
+
+
+def test_recorder_segments_and_compile_miss():
+    w = _FakeWatch()
+    rec = PerfRecorder(watch=w)
+    with rec.dispatch(128, live=100, kind="chunk"):
+        w.n += 1  # a compile happened inside this dispatch
+    with rec.dispatch(64):
+        pass
+    rep = rec.report()
+    assert rep.widths == (128, 64)
+    assert [s.compile_miss for s in rep.segments] == [True, False]
+    assert rep.compile_misses == 1
+    assert rep.segments[0].live == 100 and rep.segments[1].live == 64
+    assert rep.total_s == rep.compile_s + rep.execute_s
+    d = rep.to_dict(n_series=100)
+    assert d["n_dispatches"] == 2 and "series_per_s" in d
+
+
+def test_compile_watch_detects_jit_cache_growth():
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    watch = CompileWatch((f,))
+    before = watch.size()
+    f(np.float32(1.0))
+    assert watch.size() >= before  # grew (or cache API absent -> 0)
+
+
+def test_attach_perf_composes_with_resilience_report():
+    from tsspark_tpu.models.prophet.design import ScalingMeta
+    from tsspark_tpu.models.prophet.model import FitState
+    from tsspark_tpu.resilience.report import (
+        ResilienceReport, attach_report, get_report,
+    )
+
+    z = np.zeros(2)
+    meta = ScalingMeta(*([z] * 7))
+    state = FitState(theta=np.zeros((2, 3)), meta=meta, loss=z,
+                     grad_norm=z, converged=z.astype(bool),
+                     n_iters=z.astype(np.int32))
+    rep = PerfReport(segments=(
+        SegmentRecord(0, "fit", 2, 2, 0.5, False),
+    ))
+    both = attach_perf(attach_report(state, ResilienceReport()), rep)
+    # Both annotations ride the same derived instance; neither drops.
+    assert get_perf(both) is rep
+    assert get_report(both) is not None
+    assert isinstance(both, FitState)
+    assert get_perf(state) is None
+
+
+def test_backend_attaches_cumulative_report():
+    from tsspark_tpu.backends.tpu import TpuBackend
+    from tsspark_tpu.config import (
+        ProphetConfig, SeasonalityConfig, SolverConfig,
+    )
+
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=3,
+    )
+    rng = np.random.default_rng(0)
+    ds = np.arange(96, dtype=np.float64)
+    y = (0.1 * ds + rng.normal(0, 0.1, (40, 96))).astype(np.float32)
+    rec = PerfRecorder()
+    bk = TpuBackend(cfg, SolverConfig(max_iters=20), perf=rec, rescue=False)
+    state = bk.fit(ds, y)
+    rep = get_perf(state)
+    assert rep is not None and len(rep.segments) >= 1
+    assert rep.total_s > 0
+    assert all(s.width >= 40 for s in rep.segments)
+
+
+# -- autotuner --------------------------------------------------------------
+
+def test_autotuner_starts_small_and_explores_up():
+    tu = ChunkAutotuner(cap=1024, floor=128)
+    assert tu.next_size() == 128
+    # Compile-tainted sample: no decision, no best.
+    tu.record(128, 128, 10.0, compile_miss=True)
+    assert tu.next_size() == 128
+    # Warm sample -> explore upward.
+    tu.record(128, 128, 1.0)
+    assert tu.next_size() == 256
+    tu.record(256, 256, 10.0, compile_miss=True)
+    tu.record(256, 256, 1.0)   # 256 series/s > 128 -> keep climbing
+    assert tu.next_size() == 512
+
+
+def test_autotuner_backs_off_when_bigger_is_slower():
+    tu = ChunkAutotuner(cap=512, floor=128)
+    tu.record(128, 128, 1.0)      # 128/s
+    assert tu.next_size() == 256  # explore
+    tu.record(256, 256, 4.0)      # 64/s — worse
+    assert tu.next_size() == 128  # back to the measured optimum
+    assert tu.best_size == 128
+    # Stays put: both neighbors known, neither better.
+    tu.record(128, 128, 1.0)
+    assert tu.next_size() == 128
+
+
+def test_autotuner_respects_cap_and_floor():
+    tu = ChunkAutotuner(cap=256, floor=64)
+    for _ in range(6):
+        tu.record(tu.next_size(), tu.next_size(), 0.01)
+    assert tu.next_size() <= 256
+    tu2 = ChunkAutotuner(cap=32, floor=128)  # floor clamped to cap
+    assert tu2.next_size() == 32
+
+
+def test_autotuner_persists_and_warm_starts(tmp_path):
+    path = str(tmp_path / "autotune.json")
+    tu = ChunkAutotuner(cap=1024, floor=128, state_path=path)
+    tu.record(128, 128, 1.0)
+    tu.record(256, 256, 0.5)
+    assert os.path.exists(path)
+    # External consumers read the MEASURED-BEST width; the resumed
+    # tuner continues from the exploration cursor (which may be an
+    # unexplored rung — here 512, mid-climb).
+    assert load_learned_chunk(path) == tu.best_size == 256
+    warm = ChunkAutotuner.load(path, cap=1024, floor=128)
+    assert warm.next_size() == tu.next_size()
+    assert warm.throughput(128) == pytest.approx(128.0)
+    # Corrupt state is pure cache: ignored, fresh tuner.
+    with open(path, "w") as fh:
+        fh.write("{not json")
+    assert load_learned_chunk(path) is None
+    fresh = ChunkAutotuner.load(path, cap=1024, floor=128)
+    assert fresh.next_size() == 128
+
+
+# -- summarization + __main__ ----------------------------------------------
+
+_TIMES = [
+    {"lo": 0, "hi": 128, "fit_s": 2.0, "width": 128, "live": 128,
+     "series_per_s": 64.0, "compile_miss": True, "t": 2.1},
+    {"lo": 128, "hi": 256, "fit_s": 0.5, "width": 128, "live": 128,
+     "series_per_s": 256.0, "compile_miss": False, "t": 2.7},
+    {"phase2_s": 1.0, "stragglers": 10},
+]
+
+
+def test_summarize_times():
+    out = summarize_times(_TIMES, autotune={"chunk": 256})
+    assert out["n_chunks"] == 2
+    assert out["first_flush_s"] == 2.1
+    assert out["compile_misses"] == 1
+    assert out["chunk_sizes"] == [128]
+    assert out["series_per_s_by_size"]["128"] == pytest.approx(160.0)
+    assert out["autotune"]["chunk"] == 256
+    assert len(out["segments"]) == 2
+
+
+def test_perf_main_over_bench_json_and_dir(tmp_path, capsys):
+    from tsspark_tpu.perf.__main__ import main as perf_main
+
+    bench = {"metric": "m", "value": 1.0,
+             "extra": {"perf": summarize_times(_TIMES)}}
+    p = tmp_path / "BENCH_x.json"
+    p.write_text(json.dumps(bench))
+    assert perf_main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "chunks fitted:     2" in out
+    assert "first chunk flush: 2.1 s" in out
+
+    d = tmp_path / "out"
+    d.mkdir()
+    with open(d / "times.jsonl", "w") as fh:
+        for row in _TIMES:
+            fh.write(json.dumps(row) + "\n")
+    (d / "autotune.json").write_text(json.dumps({"chunk": 128}))
+    assert perf_main([str(d)]) == 0
+    assert "autotuned chunk:   128" in capsys.readouterr().out
+
+
+# -- orchestrate wiring -----------------------------------------------------
+
+@pytest.mark.slow
+def test_fit_resilient_autotune_end_to_end(tmp_path):
+    from tsspark_tpu import orchestrate
+    from tsspark_tpu.config import (
+        ProphetConfig, SeasonalityConfig, SolverConfig,
+    )
+    from tsspark_tpu.data import datasets
+
+    batch = datasets.m5_like(n_series=300, n_days=128)
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 3),),
+        n_changepoints=5,
+    )
+    scratch = str(tmp_path / "scratch")
+    state = orchestrate.fit_resilient(
+        cfg, SolverConfig(max_iters=60),
+        batch.ds, np.nan_to_num(batch.y).astype(np.float32),
+        mask=batch.mask.astype(np.float32),
+        chunk=256, phase1_iters=8, autotune=True,
+        scratch_dir=scratch, keep_scratch=True, budget_s=600,
+    )
+    assert np.asarray(state.theta).shape[0] == 300
+    out = os.path.join(scratch, "out")
+    # The learned state persisted next to the chunk files.
+    at = json.load(open(os.path.join(out, "autotune.json")))
+    assert 128 <= at["chunk"] <= 256
+    # times.jsonl rows carry the telemetry schema bench.py summarizes.
+    rows = [json.loads(line) for line in open(os.path.join(out,
+                                                           "times.jsonl"))]
+    chunk_rows = [r for r in rows if "fit_s" in r]
+    assert chunk_rows, rows
+    for r in chunk_rows:
+        assert {"width", "live", "series_per_s", "compile_miss",
+                "t"} <= set(r)
+    # The first chunk is tuner-floor-sized: small first flush.
+    assert chunk_rows[0]["width"] == 128
+    summary = summarize_times(rows, at)
+    assert summary["n_chunks"] == len(chunk_rows)
+    # The streaming driver warm-starts its backend at the learned width.
+    from tsspark_tpu.streaming.driver import StreamingForecaster
+
+    fc = StreamingForecaster(
+        cfg, SolverConfig(max_iters=20),
+        autotune_state=os.path.join(out, "autotune.json"),
+    )
+    assert fc.backend.chunk_size == at["chunk"]
+
+
+# -- probe budget / CPU degradation -----------------------------------------
+
+def test_probe_budget_degrades_to_cpu_and_survives_resume(tmp_path,
+                                                          monkeypatch):
+    """A wedged accelerator (injected probe failures) must stop burning
+    budget after ``probe_budget_s`` and complete on CPU-pinned workers —
+    including on a RESUMED scratch dir that already holds chunks from a
+    previous run (the budget clock keys on progress THIS run, not on the
+    directory ever having held a chunk)."""
+    from tsspark_tpu import orchestrate
+    from tsspark_tpu.config import (
+        ProphetConfig, SeasonalityConfig, SolverConfig,
+    )
+    from tsspark_tpu.data import datasets
+    from tsspark_tpu.resilience import faults
+
+    batch = datasets.m5_like(n_series=96, n_days=96)
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=4,
+    )
+    data_dir, out_dir = str(tmp_path / "data"), str(tmp_path / "out")
+    os.makedirs(out_dir)
+    orchestrate.spill_data(data_dir, batch.ds,
+                           np.nan_to_num(batch.y).astype(np.float32),
+                           mask=batch.mask.astype(np.float32))
+    orchestrate.save_run_config(out_dir, cfg, SolverConfig(max_iters=40))
+    # Every probe this process makes fails (flag mode = tunnel wedged).
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "device_probe", attempts=1000, mode="flag"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+
+    import time
+
+    def run(state):
+        return orchestrate.run_resilient(
+            data_dir=data_dir, out_dir=out_dir, series=96, chunk=64,
+            min_chunk=32, phase1_iters=6,
+            probe_accelerator=True,      # force the probe loop on
+            probe_budget_s=0.0,          # degrade on the first failure
+            deadline=time.time() + 300, state=state,
+        )
+
+    state = run({})
+    assert state.get("degraded_cpu") is True
+    assert state["complete"] is True
+    n1 = len(orchestrate.completed_ranges(out_dir))
+    assert n1 > 0
+    # Resume with banked chunks: remove the phase-2 marker so work
+    # remains, and the second run must degrade again (not probe forever)
+    # even though the scratch already holds chunks.
+    os.remove(os.path.join(out_dir, "phase2_done"))
+    ranges = orchestrate.completed_ranges(out_dir)
+    os.remove(orchestrate._chunk_path(out_dir, *ranges[-1]))
+    state2 = run({})
+    assert state2.get("degraded_cpu") is True
+    assert state2["complete"] is True

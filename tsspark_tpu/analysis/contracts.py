@@ -332,6 +332,74 @@ def _c_mcmc(case: ShapeCase, out) -> List[str]:
     )
 
 
+def _lbfgs_state_sds(case: ShapeCase, cfg, solver):
+    from tsspark_tpu.ops.lbfgs import LbfgsState
+
+    b, p, m = case.b, cfg.num_params, solver.history
+    return LbfgsState(
+        theta=_sds((b, p)), f=_sds((b,)), grad=_sds((b, p)),
+        s_hist=_sds((m, b, p)), y_hist=_sds((m, b, p)),
+        rho=_sds((m, b)),
+        iteration=_sds((), "int32"),
+        converged=_sds((b,), "bool"),
+        n_iters=_sds((b,), "int32"),
+        prev_step=_sds((b,)),
+        floor_count=_sds((b,), "int32"),
+        ftol_count=_sds((b,), "int32"),
+        status=_sds((b,), "int32"),
+        precond=_sds((b, p)),
+    )
+
+
+def _k_compact_gather(case: ShapeCase):
+    """The compaction scheduler's gather kernels (perf tentpole): a
+    row-subset take over the solver state and the design tensors must
+    preserve every dtype and reduce exactly the series axis — a drifted
+    leaf here would silently corrupt every compacted trajectory."""
+    import jax
+
+    from tsspark_tpu.models.prophet.design import take_fit_data
+    from tsspark_tpu.ops.lbfgs import take_state
+
+    cfg, solver = _configs(case)
+    idx = _sds((max(case.b // 2, 1),), "int32")
+    return {
+        "state": jax.eval_shape(
+            take_state, _lbfgs_state_sds(case, cfg, solver), idx
+        ),
+        "data": jax.eval_shape(
+            take_fit_data, _fit_data(case, cfg), idx
+        ),
+    }
+
+
+def _c_compact_gather(case: ShapeCase, out) -> List[str]:
+    cfg, solver = _configs(case)
+    k = max(case.b // 2, 1)
+    p, m = cfg.num_params, solver.history
+    st, d = out["state"], out["data"]
+    errs = (
+        _expect(st.theta, (k, p), "float32", "take_state theta")
+        + _expect(st.s_hist, (m, k, p), "float32", "take_state s_hist")
+        + _expect(st.rho, (m, k), "float32", "take_state rho")
+        + _expect(st.iteration, (), "int32", "take_state iteration")
+        + _expect(st.converged, (k,), "bool", "take_state converged")
+        + _expect(st.n_iters, (k,), "int32", "take_state n_iters")
+        + _expect(st.status, (k,), "int32", "take_state status")
+        + _expect(d.y, (k, case.t), "float32", "take_fit_data y")
+        + _expect(d.X_reg, (k, case.t, case.r), "float32",
+                  "take_fit_data X_reg")
+    )
+    # Shared leaves must stay shared: gathering the (T, Fs) calendar
+    # seasonal matrix per-series would silently B-fold the design bytes.
+    if tuple(d.X_season.shape) != (case.t, cfg.num_seasonal_features):
+        errs.append(
+            f"take_fit_data X_season: shared (T, Fs) leaf changed shape "
+            f"to {tuple(d.X_season.shape)}"
+        )
+    return errs
+
+
 def _mesh_for(case: ShapeCase):
     import jax
 
@@ -408,6 +476,8 @@ def default_kernels() -> Tuple[KernelContract, ...]:
         KernelContract("seasonality.fourier_features", _k_seasonality,
                        _c_seasonality),
         KernelContract("model.mcmc_core", _k_mcmc, _c_mcmc),
+        KernelContract("compact.take_state+take_fit_data",
+                       _k_compact_gather, _c_compact_gather),
         KernelContract("sharding.fit_sharded", _k_sharded, _c_sharded,
                        wants_mesh=True),
         KernelContract("sharding.fit_sharded_packed", _k_sharded_packed,

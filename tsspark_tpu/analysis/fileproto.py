@@ -131,7 +131,18 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
     ArtifactSpec(
         "timing-log", ("times.jsonl",),
         ("fit_worker", "fit_worker.save_and_log"),
-        "append-only per-chunk diagnostics", append_ok=True,
+        "append-only per-chunk diagnostics (doubles as the perf "
+        "telemetry rows bench.py summarizes — docs/PERF.md)",
+        append_ok=True,
+    ),
+    ArtifactSpec(
+        "autotune-state", ("autotune.json",),
+        ("ChunkAutotuner.save",),
+        "learned chunk size + per-size throughput samples, written "
+        "atomically after every recorded chunk by the fit worker's "
+        "tuner; read by resumed workers, bench.py's prep sizing, and "
+        "the streaming driver's warm start — pure cache, corrupt "
+        "copies ignored at load",
     ),
     ArtifactSpec(
         "probe-log", ("probes.jsonl",),
@@ -164,6 +175,8 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/utils/checkpoint.py",
     "tsspark_tpu/resilience/integrity.py",
     "tsspark_tpu/resilience/faults.py",
+    "tsspark_tpu/perf/autotune.py",
+    "tsspark_tpu/perf/recorder.py",
 )
 
 _WRITE_FNS = {"save", "savez", "savez_compressed", "dump"}

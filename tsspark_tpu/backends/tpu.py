@@ -12,6 +12,7 @@ code runs on any JAX backend (tests exercise it on the forced-CPU mesh).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import warnings
 from typing import Optional
@@ -69,6 +70,21 @@ def _resilient_ineligibility(dyn_used, init, conditions, mesh, packable):
     return failed
 
 
+def _mesh_series_axis(mesh, shard_config=None) -> str:
+    """The mesh axis that shards the series dimension: the config's
+    declared name when given; else conventional names win over position —
+    "series" wherever it appears, with only "time" named the first
+    non-"time" axis, otherwise the first axis (ADVICE r4)."""
+    if shard_config is not None:
+        return shard_config.series_axis
+    names = mesh.axis_names
+    if "series" in names:
+        return "series"
+    if "time" in names and len(names) > 1:
+        return next(n for n in names if n != "time")
+    return names[0]
+
+
 def _pad_batch(arr, b_pad):
     """Host-side (numpy) zero-padding along the batch axis.
 
@@ -123,6 +139,7 @@ class TpuBackend(ForecastBackend):
                  rescue: bool = True,
                  mesh=None, shard_config=None,
                  resilient: bool = False, resilient_opts=None,
+                 compact: bool = True, perf=None,
                  **kwargs):
         """chunk_size bounds series per program; iter_segment bounds solver
         iterations per program.
@@ -179,7 +196,26 @@ class TpuBackend(ForecastBackend):
         Semantics are ``fit_twophase``'s (speed-first: no rescue pass).
         Ineligible inputs fall back to the in-process fit.
         ``resilient_opts`` forwards keywords to ``fit_resilient``
-        (scratch_dir, budget_s, phase1_iters, ...)."""
+        (scratch_dir, budget_s, phase1_iters, ...).
+
+        ``compact``: on segmented solves (``iter_segment``), shrink the
+        lockstep batch to the unconverged set between segments — the
+        convergence-compacting scheduler (models.prophet.model.
+        _run_segments_compacted; per-series results are bitwise
+        identical, per-iteration cost tracks the live set).  Widths walk
+        the same pow-2/32-floor ladder as the chunk padding, so shrunk
+        widths reuse compiled programs.  No-op on unsegmented solves
+        (one fused program has no between-segment boundary to compact
+        at) — which today includes every mesh solve (mesh excludes
+        iter_segment above); the width policy (sharding.compacted_width)
+        still accepts a series-shard multiple so a future segmented
+        sharded program composes without new padding rules.
+
+        ``perf`` (tsspark_tpu.perf.PerfRecorder): per-dispatch telemetry
+        accumulated across every chunk/segment this backend dispatches;
+        the cumulative report is attached to each returned FitState as
+        ``state.perf`` (perf.get_perf).  Telemetry blocks per dispatch
+        to time it, so leave it None on latency-critical pipelines."""
         super().__init__(*args, **kwargs)
         if mesh is not None and iter_segment:
             raise ValueError(
@@ -195,7 +231,26 @@ class TpuBackend(ForecastBackend):
         self.shard_config = shard_config
         self.resilient = resilient
         self.resilient_opts = dict(resilient_opts or {})
+        self.compact = compact
+        self.perf = perf
         self._model = ProphetModel(self.config, self.solver_config)
+
+    def _compact_multiple(self) -> int:
+        """Series-axis shard count a compacted width must divide into
+        (1 off-mesh) — the ``multiple`` the width policy
+        (``parallel.sharding.compacted_width``) pads up to.
+
+        Today this is 1 on every path that actually compacts: the mesh
+        and ``iter_segment`` are mutually exclusive (see __init__), and
+        compaction only runs on segmented solves — so the mesh branch is
+        consulted only by tests and by a future segmented-mesh path.
+        The resolution is shared with _fit_sharded_chunk
+        (``_mesh_series_axis``) so the two can never disagree."""
+        if self.mesh is None:
+            return 1
+        return int(self.mesh.shape[_mesh_series_axis(
+            self.mesh, self.shard_config
+        )])
 
     def _plan_length_buckets(self, y, mask):
         """Bucket series by observed time window.
@@ -362,12 +417,21 @@ class TpuBackend(ForecastBackend):
         # flow via its straggler pass) or segmented solves (bounded
         # dispatches are the caller's priority there).
         if not self.rescue or dyn_used or segmented:
-            return state
+            return self._attach_perf(state)
         with changepoint_span_warning_suppressed():
-            return self._rescue_pass(
+            return self._attach_perf(self._rescue_pass(
                 state, ds, y, mask, cap, floor, regressors, conditions,
                 reg_u8_cols,
-            )
+            ))
+
+    def _attach_perf(self, state):
+        """Ride the recorder's CUMULATIVE report on the returned state
+        (every chunk/segment this backend has dispatched so far)."""
+        if self.perf is None:
+            return state
+        from tsspark_tpu.perf import attach_perf
+
+        return attach_perf(state, self.perf.report())
 
     def _rescue_pass(self, state, ds, y, mask, cap, floor, regressors,
                      conditions, u8):
@@ -388,6 +452,7 @@ class TpuBackend(ForecastBackend):
             chunk_size=self.chunk_size, iter_segment=self.iter_segment,
             on_segment=self.on_segment, length_buckets=1, rescue=False,
             mesh=self.mesh, shard_config=self.shard_config,
+            compact=self.compact, perf=self.perf,
         )
         y = np.asarray(y)
         r = lambda a: None if a is None else np.asarray(a)[idx]
@@ -468,6 +533,7 @@ class TpuBackend(ForecastBackend):
                     length_buckets=1,
                     rescue=False,  # the top-level fit rescues the whole batch
                     mesh=self.mesh, shard_config=self.shard_config,
+                    compact=self.compact, perf=self.perf,
                 )
                 states = []
                 for idx, lo_t, hi_t in plan:
@@ -549,7 +615,9 @@ class TpuBackend(ForecastBackend):
             ds, y, mask=mask, cap=cap, floor=floor, regressors=regressors,
             init=init, iter_segment=self.iter_segment,
             on_segment=self.on_segment, conditions=conditions,
-            reg_u8_cols=reg_u8_cols, **(dyn or {}),
+            reg_u8_cols=reg_u8_cols, recorder=self.perf,
+            compact=self.compact,
+            compact_multiple=self._compact_multiple(), **(dyn or {}),
         )
         return _slice_state(state, 0, b)
 
@@ -609,41 +677,40 @@ class TpuBackend(ForecastBackend):
             # custom-named meshes work without a matching ShardingConfig.
             # The conventional names win over position: a mesh declared
             # ("time", "series") must not get its axes swapped just
-            # because "series" is not first (ADVICE r4).
+            # because "series" is not first (ADVICE r4).  The series-axis
+            # choice is shared with _compact_multiple via
+            # _mesh_series_axis.
             names = self.mesh.axis_names
-            if "series" in names:
-                series_ax = "series"
-                rest = [n for n in names if n != "series"]
-                time_ax = (
-                    "time" if "time" in rest
-                    else (rest[0] if rest else None)
-                )
-            elif "time" in names and len(names) > 1:
-                # Symmetric case: only "time" is conventionally named —
-                # it must stay the time axis even when listed first.
-                time_ax = "time"
-                series_ax = next(n for n in names if n != "time")
-            else:
-                series_ax = names[0]
-                time_ax = names[1] if len(names) > 1 else None
+            series_ax = _mesh_series_axis(self.mesh)
+            rest = [n for n in names if n != series_ax]
+            time_ax = (
+                "time" if "time" in rest else (rest[0] if rest else None)
+            )
             shard_cfg = ShardingConfig(
                 series_axis=series_ax,
                 time_axis=time_ax,
             )
         theta0 = None if theta0 is None else jnp.asarray(theta0)
-        if packable:
-            packed, u8 = pack_fit_data(
-                data, meta, ds, reg_u8_cols=reg_u8_cols,
-                collapse_cap=self.config.growth != "logistic",
-            )
-            res = sharding_mod.fit_sharded_packed(
-                packed, u8, theta0, self.config, solver, self.mesh,
-                shard_cfg,
-            )
-        else:
-            res = sharding_mod.fit_sharded(
-                data, theta0, self.config, solver, self.mesh, shard_cfg,
-            )
+        dispatch = (
+            self.perf.dispatch(int(y.shape[0]), kind="chunk")
+            if self.perf is not None else contextlib.nullcontext()
+        )
+        with dispatch:
+            if packable:
+                packed, u8 = pack_fit_data(
+                    data, meta, ds, reg_u8_cols=reg_u8_cols,
+                    collapse_cap=self.config.growth != "logistic",
+                )
+                res = sharding_mod.fit_sharded_packed(
+                    packed, u8, theta0, self.config, solver, self.mesh,
+                    shard_cfg,
+                )
+            else:
+                res = sharding_mod.fit_sharded(
+                    data, theta0, self.config, solver, self.mesh, shard_cfg,
+                )
+            if self.perf is not None:
+                jax.block_until_ready(res.theta)
         if self.on_segment is not None:
             self.on_segment()
         return FitState(
@@ -760,7 +827,7 @@ class TpuBackend(ForecastBackend):
             state2 = fit2(ds2, sub(y), **kwargs, **dyn2)
         if pad:
             state2 = _slice_state(state2, 0, idx.size)
-        return patch_state(state, idx, state2)
+        return self._attach_perf(patch_state(state, idx, state2))
 
     def _derived(self, **solver_overrides) -> "TpuBackend":
         """Same backend with SolverConfig fields replaced (keeps chunking
@@ -775,6 +842,7 @@ class TpuBackend(ForecastBackend):
             length_buckets=1,
             rescue=False,
             mesh=self.mesh, shard_config=self.shard_config,
+            compact=self.compact, perf=self.perf,
         )
 
     def _phase1(self, phase1_iters: int) -> "TpuBackend":
@@ -986,8 +1054,7 @@ def patch_state(state: FitState, idx: np.ndarray, sub: FitState) -> FitState:
     )
 
 
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+# One ladder for chunk padding and compaction widths (see
+# sharding.next_pow2); the alias keeps this module's many call sites
+# unchanged.
+from tsspark_tpu.parallel.sharding import next_pow2 as _next_pow2  # noqa: E402,E501

@@ -117,6 +117,33 @@ PACKED_PER_SERIES_FIELDS = (
 )
 
 
+def take_fit_data(data: FitData, idx: jnp.ndarray) -> FitData:
+    """Gather a row subset of a FitData batch (series axis): the design-
+    tensor half of the compaction primitive (``ops.lbfgs.take_state``).
+
+    Shared leaves — a (T, Fs) calendar seasonal matrix, the prior
+    vectors — are carried as-is; everything per-series is gathered on
+    axis 0.  Gathered rows are bitwise copies, so a solve continued on
+    the subset reproduces each selected series' full-width trajectory
+    exactly.
+    """
+    idx = jnp.asarray(idx)
+    take = lambda a: jnp.take(a, idx, axis=0)
+    return FitData(
+        t=take(data.t),
+        y=take(data.y),
+        mask=take(data.mask),
+        s=take(data.s),
+        cap=take(data.cap),
+        X_season=(
+            data.X_season if data.X_season.ndim == 2 else take(data.X_season)
+        ),
+        X_reg=take(data.X_reg),
+        prior_scales=data.prior_scales,
+        mult_mask=data.mult_mask,
+    )
+
+
 def _bitpack_time(a: np.ndarray) -> np.ndarray:
     """(B, T, K) exact-0/1 array -> (B, ceil(T/8), K) uint8, little-endian
     bits along the time axis (host side, numpy)."""

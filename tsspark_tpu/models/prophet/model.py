@@ -10,6 +10,7 @@ in, MAP parameters out.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Dict, NamedTuple, Optional, Tuple
@@ -235,6 +236,107 @@ def select_better_state(a: "FitState", b: "FitState",
     )
 
 
+def _run_segments_compacted(
+    data: FitData,
+    ls: lbfgs.LbfgsState,
+    config: ProphetConfig,
+    solver: SolverConfig,
+    iter_segment: int,
+    n_seg: int,
+    on_segment,
+    recorder,
+    floor: int,
+    multiple: int,
+) -> lbfgs.LbfgsResult:
+    """The convergence-compacting segment scheduler.
+
+    The batched solver already FREEZES converged series (their updates
+    are masked to zero), but frozen rows still ride every objective
+    evaluation — on the M5 shape, mean iterations to converge is ~3
+    while the lockstep batch pays full width for its slowest member.
+    Between segment dispatches this scheduler GATHERS the surviving
+    (unconverged) rows into the next power-of-2 width
+    (``parallel.sharding.compacted_width``: pow-2 ladder, 32-row floor,
+    shard-count multiple) and continues the solve at that width, so
+    per-iteration cost tracks the LIVE set instead of the original
+    batch.  Departing rows' results are harvested into full-width host
+    buffers at the moment they leave; the final result scatters the
+    remaining live rows back.
+
+    Parity: every per-series quantity in the solver and the design
+    tensors is row-local (``lbfgs.take_state`` / ``design.
+    take_fit_data``), pad rows are converged duplicates the active mask
+    freezes, and frozen rows never change after convergence — so the
+    compacted schedule is BITWISE identical to the full-width segmented
+    solve per series (tests/test_compaction.py).  Shrunk widths reuse
+    the pow-2 programs the chunk padding already compiles, so no
+    per-live-set-size recompiles.
+    """
+    from tsspark_tpu.models.prophet.design import take_fit_data
+    from tsspark_tpu.parallel.sharding import compacted_width
+
+    b_full = int(data.y.shape[0])
+    live = np.arange(b_full)  # original row of each current REAL row
+    n_real = b_full           # rows [0:n_real) are real; the rest pads
+    buf = None                # full-width host result buffers
+
+    def harvest(res, rows_local, rows_orig):
+        nonlocal buf
+        res_np = {
+            f: np.asarray(getattr(res, f)) for f in lbfgs.LbfgsResult._fields
+        }
+        if buf is None:
+            buf = {
+                f: np.empty((b_full,) + a.shape[1:], a.dtype)
+                for f, a in res_np.items()
+            }
+        for f, a in res_np.items():
+            buf[f][rows_orig] = a[rows_local]
+
+    for seg_i in range(n_seg):
+        width = int(data.y.shape[0])
+        with (recorder.dispatch(width, live=n_real, kind="segment")
+              if recorder is not None else contextlib.nullcontext()):
+            ls = fit_segment_core(data, ls, config, solver, iter_segment)
+            # Block per segment: bounds dispatch time AND the converged
+            # mask must be concrete before the compaction decision.
+            jax.block_until_ready(ls.theta)
+        if on_segment is not None:
+            on_segment()
+        conv = np.asarray(ls.converged)
+        if conv.all() or seg_i == n_seg - 1:
+            break
+        running = np.flatnonzero(~conv[:n_real])
+        new_w = compacted_width(running.size, floor=floor, multiple=multiple)
+        if new_w >= width:
+            continue
+        res = lbfgs.to_result(ls)
+        done_local = np.flatnonzero(conv[:n_real])
+        harvest(res, done_local, live[done_local])
+        # Pads are converged rows repeated: the solver's active mask
+        # freezes them, so they add no lockstep depth and their outputs
+        # are never scattered back.  done_local is nonempty whenever
+        # compaction fires: width == compacted_width(previous live set),
+        # so a shrink requires some row to have converged since.
+        pad = new_w - running.size
+        gather = (
+            np.concatenate([running, np.resize(done_local, pad)])
+            if pad else running
+        )
+        gidx = jnp.asarray(gather.astype(np.int32))
+        ls = lbfgs.take_state(ls, gidx)
+        data = take_fit_data(data, gidx)
+        live = live[gather]
+        n_real = running.size
+
+    res = lbfgs.to_result(ls)
+    if buf is None:
+        return res  # never compacted: device-resident result, as before
+    rows = np.arange(n_real)
+    harvest(res, rows, live[:n_real])
+    return lbfgs.LbfgsResult(**buf)
+
+
 def fitstate_from_packed(theta, stats, meta: ScalingMeta) -> "FitState":
     """FitState from fit_core_packed's (theta, (5, B) stats) result."""
     stats = np.asarray(stats)
@@ -348,6 +450,10 @@ class ProphetModel:
         max_iters_dynamic=None,
         gn_precond_dynamic=None,
         use_init_dynamic=None,
+        recorder=None,
+        compact: bool = False,
+        compact_floor: int = 32,
+        compact_multiple: int = 1,
     ) -> FitState:
         """Fit every series in the (B, T) batch.
 
@@ -381,6 +487,18 @@ class ProphetModel:
         through one compiled program.  On the non-packable fallback they
         are honored semantically (folded into an equivalent static solver
         config), just without the shared-program benefit.
+
+        ``recorder`` (tsspark_tpu.perf.PerfRecorder): per-dispatch
+        telemetry — wall time, dispatched width, live-set width,
+        compile-cache misses.  Timing requires blocking per dispatch,
+        so passing one trades dispatch-pipeline overlap for telemetry.
+
+        ``compact`` enables the convergence-compacting segment schedule
+        on the segmented path (see ``_run_segments_compacted``): the
+        lockstep batch shrinks to the unconverged set between segments
+        (``compact_floor``/``compact_multiple`` bound the width ladder).
+        Bitwise-identical per-series results; per-iteration cost
+        proportional to the live set.
         """
         data, meta = prepare_fit_data(
             ds, y, self.config, mask=mask, cap=cap, floor=floor,
@@ -427,13 +545,24 @@ class ProphetModel:
                     (np.asarray(data.y).shape[0], self.config.num_params),
                     np.float32,
                 )
-            theta, stats = fit_core_packed(
-                packed, theta0, self.config, self.solver_config,
+            kw = dict(
                 reg_u8_cols=u8,
                 max_iters_dynamic=max_iters_dynamic,
                 gn_precond_dynamic=gn_precond_dynamic,
                 use_theta0_dynamic=use_init_dynamic,
             )
+            if recorder is not None:
+                with recorder.dispatch(np.asarray(data.y).shape[0],
+                                       kind="fit"):
+                    theta, stats = fit_core_packed(
+                        packed, theta0, self.config, self.solver_config,
+                        **kw,
+                    )
+                    jax.block_until_ready(theta)
+            else:
+                theta, stats = fit_core_packed(
+                    packed, theta0, self.config, self.solver_config, **kw
+                )
             if on_segment is not None:
                 on_segment()
             return fitstate_from_packed(theta, stats, meta)
@@ -449,9 +578,16 @@ class ProphetModel:
             fallback = ProphetModel(self.config, solver)
             theta0 = init if bool(use_init_dynamic) else None
             return fallback._fit_prepared(
-                data, meta, theta0, iter_segment, on_segment
+                data, meta, theta0, iter_segment, on_segment,
+                recorder=recorder, compact=compact,
+                compact_floor=compact_floor,
+                compact_multiple=compact_multiple,
             )
-        return self._fit_prepared(data, meta, init, iter_segment, on_segment)
+        return self._fit_prepared(
+            data, meta, init, iter_segment, on_segment,
+            recorder=recorder, compact=compact, compact_floor=compact_floor,
+            compact_multiple=compact_multiple,
+        )
 
     def _fit_prepared(
         self,
@@ -460,6 +596,10 @@ class ProphetModel:
         init: Optional[jnp.ndarray],
         iter_segment: Optional[int] = None,
         on_segment=None,
+        recorder=None,
+        compact: bool = False,
+        compact_floor: int = 32,
+        compact_multiple: int = 1,
     ) -> FitState:
         # None -> warm start computed inside the jitted program (init.py).
         theta0 = init
@@ -470,18 +610,38 @@ class ProphetModel:
             # no cross-call caching — ~56 MB per re-ship at bench shape).
             data = jax.tree.map(jnp.asarray, data)
             ls = fit_init_core(data, theta0, self.config, solver)
-            for _ in range(-(-solver.max_iters // iter_segment)):
-                ls = fit_segment_core(
-                    data, ls, self.config, solver, iter_segment
+            n_seg = -(-solver.max_iters // iter_segment)
+            if compact:
+                # Convergence-compacting schedule: shrink the lockstep
+                # batch to the unconverged set between segments (bitwise-
+                # identical per series — see _run_segments_compacted).
+                res = _run_segments_compacted(
+                    data, ls, self.config, solver, iter_segment, n_seg,
+                    on_segment, recorder, compact_floor, compact_multiple,
                 )
-                # Block per segment: keeps every dispatch short AND surfaces
-                # a dead runtime at the segment boundary, not downstream.
-                jax.block_until_ready(ls.theta)
-                if on_segment is not None:
-                    on_segment()
-                if bool(ls.converged.all()):
-                    break
-            res = lbfgs.to_result(ls)
+            else:
+                width = int(data.y.shape[0])
+                for _ in range(n_seg):
+                    with (recorder.dispatch(width, kind="segment")
+                          if recorder is not None
+                          else contextlib.nullcontext()):
+                        ls = fit_segment_core(
+                            data, ls, self.config, solver, iter_segment
+                        )
+                        # Block per segment: keeps every dispatch short
+                        # AND surfaces a dead runtime at the segment
+                        # boundary, not downstream.
+                        jax.block_until_ready(ls.theta)
+                    if on_segment is not None:
+                        on_segment()
+                    if bool(ls.converged.all()):
+                        break
+                res = lbfgs.to_result(ls)
+        elif recorder is not None:
+            with recorder.dispatch(int(np.asarray(data.y).shape[0]),
+                                   kind="fit"):
+                res = fit_core(data, theta0, self.config, solver)
+                jax.block_until_ready(res.theta)
         else:
             res = fit_core(data, theta0, self.config, solver)
         return FitState(

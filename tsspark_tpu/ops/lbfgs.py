@@ -399,6 +399,38 @@ def run_segment(
     return jax.lax.while_loop(cond, body, state)
 
 
+def take_state(state: LbfgsState, idx: jnp.ndarray) -> LbfgsState:
+    """Gather a row subset of a solver state: the compaction primitive.
+
+    ``idx`` indexes the series axis — axis 0 for the (B, ...) leaves,
+    axis 1 for the (M, B, P)/(M, B) history ring; the shared iteration
+    counter is carried as-is.  Because every per-series quantity in the
+    solver (history ring, rho, line-search step memory, convergence
+    counters, preconditioner) is row-local, a gathered state continues
+    each selected series' trajectory BITWISE identically to the
+    full-width solve — this is what lets a segment scheduler shrink the
+    batch to the unconverged set between ``run_segment`` calls
+    (tests/test_compaction.py pins the parity).
+    """
+    idx = jnp.asarray(idx)
+    return LbfgsState(
+        theta=jnp.take(state.theta, idx, axis=0),
+        f=jnp.take(state.f, idx, axis=0),
+        grad=jnp.take(state.grad, idx, axis=0),
+        s_hist=jnp.take(state.s_hist, idx, axis=1),
+        y_hist=jnp.take(state.y_hist, idx, axis=1),
+        rho=jnp.take(state.rho, idx, axis=1),
+        iteration=state.iteration,
+        converged=jnp.take(state.converged, idx, axis=0),
+        n_iters=jnp.take(state.n_iters, idx, axis=0),
+        prev_step=jnp.take(state.prev_step, idx, axis=0),
+        floor_count=jnp.take(state.floor_count, idx, axis=0),
+        ftol_count=jnp.take(state.ftol_count, idx, axis=0),
+        status=jnp.take(state.status, idx, axis=0),
+        precond=jnp.take(state.precond, idx, axis=0),
+    )
+
+
 def minimize(
     fun: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
     theta0: jnp.ndarray,

@@ -435,6 +435,7 @@ def fit_worker(args) -> int:
     )
 
     faults.inject("fit_worker_start")
+    t_worker0 = time.time()
     # Resume never trusts a corrupt chunk: quarantine torn/mismatched
     # files NOW so their ranges land back in this worker's todo list and
     # phase 2 can never np.load garbage.  Predecessors killed mid-write
@@ -477,8 +478,34 @@ def fit_worker(args) -> int:
     # scalars (fit_core's *_dynamic args).
     model = backend._model
     n_params = model.config.num_params
-    zeros_theta = np.zeros((args.chunk, n_params), np.float32)
     collapse_cap = model.config.growth != "logistic"
+    # Per-width ridge-init placeholder cache: the autotuner dispatches
+    # several pow-2 widths over one run.
+    _zeros_theta: dict = {}
+
+    def theta_zeros(width: int):
+        if width not in _zeros_theta:
+            _zeros_theta[width] = np.zeros((width, n_params), np.float32)
+        return _zeros_theta[width]
+
+    # Online chunk autotuner (tsspark_tpu.perf.autotune): start the
+    # ladder SMALL so the first chunk file flushes within seconds
+    # (BENCH_r05 flushed nothing in 875 s behind one huge first
+    # dispatch), then hill-climb the pow-2 ladder toward the measured
+    # series/s optimum.  The learned state persists next to the chunk
+    # files so resumes — and the streaming driver's warm start — skip
+    # the walk.  Chunk width only regroups series into lockstep
+    # programs (row-local math; tests/test_compaction.py), so tuning
+    # is throughput-only.
+    from tsspark_tpu.perf import ChunkAutotuner, CompileWatch
+
+    compile_watch = CompileWatch.default()
+    tuner = None
+    if getattr(args, "autotune", False):
+        tuner = ChunkAutotuner.load(
+            os.path.join(args.out, "autotune.json"),
+            cap=args.chunk, floor=min(args.chunk, 128),
+        )
 
     # Segmented mode (--segment < phase-1 depth) keeps the FitData path:
     # per-segment dispatches with a heartbeat after each, for runs where
@@ -493,39 +520,53 @@ def fit_worker(args) -> int:
     # silently recompile mid-run.
     u8_cols = _indicator_reg_cols(reg) if reg is not None else ()
 
-    def rows(a, lo, hi, fill=0.0):
-        return _pad_chunk_rows(a, lo, hi, args.chunk, fill)
-
-    def prep(lo: int, hi: int):
+    def prep(lo: int, hi: int, width: int):
         if not segmented:
             # A CPU prep worker may have pre-packed this chunk while the
             # runtime was down (same prepare/pack code path, so numerics
             # are identical); corrupt/absent files fall through to local
-            # prep.
-            cached = load_prep(args.out, lo, hi, chunk=args.chunk)
+            # prep.  Width-mismatched payloads (the prep worker packs at
+            # the requested cap, the tuner may dispatch smaller) are
+            # rejected by load_prep and re-prepped locally.
+            cached = load_prep(args.out, lo, hi, chunk=width)
             if cached is not None:
-                return lo, hi, cached[0], cached[1], cached[2]
+                return lo, hi, width, cached[0], cached[1], cached[2]
         b_real = hi - lo
+        rows = lambda a, fill=0.0: _pad_chunk_rows(a, lo, hi, width, fill)
         # as_numpy: a prep thread must not issue device transfers — they
         # would queue behind the in-flight fit program and re-serialize
         # the pipeline the prefetch exists to overlap.
-        y_c = rows(y, lo, hi)
+        y_c = rows(y)
         data, meta = model.prepare(
-            ds, y_c, mask=_chunk_mask(y_c, mask, lo, hi, args.chunk),
-            regressors=rows(reg, lo, hi), cap=rows(cap, lo, hi, fill=1.0),
-            floor=rows(floor, lo, hi), as_numpy=True,
+            ds, y_c, mask=_chunk_mask(y_c, mask, lo, hi, width),
+            regressors=rows(reg), cap=rows(cap, fill=1.0),
+            floor=rows(floor), as_numpy=True,
         )
         if segmented:
-            return lo, hi, b_real, data, meta
+            return lo, hi, width, b_real, data, meta
         packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols,
                                   collapse_cap=collapse_cap)
-        return lo, hi, b_real, packed, meta
+        return lo, hi, width, b_real, packed, meta
 
     # Range claims come from plan_chunks (coverage-based, never file
     # names) — see its docstring for the overlap invariants it carries.
-    todo = plan_chunks(
-        completed_ranges(args.out), args.lo, args.hi, args.chunk
-    )
+    # With the tuner each claim is sized at submit time, so the claim
+    # grid follows the learned chunk size mid-run; locally-claimed
+    # ranges count as covered because the writer thread may not have
+    # flushed their files yet.
+    claimed: List[Tuple[int, int]] = []
+
+    def next_claim():
+        width = tuner.next_size() if tuner is not None else args.chunk
+        todo2 = plan_chunks(
+            completed_ranges(args.out) + claimed, args.lo, args.hi, width
+        )
+        if not todo2:
+            return None
+        lo2, hi2 = todo2[0]
+        claimed.append((lo2, hi2))
+        return lo2, hi2, width
+
     prefetch_depth = 3
     # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
     # program, so it can change per chunk for free.  One adjustment after
@@ -550,9 +591,14 @@ def fit_worker(args) -> int:
         elif frac_unconv < 0.005 and depth["v"] > 8:
             depth["v"] = max(8, int(depth["v"]) * 2 // 3)
 
-    def save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1):
+    def save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1,
+                     width, compiled):
         """Chunk save + prep-file cleanup + one times.jsonl row (shared by
-        the packed writer path and the segmented inline path)."""
+        the packed writer path and the segmented inline path).  The row
+        doubles as the per-chunk perf telemetry (docs/PERF.md): padded
+        width, live series, series/s, compile-miss, and the wall offset
+        of the flush — what bench.py folds into BENCH extras via
+        ``perf.summarize_times``."""
         save_chunk_atomic(args.out, lo, hi, state)
         try:  # prep payload served its purpose; bound scratch disk
             os.remove(_prep_path(args.out, lo, hi))
@@ -564,7 +610,11 @@ def fit_worker(args) -> int:
                 "wait_s": round(t_wait, 3), "put_s": round(t_put, 3),
                 "dev_s": round(t_dev, 3),
                 "read_s": round(time.time() - t1, 3),
-                "chunk": args.chunk, "device": str(jax.devices()[0]),
+                "chunk": args.chunk, "width": width, "live": hi - lo,
+                "series_per_s": round((hi - lo) / fit_s, 2) if fit_s else 0,
+                "compile_miss": bool(compiled),
+                "t": round(time.time() - t_worker0, 2),
+                "device": str(jax.devices()[0]),
             }) + "\n")
 
     # Post-fit host work (device->host readback of the small result
@@ -575,14 +625,15 @@ def fit_worker(args) -> int:
     # (wait+put+dev); read_s alone reflects writer-side readback, which
     # may overlap the next chunk's upload.
     def finish_chunk(lo, hi, b_real, theta, stats, meta, fit_s, t_wait,
-                     t_put, t_dev):
+                     t_put, t_dev, width, compiled):
         t1 = time.time()
         state = fitstate_from_packed(
             np.asarray(theta)[:b_real],
             np.asarray(stats)[:, :b_real],
             jax.tree.map(lambda a: np.asarray(a)[:b_real], meta),
         )
-        save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1)
+        save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1,
+                     width, compiled)
         return state
 
     # Device-resident chunk payloads: phase 1 keeps every uploaded packed
@@ -601,21 +652,31 @@ def fit_worker(args) -> int:
     # Test/chaos hook: crash the worker after N chunk saves to prove the
     # parent's retry + resume path (tests/test_orchestrate.py).
     crash_after = int(os.environ.get("TSSPARK_TEST_CRASH_AFTER", "0"))
+    from collections import deque
+
     with ThreadPoolExecutor(max_workers=2) as pool, \
             ThreadPoolExecutor(max_workers=1) as writer:
         write_futs = []
-        futs = {
-            j: pool.submit(prep, *todo[j])
-            for j in range(min(prefetch_depth, len(todo)))
-        }
-        for i in range(len(todo)):
+        pending: deque = deque()
+
+        def submit_next() -> bool:
+            c = next_claim()
+            if c is None:
+                return False
+            lo2, hi2, w2 = c
+            pending.append(pool.submit(prep, lo2, hi2, w2))
+            return True
+
+        for _ in range(prefetch_depth):
+            if not submit_next():
+                break
+        n_fitted = 0
+        while pending:
             t0 = time.time()
-            faults.inject("fit_chunk", lo=todo[i][0], hi=todo[i][1])
-            lo, hi, b_real, payload, meta = futs.pop(i).result()
+            lo, hi, width, b_real, payload, meta = pending.popleft().result()
+            faults.inject("fit_chunk", lo=lo, hi=hi)
             t_wait = time.time() - t0
-            nxt = i + prefetch_depth
-            if nxt < len(todo):
-                futs[nxt] = pool.submit(prep, *todo[nxt])
+            submit_next()
             t1 = time.time()
             # One device_put call for the whole pytree (not per-leaf
             # tree.map): the runtime can batch the per-buffer dispatches.
@@ -623,27 +684,40 @@ def fit_worker(args) -> int:
             jax.block_until_ready(jax.tree.leaves(payload))
             t_put = time.time() - t1
             t1 = time.time()
+            snap = compile_watch.size()
             if segmented:
+                # Compaction on: the segment scheduler shrinks each
+                # chunk's lockstep batch to its unconverged set between
+                # dispatches (bitwise-identical; heartbeats still fire
+                # per dispatch).
                 state = phase1._model._fit_prepared(
                     payload, meta, None, phase1.iter_segment,
-                    on_segment=heartbeat,
+                    on_segment=heartbeat, compact=True,
                 )
                 jax.block_until_ready(state.theta)
                 t_dev = time.time() - t1
+                compiled = compile_watch.size() > snap
+                if tuner is not None and hi - lo == width:
+                    # Full chunks only: a padded tail claim costs
+                    # full-width wall for a short real-row count and
+                    # would drag the size's estimate off the optimum.
+                    tuner.record(width, hi - lo, time.time() - t0,
+                                 compile_miss=compiled)
                 t1 = time.time()
                 state = jax.tree.map(
                     lambda a: np.asarray(a)[:b_real], state
                 )
                 save_and_log(lo, hi, state, time.time() - t0,
-                             t_wait, t_put, t_dev, t1)
+                             t_wait, t_put, t_dev, t1, width, compiled)
             else:
                 theta, stats = fit_core_packed(
-                    payload, zeros_theta, model.config, solver_config,
-                    reg_u8_cols=u8_cols,
+                    payload, theta_zeros(width), model.config,
+                    solver_config, reg_u8_cols=u8_cols,
                     **phase1_dynamic_args(depth["v"], False, packed=True),
                 )
                 jax.block_until_ready(theta)
                 heartbeat()
+                compiled = compile_watch.size() > snap
                 if two_phase and not os.environ.get("BENCH_NO_RESIDENT"):
                     # Real [lo, hi) recorded: rows past hi - lo are inert
                     # padding that phase 2 must never gather (a padding
@@ -657,18 +731,24 @@ def fit_worker(args) -> int:
                         resident_bytes += nb
                 t_dev = time.time() - t1
                 fit_s = time.time() - t0
+                if tuner is not None and hi - lo == width:
+                    # Full chunks only (see the segmented branch above).
+                    tuner.record(width, hi - lo, fit_s,
+                                 compile_miss=compiled)
                 if not depth["tuned"]:
                     # Depth must settle before chunk 1 dispatches, so
                     # chunk 0 finalizes inline.
                     state = finish_chunk(lo, hi, b_real, theta, stats,
-                                         meta, fit_s, t_wait, t_put, t_dev)
+                                         meta, fit_s, t_wait, t_put, t_dev,
+                                         width, compiled)
                     tune_depth(state, b_real)
                 else:
                     write_futs.append(writer.submit(
                         finish_chunk, lo, hi, b_real, theta, stats, meta,
-                        fit_s, t_wait, t_put, t_dev,
+                        fit_s, t_wait, t_put, t_dev, width, compiled,
                     ))
-            if crash_after and i + 1 >= crash_after:
+            n_fitted += 1
+            if crash_after and n_fitted >= crash_after:
                 for f in write_futs:
                     f.result()
                 os._exit(17)  # simulated mid-run worker death
@@ -737,7 +817,11 @@ def fit_worker(args) -> int:
         # *_dynamic args (phase2_dynamic_args — the triple fit_twophase
         # uses), so no second program is ever compiled or warmed.
         n_s = len(straggler_idx)
-        pad = (-n_s) % args.chunk
+        # Phase-2 pad width: the tuner's best-throughput (warm-compiled)
+        # size when autotuning, else the requested chunk — either way the
+        # deep refit re-dispatches a program shape phase 1 already ran.
+        p2_chunk = tuner.best_size if tuner is not None else args.chunk
+        pad = (-n_s) % p2_chunk
         pad_rows = lambda a: np.concatenate(
             [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
         ) if pad else a
@@ -843,15 +927,15 @@ def fit_worker(args) -> int:
                     **phase2_dynamic_args(solver_config, packed=True),
                 )
             th_parts, st_parts = [], []
-            for lo2 in range(0, n_s, args.chunk):
-                hi2 = min(lo2 + args.chunk, n_s)
+            for lo2 in range(0, n_s, p2_chunk):
+                hi2 = min(lo2 + p2_chunk, n_s)
                 ix = row_idx[lo2:hi2]
                 th = theta_cat[lo2:hi2].astype(np.float32)
-                if hi2 - lo2 < args.chunk:
+                if hi2 - lo2 < p2_chunk:
                     # Pad by repeating the first row: a duplicate of a row
                     # already being solved adds no lockstep depth (unlike
                     # arbitrary data) and its result is sliced away.
-                    rep = args.chunk - (hi2 - lo2)
+                    rep = p2_chunk - (hi2 - lo2)
                     ix = np.concatenate([ix, np.repeat(ix[:1], rep)])
                     th = np.concatenate(
                         [th, np.repeat(th[:1], rep, axis=0)]
@@ -894,10 +978,10 @@ def fit_worker(args) -> int:
             # peak memory.
             resident.clear()
             y_s, m_s, r_s, c_s, f_s, init_s = host_gather()
-            lows = list(range(0, n_s + pad, args.chunk))
+            lows = list(range(0, n_s + pad, p2_chunk))
 
             def prep2(lo2):
-                hi2 = lo2 + args.chunk
+                hi2 = lo2 + p2_chunk
                 sl = lambda a: None if a is None else a[lo2:hi2]
                 data2, meta2 = model.prepare(
                     ds, y_s[lo2:hi2], mask=sl(m_s), regressors=sl(r_s),
@@ -925,7 +1009,7 @@ def fit_worker(args) -> int:
                     # carry status FLOOR/STALLED and are the rescue
                     # path's job, not phase 2's).
                     th2, st2 = fit_core_packed(
-                        packed2, init_s[lo2:lo2 + args.chunk],
+                        packed2, init_s[lo2:lo2 + p2_chunk],
                         model.config, solver_config,
                         reg_u8_cols=u8_cols,
                         **phase2_dynamic_args(solver_config, packed=True),
@@ -1076,7 +1160,8 @@ def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
                  timeout: Optional[float] = None,
                  progress_timeout: Optional[float] = None,
                  log_stream=None,
-                 policy: Optional[RetryPolicy] = None) -> int:
+                 policy: Optional[RetryPolicy] = None,
+                 force_cpu: bool = False) -> int:
     """Run a child worker; kill it on overall timeout OR when no new chunk
     result / heartbeat has appeared for ``progress_timeout`` seconds (a
     wedged runtime blocks client creation forever — stalling is
@@ -1084,7 +1169,11 @@ def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
 
     ``policy``: the policy's per-attempt deadline (``attempt_timeout_s``,
     when set) caps this spawn's ``timeout`` — how a RetryPolicy bounds
-    each worker attempt independently of the run's overall budget."""
+    each worker attempt independently of the run's overall budget.
+
+    ``force_cpu`` pins the child to the CPU backend (prep workers
+    always; fit workers after the parent's probe budget declares the
+    accelerator path dead — see run_resilient's probe_budget_s)."""
     if faults.inject("worker_spawn"):
         return -9  # injected spawn failure (same rc as a killed worker)
     if policy is not None:
@@ -1096,7 +1185,7 @@ def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
            "--data", data_dir, "--out", out_dir] + extra
     proc = subprocess.Popen(
         cmd, stdout=log_stream or sys.stderr,
-        env=_child_env(force_cpu=(mode == "--_prep")),
+        env=_child_env(force_cpu=force_cpu or (mode == "--_prep")),
     )
     _CHILDREN.add(proc)
     start = time.time()
@@ -1156,12 +1245,14 @@ def run_resilient(
     segment: int = 0,
     phase1_iters: int = 12,
     no_phase1_tune: bool = False,
+    autotune: bool = False,
     deadline: Optional[float] = None,
     reserve: Callable[[], float] = lambda: 25.0,
     on_idle: Optional[Callable[[], None]] = None,
     progress_timeout: float = 90.0,
     state: Optional[dict] = None,
     probe_accelerator: Optional[bool] = None,
+    probe_budget_s: Optional[float] = None,
     max_fruitless_retries: Optional[int] = 8,
     retry_policy: Optional[RetryPolicy] = None,
     probe_policy: Optional[RetryPolicy] = None,
@@ -1195,6 +1286,21 @@ def run_resilient(
     zero-progress attempts, and 5 s x1.5-backoff probe sleeps (30 s cap)
     with 30 + 15*consec <= 90 s per-probe patience.  An explicit
     ``retry_policy`` overrides ``max_fruitless_retries``.
+
+    ``autotune`` turns on the fit workers' online chunk-size tuner
+    (tsspark_tpu.perf.ChunkAutotuner): the chunk ladder starts small so
+    the first result file flushes within seconds, then hill-climbs
+    toward the measured series/s optimum; the learned size persists in
+    ``<out_dir>/autotune.json`` so resumes start warm.  ``chunk`` then
+    acts as the tuner's CAP rather than the fixed size.
+
+    ``probe_budget_s`` bounds the accelerator probe/backoff phase: once
+    that much wall time has passed with failed probes and ZERO chunks
+    landed, the parent stops probing and spawns fit workers pinned to
+    the CPU backend (loud stderr note, ``state["degraded_cpu"]``) —
+    slow beats a run that spends its whole budget probing a dead tunnel
+    and reports nothing (BENCH_r05).  ``None`` keeps the historical
+    probe-forever behavior.
     """
     if retry_policy is None:
         retry_policy = dataclasses.replace(
@@ -1232,10 +1338,23 @@ def run_resilient(
         except OSError:
             pass
 
+    # CPU degradation survives re-entry: a caller re-running rounds
+    # (fit_resilient after a bisection) passes the same state dict, and
+    # a tunnel already declared dead must not be re-probed from scratch.
+    force_cpu = bool(state.get("degraded_cpu"))
     check_tunnel = (
-        probe_accelerator if probe_accelerator is not None
-        else os.environ.get("JAX_PLATFORMS", "") not in ("cpu",)
+        not force_cpu
+        and (probe_accelerator if probe_accelerator is not None
+             else os.environ.get("JAX_PLATFORMS", "") not in ("cpu",))
     )
+    # Probe-budget accounting: ``spent`` accumulates ONLY time inside
+    # the failed-probe/backoff branch (probe wall + backoff sleep) — a
+    # slow compile or a long healthy fit must never count against the
+    # probe budget.  It resets whenever a new chunk lands THIS run, so
+    # the budget bounds the current outage; a resumed run with a dead
+    # tunnel still degrades instead of re-probing its whole budget away
+    # on top of run 1's banked chunks (the BENCH_r05 shape).
+    probe_phase = {"spent": 0.0, "n": len(completed_ranges(out_dir))}
     two_phase = phase1_iters > 0
     while True:
         missing = missing_ranges(completed_ranges(out_dir), series)
@@ -1277,6 +1396,30 @@ def run_resilient(
                     f"({probes['fails']}/{probes['n']} failed)",
                     file=sys.stderr,
                 )
+                n_now = len(completed_ranges(out_dir))
+                if n_now > probe_phase["n"]:
+                    # New chunks landed since the last outage: this is a
+                    # fresh outage, give it a fresh probe budget.
+                    probe_phase.update(n=n_now, spent=0.0)
+                probe_phase["spent"] += time.time() - t_probe
+                if (probe_budget_s is not None
+                        and probe_phase["spent"] > probe_budget_s):
+                    # The probe/backoff phase spent its bounded share of
+                    # the budget with nothing NEW landed this run: stop
+                    # probing and pin the fit workers to CPU — a slow
+                    # run that flushes chunks beats one that probes a
+                    # dead tunnel to the deadline and reports zero new
+                    # series (BENCH_r05).
+                    print(
+                        f"[orchestrate] probe budget "
+                        f"({probe_budget_s:.0f}s) exhausted with no new "
+                        f"chunks landed; degrading fit workers to CPU",
+                        file=sys.stderr,
+                    )
+                    state["degraded_cpu"] = True
+                    force_cpu = True
+                    check_tunnel = False
+                    continue
                 if on_idle is not None:
                     on_idle()
                 # Backoff between failed probes (probe_policy.delay_s:
@@ -1290,6 +1433,9 @@ def run_resilient(
                     if deadline else probe_sleep
                 )
                 time.sleep(min(probe_sleep, sleep_cap))
+                # Backoff sleeps are probe-phase time too (on_idle work
+                # overlaps them, but the accelerator made no progress).
+                probe_phase["spent"] += min(probe_sleep, sleep_cap)
                 continue
             check_tunnel = False
         remaining = (deadline - time.time()) if deadline else None
@@ -1306,9 +1452,10 @@ def run_resilient(
             "--segment", str(segment),
             "--series", str(series),
             "--phase1-iters", str(phase1_iters),
-        ] + (["--no-phase1-tune"] if no_phase1_tune else []),
+        ] + (["--no-phase1-tune"] if no_phase1_tune else [])
+          + (["--autotune"] if autotune else []),
             timeout=budget, progress_timeout=progress_timeout,
-            policy=retry_policy)
+            policy=retry_policy, force_cpu=force_cpu)
         if rc == 0:
             state["fruitless"] = 0
             continue  # re-scan; loop exits when nothing is missing
@@ -1326,9 +1473,11 @@ def run_resilient(
                 rc=rc,
             )
         # A death with zero progress puts the runtime itself under
-        # suspicion.
+        # suspicion (unless the accelerator path is already declared
+        # dead — CPU-pinned workers have no tunnel to probe).
         check_tunnel = (
             not made_progress
+            and not force_cpu
             and (probe_accelerator if probe_accelerator is not None
                  else os.environ.get("JAX_PLATFORMS", "") not in ("cpu",))
         )
@@ -1459,6 +1608,7 @@ def _bisect_quarantine(
     retry_policy: RetryPolicy, report: ResilienceReport,
     model_config, solver_config, max_quarantine: int,
     degrade_to_cpu: bool, deadline: Optional[float],
+    force_cpu: bool = False,
 ) -> ResilienceReport:
     """A chunk kept killing the worker: bisect the failing ranges down to
     single series, quarantine the isolated poison, and fit the survivors
@@ -1483,10 +1633,15 @@ def _bisect_quarantine(
     def probe(lo: int, hi: int) -> bool:
         for attempt in range(2):
             try:
+                # A run already degraded to CPU keeps its probes there:
+                # an accelerator-bound probe would hang in client
+                # creation for the whole attempt timeout and make every
+                # data-bound crash look environmental.
                 spawn_worker(
                     "--_fit", data_dir, out_dir, extra(lo, hi),
                     timeout=retry_policy.attempt_timeout(attempt),
                     progress_timeout=progress_timeout,
+                    force_cpu=force_cpu,
                 )
             except faults.FaultInjected:
                 pass  # an injected spawn failure is still a failure
@@ -1602,6 +1757,8 @@ def fit_resilient(
     phase1_iters: int = 12,
     segment: int = 0,
     no_phase1_tune: bool = False,
+    autotune: bool = False,
+    probe_budget_s: Optional[float] = None,
     budget_s: Optional[float] = None,
     scratch_dir: Optional[str] = None,
     keep_scratch: bool = False,
@@ -1652,6 +1809,11 @@ def fit_resilient(
 
     ``retry_policy``/``probe_policy`` tune the respawn and accelerator
     probe schedules (resilience.policy.RetryPolicy).
+
+    ``autotune`` / ``probe_budget_s``: the workers' online chunk-size
+    tuner and the probe-phase budget (see ``run_resilient``).  With
+    ``autotune=True``, ``chunk`` is the tuner's cap and the learned
+    size persists in the scratch dir for resumes.
     """
     import shutil
     import tempfile
@@ -1709,7 +1871,11 @@ def fit_resilient(
          "floor": floor},
         {"series": series, "chunk": chunk, "phase1_iters": phase1_iters,
          "segment": segment, "no_phase1_tune": no_phase1_tune,
-         "quarantine": quarantine},
+         "quarantine": quarantine,
+         # autotune changes which chunk widths the adaptive phase-1
+         # depth sees, so its results may differ from a fixed-chunk run
+         # — a different fingerprint keeps the two from sharing scratch.
+         "autotune": autotune},
     )
     fp_path = os.path.join(out_dir, "run_fingerprint")
     if os.path.exists(fp_path):
@@ -1751,6 +1917,8 @@ def fit_resilient(
         segment=segment,
         phase1_iters=phase1_iters,
         no_phase1_tune=no_phase1_tune,
+        autotune=autotune,
+        probe_budget_s=probe_budget_s,
         deadline=deadline,
         progress_timeout=progress_timeout,
         retry_policy=retry_policy,
@@ -1781,8 +1949,13 @@ def fit_resilient(
                 report=report, model_config=config,
                 solver_config=solver_config, max_quarantine=max_quarantine,
                 degrade_to_cpu=degrade_to_cpu, deadline=deadline,
+                force_cpu=bool(run_state.get("degraded_cpu")),
             )
-            run_state = {}
+            # Fresh round state, but the learned "accelerator is dead"
+            # fact survives: wiping it would send the next round back
+            # to probing the tunnel its predecessor already gave up on.
+            run_state = {"degraded_cpu": run_state.get("degraded_cpu",
+                                                       False)}
             continue  # re-enter for the phase-2 pass / remaining ranges
         if not run_state.get("complete"):
             raise TimeoutError(
@@ -1807,10 +1980,19 @@ def fit_resilient(
             marker = os.path.join(out_dir, "phase2_done")
             if os.path.exists(marker):
                 os.remove(marker)
-            run_state = {}
+            run_state = {"degraded_cpu": run_state.get("degraded_cpu",
+                                                       False)}
     report = dataclasses.replace(
         report, retries=int(run_state.get("retries", 0))
     )
+    if run_state.get("degraded_cpu") and not report.degraded_to_cpu:
+        report = dataclasses.replace(
+            report, degraded_to_cpu=True,
+            warnings=report.warnings + (
+                "accelerator probe budget exhausted with no new chunks; "
+                "fit workers were pinned to the CPU backend",
+            ),
+        )
     if report.quarantined:
         result = _mark_quarantined_rows(result, report.quarantined_indices)
     if own_scratch and not keep_scratch:
@@ -1858,6 +2040,7 @@ def _worker_main(argv) -> int:
     ap.add_argument("--series", type=int, default=0)
     ap.add_argument("--phase1-iters", type=int, default=0)
     ap.add_argument("--no-phase1-tune", action="store_true")
+    ap.add_argument("--autotune", action="store_true")
     ap.add_argument("--max-ahead", type=int, default=6)
     a = ap.parse_args(argv)
     return {"--_fit": fit_worker, "--_prep": prep_worker}[mode](a)

@@ -44,6 +44,39 @@ def pad_to_multiple(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def next_pow2(n: int) -> int:
+    """THE pow-2 ladder primitive shared by the chunk padding
+    (``TpuBackend``) and the compaction width policy below, so the two
+    can never walk different ladders.  (``perf.autotune`` keeps a
+    stdlib-local copy: importing this module would pull JAX into the
+    perf package's deliberately light import chain.)"""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def compacted_width(n_live: int, floor: int = 32, multiple: int = 1) -> int:
+    """Padded batch width for a compacted live set.
+
+    The segment scheduler (``ProphetModel._fit_prepared``) shrinks the
+    lockstep batch to its unconverged set between solver segments; this
+    is THE width policy it shrinks to:
+
+    * next power of two — widths walk the same ladder the backend's
+      chunk padding uses (``TpuBackend._fit_padded``), so shrunk widths
+      re-hit already-compiled programs instead of compiling a program
+      per live-set size;
+    * floored (default 32, the backend's tiny-batch floor) — below it
+      per-dispatch overhead dominates and the inert rows are free;
+    * rounded up to a ``multiple`` — the series-axis shard count when a
+      mesh is in play, so a compacted width still divides evenly across
+      the series shards (``fit_sharded``'s own padding contract).
+    """
+    w = next_pow2(max(int(n_live), 1))
+    return pad_to_multiple(max(w, int(floor)), max(int(multiple), 1))
+
+
 def _resolve_time_axis(mesh: Mesh, config: ShardingConfig):
     """Time axis for a layout: the config's declared name wins; otherwise
     an axis literally named "time" (the convention TpuBackend's default

@@ -1,0 +1,36 @@
+"""Perf telemetry + online tuning (``docs/PERF.md``).
+
+* ``recorder`` — per-dispatch telemetry (wall, width, compile-miss,
+  series/s) attached to ``FitState`` like the resilience report.
+* ``autotune`` — online pow-2 chunk-size hill climber with persisted
+  state, consumed by ``orchestrate.fit_worker`` and the streaming
+  driver's warm start.
+* ``python -m tsspark_tpu.perf`` — summary printer over a BENCH JSON
+  or an orchestrate scratch dir.
+
+Importing this package stays light (stdlib only); JAX loads only when
+``CompileWatch.default()`` resolves the fit kernels.
+"""
+
+from tsspark_tpu.perf.autotune import ChunkAutotuner, load_learned_chunk
+from tsspark_tpu.perf.recorder import (
+    CompileWatch,
+    PerfRecorder,
+    PerfReport,
+    SegmentRecord,
+    attach_perf,
+    get_perf,
+    summarize_times,
+)
+
+__all__ = [
+    "ChunkAutotuner",
+    "CompileWatch",
+    "PerfRecorder",
+    "PerfReport",
+    "SegmentRecord",
+    "attach_perf",
+    "get_perf",
+    "load_learned_chunk",
+    "summarize_times",
+]

@@ -57,18 +57,24 @@ class ResilienceReport:
         return tuple(r.index for r in self.quarantined)
 
 
-def attach_report(state, report: ResilienceReport):
-    """Return ``state`` annotated with ``report``.
+def annotate_state(state, attr: str, value):
+    """Return ``state`` annotated with ``value`` as attribute ``attr``.
 
     The result is a dynamically-derived instance of ``type(state)`` —
     field-for-field the same tuple (NamedTuple subclasses stay valid
-    pytrees and keep ``_replace``/``_fields``), plus a ``.resilience``
-    attribute.  Tree transformations rebuild the base type and drop the
-    attribute; callers who need the report keep the original reference.
+    pytrees and keep ``_replace``/``_fields``), plus the attribute.
+    Tree transformations rebuild the base type and drop the attribute;
+    callers who need it keep the original reference.
+
+    The annotation machinery is shared: the resilience report rides as
+    ``.resilience`` (``attach_report``) and the perf telemetry as
+    ``.perf`` (``tsspark_tpu.perf.attach_perf``) on the SAME generated
+    class, so attaching one never drops the other.
     """
     # Re-annotating an annotated state (add_warning on a fit_resilient
-    # result) must reuse the SAME generated class, never subclass it
-    # again — hence the _resilience_base marker.
+    # result, attach_perf on an annotated state) must reuse the SAME
+    # generated class, never subclass it again — hence the
+    # _resilience_base marker.
     base = getattr(type(state), "_resilience_base", type(state))
     annotated_cls = _annotated_types.get(base)
     if annotated_cls is None:
@@ -84,8 +90,17 @@ def attach_report(state, report: ResilienceReport):
         })
         _annotated_types[base] = annotated_cls
     out = annotated_cls(*state)
-    out.resilience = report
+    # Carry annotations already riding ``state`` forward so attaching a
+    # second kind (perf after resilience, or vice versa) composes.
+    for k, v in vars(state).items() if hasattr(state, "__dict__") else ():
+        setattr(out, k, v)
+    setattr(out, attr, value)
     return out
+
+
+def attach_report(state, report: ResilienceReport):
+    """Return ``state`` annotated with ``report`` (see annotate_state)."""
+    return annotate_state(state, "resilience", report)
 
 
 _annotated_types: dict = {}

@@ -70,12 +70,28 @@ class StreamingForecaster:
         y_col: str = "y",
         store: Optional[ParamStore] = None,
         warm_start: bool = True,
+        autotune_state: Optional[str] = None,
         **backend_kwargs,
     ):
         """``warm_start=False`` disables the parameter-store transfer:
         every refit starts from the ridge init as if the series were new.
         Exists for the warm-vs-cold comparison eval config 5 records —
-        production streaming always wants the default."""
+        production streaming always wants the default.
+
+        ``autotune_state``: path to a persisted chunk-autotuner state
+        (an orchestrate run's ``autotune.json``).  The driver starts its
+        backend at the LEARNED chunk width instead of the static default
+        — the streaming loop refits a different touched-series count
+        every micro-batch, and the learned width is the one measured
+        fastest on this runtime.  An explicit ``chunk_size`` in
+        ``backend_kwargs`` wins; a missing/corrupt state file is
+        ignored (it is pure cache)."""
+        if autotune_state is not None and "chunk_size" not in backend_kwargs:
+            from tsspark_tpu.perf import load_learned_chunk
+
+            learned = load_learned_chunk(autotune_state)
+            if learned:
+                backend_kwargs["chunk_size"] = learned
         self.config = config
         self.backend = get_backend(backend, config, solver_config,
                                    **backend_kwargs)
@@ -167,6 +183,14 @@ class StreamingForecaster:
             if max_batches is not None and n >= max_batches:
                 break
         return self.stats
+
+    def perf_report(self):
+        """The backend's cumulative per-dispatch telemetry
+        (tsspark_tpu.perf.PerfReport), or None when the backend carries
+        no recorder — pass ``perf=PerfRecorder()`` through the backend
+        kwargs to enable it."""
+        rec = getattr(self.backend, "perf", None)
+        return rec.report() if rec is not None else None
 
     # -- forecasting out of the store ------------------------------------------
 
