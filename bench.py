@@ -64,13 +64,11 @@ RESERVE_S = 150.0
 PROBE_BUDGET_FRACTION = 0.3
 
 
-# Bump when a bench/orchestrate change alters fit NUMERICS (solver args,
-# phase policy, data handling).  Orchestration-only changes (probing,
-# retries, logging) must NOT bump it: the whole point of the
-# numerics-scoped fingerprint below is that resume state survives them.
-# rev 7: the online chunk autotuner varies chunk widths mid-run, which
-# changes the chunk the adaptive phase-1 depth observes.
-BENCH_NUMERICS_REV = 7
+# The package-wide fit-numerics revision (bump policy documented at the
+# constant): one shared value keys BOTH this bench's resumable scratch
+# fingerprint and the serve registry's manifest guard, so the two can
+# never drift apart.
+from tsspark_tpu.config import NUMERICS_REV as BENCH_NUMERICS_REV
 
 
 def _code_fingerprint() -> str:

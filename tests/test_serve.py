@@ -1,0 +1,380 @@
+"""Serving subsystem (tsspark_tpu/serve, docs/SERVING.md): registry
+publish/activate/rollback + corrupt-manifest rejection, engine deadline
+shedding and batch-coalescing bitwise determinism, cache invalidation on
+version flips, the loadgen report, and the streaming driver's engine
+routing."""
+
+import json
+import os
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pandas as pd
+import pytest
+
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.resilience import FaultPlan, RetryPolicy, faults
+from tsspark_tpu.serve import (
+    EngineOverloaded,
+    ForecastCache,
+    ForecastRequest,
+    ParamRegistry,
+    PredictionEngine,
+    RegistryError,
+    RequestShed,
+    UnknownSeries,
+)
+from tsspark_tpu.streaming.driver import StreamingForecaster, median_steps
+from tsspark_tpu.streaming.state import ParamStore
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+)
+SOLVER = SolverConfig(max_iters=25)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    """One fitted 6-series batch shared across the module (fits are the
+    slow part; every test only reads)."""
+    rng = np.random.default_rng(0)
+    t = np.arange(150.0)
+    y = (10 + 0.02 * t[None, :] + np.sin(2 * np.pi * t[None, :] / 7)
+         + rng.normal(0, 0.1, (6, 150)))
+    backend = get_backend("tpu", CFG, SOLVER)
+    state = backend.fit(t, jnp.asarray(y))
+    return backend, state, [f"s{i}" for i in range(6)]
+
+
+def _registry(tmp_path, fitted, **kwargs):
+    backend, state, ids = fitted
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG, **kwargs)
+    reg.publish(state, ids, step=np.ones(len(ids)))
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# registry lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_registry_publish_activate_rollback(tmp_path, fitted):
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert reg.active_version() == 1 and reg.versions() == (1,)
+    v2 = reg.publish(state._replace(theta=state.theta * 1.01), ids)
+    assert (v2, reg.active_version()) == (2, 2)
+    snap2 = reg.load()
+    assert snap2.version == 2
+
+    assert reg.rollback() == 1
+    snap1 = reg.load()
+    assert snap1.version == 1
+    np.testing.assert_array_equal(
+        np.asarray(snap1.state.theta) * 1.01, np.asarray(snap2.state.theta)
+    )
+    # Publish without activation leaves the active pointer alone.
+    v3 = reg.publish(state, ids, activate=False)
+    assert v3 == 3 and reg.active_version() == 1
+    reg.activate(v3)
+    assert reg.active_version() == 3
+    with pytest.raises(RegistryError) as e:
+        reg.activate(99)
+    assert e.value.reason == "unknown-version"
+
+
+def test_registry_snapshot_lookup_and_gather(tmp_path, fitted):
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    snap = reg.load()
+    idx, missing = snap.rows(["s3", "s0", "ghost"])
+    assert missing == ["ghost"] and idx.tolist() == [3, 0]
+    sub, step = snap.take(idx)
+    np.testing.assert_array_equal(
+        np.asarray(sub.theta), np.asarray(state.theta)[[3, 0]]
+    )
+    # Meta leaves stay host float64 through the gather (ds precision).
+    assert sub.meta.ds_start.dtype == np.float64
+    assert step.tolist() == [1.0, 1.0]
+
+
+def test_registry_rejects_corrupt_manifest(tmp_path, fitted):
+    reg = _registry(tmp_path, fitted)
+    with open(os.path.join(reg.root, "manifest.json"), "w") as fh:
+        fh.write('{"format": 1, "versi')  # torn write simulation
+    with pytest.raises(RegistryError) as e:
+        ParamRegistry(reg.root, CFG)
+    assert e.value.reason == "corrupt-manifest"
+
+
+def test_registry_rejects_incompatible_snapshots(tmp_path, fitted):
+    reg = _registry(tmp_path, fitted)
+    other = ProphetConfig(seasonalities=(), n_changepoints=3)
+    with pytest.raises(RegistryError) as e:
+        ParamRegistry(reg.root, other)
+    assert e.value.reason == "fingerprint-mismatch"
+    with pytest.raises(RegistryError) as e:
+        ParamRegistry(reg.root, CFG, numerics_rev=999)
+    assert e.value.reason == "numerics-rev-mismatch"
+    # strict=False force-attaches (the operator override).
+    assert ParamRegistry(reg.root, CFG, numerics_rev=999,
+                         strict=False).active_version() == 1
+
+
+def test_registry_open_rebuilds_config(tmp_path, fitted):
+    reg = _registry(tmp_path, fitted)
+    reopened = ParamRegistry.open(reg.root)
+    assert reopened.config == CFG
+    assert reopened.load().version == 1
+
+
+# ---------------------------------------------------------------------------
+# engine: coalescing determinism, shedding, admission, retries
+# ---------------------------------------------------------------------------
+
+
+def test_engine_batched_bitwise_equals_direct_predict(tmp_path, fitted):
+    """THE serving parity pin: two coalesced requests, padded to the
+    pow-2 width/horizon buckets, must reproduce a direct
+    backend.predict for the same series bit for bit."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    eng = PredictionEngine(reg)
+    p1 = eng.submit(ForecastRequest.make(["s1", "s3", "s4"], 7))
+    p2 = eng.submit(ForecastRequest.make(["s5", "s1"], 5))
+    assert eng.pump() == 2  # one batch, one dispatch group (same bucket)
+    r1, r2 = p1.result(5), p2.result(5)
+
+    snap = reg.load()
+    for res, sids, h in ((r1, ["s1", "s3", "s4"], 7),
+                         (r2, ["s5", "s1"], 5)):
+        idx, _ = snap.rows(sids)
+        sub, step = snap.take(idx)
+        last = np.asarray(sub.meta.ds_start + sub.meta.ds_span, np.float64)
+        grid = last[:, None] + step[:, None] * np.arange(1, h + 1)
+        direct = backend.predict(sub, grid, num_samples=0)
+        np.testing.assert_array_equal(res.ds, grid)
+        for k, v in direct.items():
+            np.testing.assert_array_equal(
+                res.values[k], np.asarray(v), err_msg=k
+            )
+    # Both requests rode one dispatch: s1 was gathered once.
+    assert eng.stats.dispatches == 1
+    occ = eng.stats.occupancy[0]
+    assert occ[0] == 4 and occ[2] == 2  # 4 unique series, 2 requests
+
+
+def test_engine_deadline_shedding_structured(tmp_path, fitted):
+    reg = _registry(tmp_path, fitted)
+    eng = PredictionEngine(reg)
+    dead = eng.submit(ForecastRequest.make(["s0"], 7, deadline_in_s=0.0))
+    alive = eng.submit(ForecastRequest.make(["s2"], 7, deadline_in_s=30.0))
+    time.sleep(0.005)
+    assert eng.pump() == 2
+    with pytest.raises(RequestShed) as e:
+        dead.result(5)
+    d = e.value.to_dict()
+    assert d["reason"] == "deadline-exceeded" and d["late_s"] >= 0
+    assert alive.result(5).values["yhat"].shape == (1, 7)
+    assert eng.stats.shed == 1 and eng.stats.completed == 1
+
+
+def test_engine_admission_and_unknown_series(tmp_path, fitted):
+    reg = _registry(tmp_path, fitted)
+    eng = PredictionEngine(reg, max_queue=1)
+    eng.submit(ForecastRequest.make(["s0"], 7))
+    with pytest.raises(EngineOverloaded):
+        eng.submit(ForecastRequest.make(["s1"], 7))
+    assert eng.stats.rejected == 1
+    eng.pump()
+    with pytest.raises(UnknownSeries) as e:
+        eng.forecast(["s0", "ghost"], 7)
+    assert e.value.missing == ("ghost",) and e.value.version == 1
+    # Malformed requests fail alone, with structured errors — never the
+    # batch they were coalesced into.
+    with pytest.raises(ValueError):
+        ForecastRequest.make([], 7)
+    bad = eng.submit(ForecastRequest(series_ids=(), horizon=7))
+    eng.pump()
+    with pytest.raises(ValueError):
+        bad.result(5)
+    ok = eng.submit(ForecastRequest.make(["s0"], 7))
+    eng.pump()
+    assert ok.result(5).values["yhat"].shape == (1, 7)
+
+
+def test_registry_concurrent_publishers_get_distinct_versions(tmp_path,
+                                                              fitted):
+    import threading
+
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    got = []
+    publish = lambda: got.append(reg.publish(state, ids))
+    threads = [threading.Thread(target=publish) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(got) == [2, 3, 4, 5]  # no duplicate version numbers
+    assert reg.versions() == (1, 2, 3, 4, 5)  # no catalog entry lost
+    for v in got:
+        assert reg.load(v).version == v  # every snapshot loads whole
+
+
+def test_engine_retry_policy_covers_transient_faults(tmp_path, fitted,
+                                                     monkeypatch):
+    reg = _registry(tmp_path, fitted)
+    plan = FaultPlan(state_dir=str(tmp_path / "faults")).fail(
+        "serve_predict", attempts=1, mode="raise"
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    eng = PredictionEngine(
+        reg, retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                                      max_delay_s=0.0),
+    )
+    res = eng.forecast(["s0"], 7)  # first dispatch faults, retry lands
+    assert res.values["yhat"].shape == (1, 7)
+
+
+def test_engine_cache_invalidated_on_version_flip(tmp_path, fitted):
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    cache = ForecastCache(capacity=64)
+    eng = PredictionEngine(reg, cache=cache)
+    r1 = eng.forecast(["s0", "s1"], 7)
+    assert eng.forecast(["s0", "s1"], 7).from_cache == 2
+    assert cache.hits == 2 and len(cache) == 2
+
+    reg.publish(state._replace(theta=state.theta * 1.02), ids)
+    assert len(cache) == 0  # activation listener dropped v1 entries
+    r2 = eng.forecast(["s0", "s1"], 7)
+    assert r2.version == 2 and r2.from_cache == 0
+    assert not np.array_equal(r2.values["yhat"], r1.values["yhat"])
+    # Rollback flips back; old values return (recomputed, version-keyed).
+    reg.rollback()
+    r3 = eng.forecast(["s0", "s1"], 7)
+    assert r3.version == 1
+    np.testing.assert_array_equal(r3.values["yhat"], r1.values["yhat"])
+
+
+# ---------------------------------------------------------------------------
+# streaming integration: cadence column + shared read path
+# ---------------------------------------------------------------------------
+
+
+def _series_df(n, sid="s0", seed=0, step=1.0):
+    rng = np.random.default_rng(seed)
+    t = np.arange(n, dtype=float) * step
+    y = (10 + 0.02 * t + 1.5 * np.sin(2 * np.pi * t / 7)
+         + rng.normal(0, 0.1, n))
+    return pd.DataFrame({"series_id": sid, "ds": t, "y": y})
+
+
+def test_median_steps_vectorized():
+    grid = np.array([0.0, 1.0, 2.0, 4.0, 8.0, 9.0])
+    y = np.array([
+        [1.0, 1.0, 1.0, np.nan, np.nan, np.nan],   # daily prefix
+        [1.0, np.nan, np.nan, 1.0, 1.0, np.nan],   # gaps of 4
+        [np.nan, 1.0, np.nan, np.nan, np.nan, np.nan],  # 1 obs -> default
+    ])
+    assert median_steps(grid, y).tolist() == [1.0, 4.0, 1.0]
+
+
+def test_store_records_cadence_and_forecast_continues_it(tmp_path):
+    weekly = _series_df(40, "w", seed=1, step=7.0)
+    daily = _series_df(120, "d", seed=2, step=1.0)
+    sf = StreamingForecaster(CFG, SOLVER, backend="tpu")
+    sf.process(pd.concat([weekly, daily]))
+    np.testing.assert_allclose(sf.store.lookup_step(["w", "d"]), [7.0, 1.0])
+    fc = sf.forecast(["w", "d"], horizon=3, num_samples=0)
+    ds = fc.ds.to_numpy().reshape(2, 3)
+    np.testing.assert_allclose(np.diff(ds[0]), 7.0)  # weekly continues
+    np.testing.assert_allclose(np.diff(ds[1]), 1.0)
+    # The cadence column survives the checkpoint round trip.
+    path = str(tmp_path / "store")
+    sf.store.save(path)
+    loaded = ParamStore.load(path, CFG)
+    np.testing.assert_allclose(loaded.lookup_step(["w", "d"]), [7.0, 1.0])
+
+
+def test_driver_routes_forecast_through_engine(tmp_path):
+    sf = StreamingForecaster(CFG, SOLVER, backend="tpu")
+    sf.process(pd.concat([_series_df(120, "a", 1), _series_df(120, "b", 2)]))
+    direct = sf.forecast(["a", "b"], horizon=9, num_samples=0)
+
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG)
+    assert sf.publish(reg) == 1
+    eng = PredictionEngine(reg)
+    sf.attach_engine(eng)
+    routed = sf.forecast(["a", "b"], horizon=9, num_samples=0)
+    # One read path: the engine-routed frame is the direct frame, bit
+    # for bit (same grid, same values, same layout).
+    pd.testing.assert_frame_equal(routed, direct)
+    assert eng.stats.completed == 1
+    # Unknown series keep the driver's KeyError contract on both paths.
+    with pytest.raises(KeyError):
+        sf.forecast(["nope"], horizon=3)
+    # The engine's source of truth is the PUBLISHED snapshot: a series
+    # refit after publish() is served from the registry version, and a
+    # fresh read-only driver over the same registry can serve series
+    # its own (empty) store has never seen.
+    ro = StreamingForecaster(CFG, SOLVER, backend="tpu", engine=eng)
+    pd.testing.assert_frame_equal(
+        ro.forecast(["a", "b"], horizon=9, num_samples=0), direct
+    )
+    sf.attach_engine(None)
+    pd.testing.assert_frame_equal(
+        sf.forecast(["a", "b"], horizon=9, num_samples=0), direct
+    )
+
+
+def test_orchestrate_publish_fit_state(tmp_path, fitted):
+    from tsspark_tpu import orchestrate
+
+    import jax
+
+    backend, state, ids = fitted
+    out = str(tmp_path / "chunks")
+    os.makedirs(out)
+    s = lambda lo, hi: jax.tree.map(lambda a: np.asarray(a)[lo:hi], state)
+    orchestrate.save_chunk_atomic(out, 0, 4, s(0, 4))
+    orchestrate.save_chunk_atomic(out, 4, 6, s(4, 6))
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG)
+    assert orchestrate.publish_fit_state(reg, out, ids) == 1
+    snap = reg.load()
+    assert snap.series_ids == tuple(ids)
+    np.testing.assert_allclose(
+        np.asarray(snap.state.theta), np.asarray(state.theta), rtol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# loadgen
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_emits_report(tmp_path, capsys):
+    from tsspark_tpu.serve.__main__ import main
+
+    report = str(tmp_path / "SERVE_test.json")
+    rc = main([
+        "--loadgen", "200", "--series", "12", "--seed", "1",
+        "--dir", str(tmp_path), "--report", report,
+    ])
+    assert rc == 0
+    with open(report) as fh:
+        r = json.load(fh)
+    assert r["n_requests"] == 200
+    lat = r["engine"]["latency_ms"]
+    assert all(lat[q] is not None for q in ("p50", "p95", "p99"))
+    assert lat["p50"] <= lat["p95"] <= lat["p99"]
+    occ = r["engine"]["batch_occupancy"]
+    assert occ["mean_fill"] is not None and 0 < occ["mean_fill"] <= 1
+    assert 0 <= r["cache"]["hit_rate"] <= 1
+    assert r["engine"]["completed"] + r["engine"]["shed"] \
+        + r["engine"]["failed"] + r["engine"]["rejected"] == 200
+    assert r["dispatch"]["n_dispatches"] == r["engine"]["dispatches"]
+    assert "loadgen" in capsys.readouterr().out

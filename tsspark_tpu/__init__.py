@@ -52,6 +52,7 @@ from tsspark_tpu.resilience import (
     RetryPolicy,
     get_report,
 )
+from tsspark_tpu.serve import ParamRegistry, PredictionEngine
 
 __version__ = "0.4.0"
 
@@ -76,6 +77,8 @@ __all__ = [
     "WEEKLY",
     "YEARLY",
     "FaultPlan",
+    "ParamRegistry",
+    "PredictionEngine",
     "ResilienceReport",
     "ResilienceWarning",
     "RetryPolicy",

@@ -400,6 +400,45 @@ def _c_compact_gather(case: ShapeCase, out) -> List[str]:
     return errs
 
 
+def _k_forecast(case: ShapeCase):
+    """The batched predict entry point the serving engine dispatches
+    through (predict.forecast_jit): traced with sampling ON so the
+    trend-path simulation and quantile reduction are inside the checked
+    program — the path where an un-pinned random-draw dtype doubles
+    every sample tensor under x64 drift."""
+    import jax
+
+    from tsspark_tpu.models.prophet.design import ScalingMeta
+    from tsspark_tpu.models.prophet.predict import forecast
+
+    cfg, _ = _configs(case)
+    theta = _sds((case.b, cfg.num_params))
+    meta = ScalingMeta(
+        y_scale=_sds((case.b,)), floor=_sds((case.b,)),
+        ds_start=_sds((case.b,)), ds_span=_sds((case.b,)),
+        reg_mean=_sds((case.b, case.r)), reg_std=_sds((case.b, case.r)),
+        changepoints=_sds((case.b, case.n_cp)),
+    )
+    key = _sds((2,), "uint32")
+    return jax.eval_shape(
+        lambda th, d, m, k: forecast(th, d, m, cfg, key=k,
+                                     num_samples=4),
+        theta, _fit_data(case, cfg), meta, key,
+    )
+
+
+def _c_forecast(case: ShapeCase, out) -> List[str]:
+    errs = []
+    for name in ("yhat", "trend", "additive", "multiplicative",
+                 "yhat_lower", "yhat_upper", "trend_lower",
+                 "trend_upper"):
+        if name not in out:
+            errs.append(f"forecast output lacks {name!r}")
+            continue
+        errs += _expect(out[name], (case.b, case.t), "float32", name)
+    return errs
+
+
 def _mesh_for(case: ShapeCase):
     import jax
 
@@ -478,6 +517,8 @@ def default_kernels() -> Tuple[KernelContract, ...]:
         KernelContract("model.mcmc_core", _k_mcmc, _c_mcmc),
         KernelContract("compact.take_state+take_fit_data",
                        _k_compact_gather, _c_compact_gather),
+        KernelContract("predict.forecast (serve batched entry)",
+                       _k_forecast, _c_forecast),
         KernelContract("sharding.fit_sharded", _k_sharded, _c_sharded,
                        wants_mesh=True),
         KernelContract("sharding.fit_sharded_packed", _k_sharded_packed,

@@ -126,8 +126,32 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "globs (atomic by construction; kept for forensics)",
     ),
     # Specific marker specs must precede "checkpoint": its generic
-    # ".json" marker would otherwise swallow "times.jsonl" (first
-    # marker match wins).
+    # ".json" marker would otherwise swallow "times.jsonl",
+    # "manifest.json" and "SERVE_*.json" (first marker match wins).
+    ArtifactSpec(
+        "registry-manifest", ("manifest.json",),
+        ("ParamRegistry._write_manifest",),
+        "versioned serve-registry index (serve/registry.py), replaced "
+        "atomically AFTER the snapshot files it references have landed; "
+        "readers (ParamRegistry.load, a concurrent serving daemon) see "
+        "the old or the new version set, never a torn index or a "
+        "dangling reference",
+    ),
+    ArtifactSpec(
+        "registry-lock", (".manifest.lock",),
+        ("ParamRegistry._locked",),
+        "advisory flock target serializing registry manifest "
+        "read-modify-writes (publish/activate); opened append, never "
+        "written or read — the lock lives on the file description",
+        append_ok=True,
+    ),
+    ArtifactSpec(
+        "serve-report", ("SERVE_",),
+        ("_loadgen",),
+        "serve loadgen latency report, written once at end of run "
+        "(the serving analog of a BENCH summary); atomic so a watcher "
+        "tailing for the artifact never parses a partial JSON",
+    ),
     ArtifactSpec(
         "timing-log", ("times.jsonl",),
         ("fit_worker", "fit_worker.save_and_log"),
@@ -177,6 +201,10 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/resilience/faults.py",
     "tsspark_tpu/perf/autotune.py",
     "tsspark_tpu/perf/recorder.py",
+    "tsspark_tpu/serve/registry.py",
+    "tsspark_tpu/serve/engine.py",
+    "tsspark_tpu/serve/cache.py",
+    "tsspark_tpu/serve/__main__.py",
 )
 
 _WRITE_FNS = {"save", "savez", "savez_compressed", "dump"}

@@ -19,6 +19,18 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+#: THE fit-numerics revision, shared by every consumer that must refuse
+#: to mix parameters fitted under different numerics regimes: bench.py's
+#: resumable scratch fingerprint and the serve registry's manifest guard
+#: (serve/registry.py) both read this one constant, so the two can never
+#: drift apart.  Bump when a model/solver/backend change alters fit
+#: NUMERICS (solver args, phase policy, data handling); orchestration-
+#: only changes (probing, retries, logging) must NOT bump it — resume
+#: state and published registries survive them by design.
+#: rev 7: the online chunk autotuner varies chunk widths mid-run, which
+#: changes the chunk the adaptive phase-1 depth observes.
+NUMERICS_REV = 7
+
 
 @dataclasses.dataclass(frozen=True)
 class SeasonalityConfig:

@@ -160,11 +160,17 @@ def _simulate_trends(
         lam = jnp.zeros((b,), data.t.dtype)
     lam = jnp.maximum(lam, 1e-8)
 
+    # Draw dtypes pinned to the design dtype: random.uniform/laplace
+    # default to the x64-mode float, so an un-pinned draw silently
+    # promotes every sample path to f64 under enable_x64 (the same
+    # drift class the contract checker caught in ops/hmc.py).
     k_bern, k_lap = jax.random.split(key)
     ind = (
-        jax.random.uniform(k_bern, (num_samples, b, t_len)) < cp_prob[None, :, None]
+        jax.random.uniform(k_bern, (num_samples, b, t_len),
+                           dtype=data.t.dtype) < cp_prob[None, :, None]
     ).astype(data.t.dtype) * future[None]
-    lap = jax.random.laplace(k_lap, (num_samples, b, t_len)) * lam[None, :, None]
+    lap = jax.random.laplace(k_lap, (num_samples, b, t_len),
+                             dtype=data.t.dtype) * lam[None, :, None]
     new_delta = ind * lap  # (S, B, T)
 
     if det is None:
@@ -299,14 +305,18 @@ def forecast(
         k_tr, k_noise = jax.random.split(key)
         trends = _simulate_trends(k_tr, theta, data, config, n_s)  # (S, B, T)
         sigma = jnp.exp(p.log_sigma)[None, :, None]
-        noise = jax.random.normal(k_noise, trends.shape) * sigma
+        noise = jax.random.normal(k_noise, trends.shape,
+                                  dtype=trends.dtype) * sigma
         samples = trends * (1.0 + mult[None]) + add[None] + noise
         lo_q = (1.0 - config.interval_width) / 2.0
         hi_q = 1.0 - lo_q
-        qs = jnp.quantile(samples, jnp.asarray([lo_q, hi_q]), axis=0)
+        # Quantile points carry the sample dtype: a bare float list is
+        # f64 under x64 and would promote the interval outputs.
+        q = jnp.asarray([lo_q, hi_q], samples.dtype)
+        qs = jnp.quantile(samples, q, axis=0)
         out["yhat_lower"] = qs[0] * scale + floor
         out["yhat_upper"] = qs[1] * scale + floor
-        t_qs = jnp.quantile(trends, jnp.asarray([lo_q, hi_q]), axis=0)
+        t_qs = jnp.quantile(trends, q, axis=0)
         out["trend_lower"] = t_qs[0] * scale + floor
         out["trend_upper"] = t_qs[1] * scale + floor
         if return_samples:

@@ -267,6 +267,27 @@ def load_fit_state(out_dir: str, n_series: int):
     )
 
 
+def publish_fit_state(registry, out_dir: str, series_ids,
+                      step=None, activate: bool = True) -> int:
+    """Assemble a completed run's chunk coverage and publish it as one
+    serve-registry version (tsspark_tpu.serve.registry.ParamRegistry).
+
+    ``series_ids`` are the run's ids in batch-row order (chunk files
+    carry ranges, not ids — the caller that planned the run owns the
+    mapping).  ``step`` is the per-series cadence in days, same order;
+    omitting it publishes the DAILY default, and the serving engine
+    will then step every future grid by 1.0 — pass the real cadence for
+    any sub-daily/weekly workload.  Integrity/coverage gates are
+    ``load_fit_state``'s: a torn or incomplete run raises instead of
+    publishing a partial version.  Returns the published version.
+    """
+    import numpy as np
+
+    ids = np.asarray([str(s) for s in series_ids])
+    state = load_fit_state(out_dir, len(ids))
+    return registry.publish(state, ids, step=step, activate=activate)
+
+
 def save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
     """Persist one chunk's packed device payload (host numpy) so a CPU
     prep worker can build it while the accelerator is wedged and the fit

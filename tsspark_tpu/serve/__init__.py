@@ -1,0 +1,65 @@
+"""Forecast serving subsystem (``docs/SERVING.md``).
+
+The read path as a first-class subsystem — the fit side's mirror image:
+
+  registry.py — versioned, atomic parameter registry over
+                ``utils.checkpoint`` + ``utils.atomic``: publish /
+                activate / rollback of fitted ``FitState`` snapshots,
+                manifest validated at load (format, config fingerprint,
+                NUMERICS_REV), per-series row lookup.
+  engine.py   — micro-batched prediction engine: bounded-queue
+                admission, request coalescing into pow-2 shape buckets
+                (the fit path's ``compacted_width`` ladder, so the jit
+                cache stays small), deadline shedding with structured
+                errors, ``RetryPolicy``-wrapped dispatch.
+  cache.py    — version-keyed per-series forecast LRU, invalidated on
+                registry activation, with hit/miss counters.
+  __main__.py — ``python -m tsspark_tpu.serve``: a stdin/stdout JSONL
+                daemon, plus ``--loadgen`` which replays a synthetic
+                request mix and emits a ``SERVE_*.json`` latency report
+                (p50/p95/p99, batch occupancy, cache hit rate).
+
+Producers publish: ``orchestrate.publish_fit_state`` (chunked fleet
+runs) and ``streaming.ParamStore.publish`` / ``StreamingForecaster.
+publish`` (the micro-batch refit loop).  ``StreamingForecaster`` with
+an attached engine routes its ``forecast`` through this subsystem, so
+streaming and serving share one batched read path.
+"""
+
+from tsspark_tpu.serve.cache import ForecastCache
+from tsspark_tpu.serve.engine import (
+    EngineOverloaded,
+    EngineStats,
+    ForecastRequest,
+    ForecastResult,
+    PendingForecast,
+    PredictionEngine,
+    RequestShed,
+    ServeError,
+    UnknownSeries,
+)
+from tsspark_tpu.serve.registry import (
+    NUMERICS_REV,
+    ParamRegistry,
+    RegistryError,
+    Snapshot,
+    take_fitstate,
+)
+
+__all__ = [
+    "EngineOverloaded",
+    "EngineStats",
+    "ForecastCache",
+    "ForecastRequest",
+    "ForecastResult",
+    "NUMERICS_REV",
+    "ParamRegistry",
+    "PendingForecast",
+    "PredictionEngine",
+    "RegistryError",
+    "RequestShed",
+    "ServeError",
+    "Snapshot",
+    "UnknownSeries",
+    "take_fitstate",
+]

@@ -1,0 +1,302 @@
+"""``python -m tsspark_tpu.serve`` — serve forecasts, or load-test the
+serving stack.
+
+Daemon mode (default): attach to a registry and answer stdin JSONL::
+
+    {"series_ids": ["a", "b"], "horizon": 14, "num_samples": 0,
+     "deadline_ms": 250, "id": "req-1"}
+
+one response line per request (``ok``/``error`` + (B, H) arrays), plus
+``{"cmd": "stats"}`` / ``{"cmd": "activate", "version": N}`` /
+``{"cmd": "rollback"}`` control lines.
+
+Loadgen mode (``--loadgen N``): build a synthetic registry (or reuse
+``--registry``), replay a deterministic Zipf-ish request mix of N
+requests through the engine, and emit a ``SERVE_<unix>.json`` report —
+p50/p95/p99 latency, batch occupancy, cache hit rate, per-dispatch
+telemetry via ``perf.PerfRecorder`` — the serving analog of
+``BENCH_*.json``.
+
+Like the analysis gate, the entry point pins JAX to CPU unless told
+otherwise: a serving smoke run must never block on a wedged TPU tunnel.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _build_demo_registry(root: str, n_series: int, seed: int):
+    """Fit a small synthetic batch and publish it as version 1."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tsspark_tpu.backends.registry import get_backend
+    from tsspark_tpu.config import (
+        ProphetConfig, SeasonalityConfig, SolverConfig,
+    )
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    config = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=3,
+    )
+    rng = np.random.default_rng(seed)
+    t = np.arange(180.0)
+    level = rng.uniform(5.0, 50.0, (n_series, 1))
+    slope = rng.uniform(-0.02, 0.05, (n_series, 1))
+    amp = rng.uniform(0.5, 3.0, (n_series, 1))
+    y = (level + slope * t[None, :]
+         + amp * np.sin(2 * np.pi * t[None, :] / 7.0)
+         + rng.normal(0, 0.2, (n_series, len(t))))
+    backend = get_backend("tpu", config, SolverConfig(max_iters=25))
+    state = backend.fit(t, jnp.asarray(y))
+    ids = np.asarray([f"s{i:04d}" for i in range(n_series)])
+    registry = ParamRegistry(root, config)
+    registry.publish(state, ids, step=np.ones(n_series))
+    return registry
+
+
+def _zipf_weights(n: int):
+    import numpy as np
+
+    w = 1.0 / (1.0 + np.arange(n))
+    return w / w.sum()
+
+
+def _loadgen(args) -> int:
+    import numpy as np
+
+    from tsspark_tpu.models.prophet import predict as predict_mod
+    from tsspark_tpu.perf import CompileWatch, PerfRecorder
+    from tsspark_tpu.resilience import RetryPolicy
+    from tsspark_tpu.serve.cache import ForecastCache
+    from tsspark_tpu.serve.engine import (
+        EngineOverloaded, ForecastRequest, PredictionEngine,
+    )
+    from tsspark_tpu.serve.registry import ParamRegistry
+    from tsspark_tpu.utils.atomic import atomic_write
+
+    t_start = time.perf_counter()
+    if args.registry and os.path.exists(
+        os.path.join(args.registry, "manifest.json")
+    ):
+        registry = ParamRegistry.open(args.registry)
+    else:
+        root = args.registry or os.path.join(
+            args.dir or ".", "serve_scratch", "registry"
+        )
+        registry = _build_demo_registry(root, args.series, args.seed)
+    recorder = PerfRecorder(
+        watch=CompileWatch((predict_mod.forecast_jit,))
+    )
+    engine = PredictionEngine(
+        registry,
+        max_queue=args.max_queue,
+        max_batch=args.max_batch,
+        cache=ForecastCache(capacity=args.cache_capacity),
+        recorder=recorder,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                 backoff=2.0, max_delay_s=0.1),
+    )
+    snap = engine.refresh()
+    n_series = len(snap.series_ids)
+
+    rng = np.random.default_rng(args.seed)
+    weights = _zipf_weights(n_series)
+    horizons = (7, 14, 28)
+    n = args.loadgen
+    pending = []
+    wave = max(1, args.max_batch // 2)
+    submitted = 0
+    t0 = time.perf_counter()
+    while submitted < n:
+        k = min(wave, n - submitted)
+        for _ in range(k):
+            k_sids = rng.integers(1, min(9, n_series + 1))
+            sids = rng.choice(n_series, size=k_sids, replace=False,
+                              p=weights)
+            sampled = rng.random() < 0.1
+            req = ForecastRequest.make(
+                [snap.series_ids[i] for i in sids],
+                horizon=int(rng.choice(horizons)),
+                num_samples=20 if sampled else 0,
+                seed=args.seed,
+                # ~2% arrive already hopeless: exercise the shedding
+                # path under load, not just in unit tests.
+                deadline_in_s=(0.0 if rng.random() < 0.02 else 30.0),
+            )
+            try:
+                pending.append(engine.submit(req))
+            except EngineOverloaded:
+                pass  # counted in engine.stats.rejected
+            submitted += 1
+        while engine.pump() > 0:
+            pass
+    wall_s = time.perf_counter() - t0
+
+    stats = engine.stats.snapshot()
+    report = {
+        "kind": "serve-loadgen",
+        "unix": round(time.time(), 3),
+        "n_requests": n,
+        "n_series": n_series,
+        "mix": {
+            "horizons": list(horizons),
+            "sampled_fraction": 0.1,
+            "hopeless_deadline_fraction": 0.02,
+            "series_per_request": [1, 8],
+            "zipf": True,
+            "seed": args.seed,
+        },
+        "wall_s": round(wall_s, 3),
+        "setup_s": round(t0 - t_start, 3),
+        "requests_per_s": round(n / wall_s, 1) if wall_s > 0 else None,
+        "engine": stats,
+        "cache": engine.cache.stats(),
+        "dispatch": recorder.report().to_dict(),
+        "active_version": registry.active_version(),
+    }
+    out = args.report or f"SERVE_{int(time.time())}.json"
+    atomic_write(out, lambda fh: json.dump(report, fh, indent=1),
+                 mode="w")
+    lat = stats["latency_ms"]
+    print(
+        f"serve loadgen: {n} requests in {wall_s:.2f}s "
+        f"({report['requests_per_s']}/s) | latency p50={lat['p50']} "
+        f"p95={lat['p95']} p99={lat['p99']} ms | cache hit rate "
+        f"{report['cache']['hit_rate']} | shed {stats['shed']} | "
+        f"report -> {out}"
+    )
+    return 0
+
+
+def _daemon(args) -> int:
+    from tsspark_tpu.serve.engine import PredictionEngine
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    registry = ParamRegistry.open(args.registry)
+    engine = PredictionEngine(
+        registry, max_queue=args.max_queue, max_batch=args.max_batch,
+    )
+
+    def emit(obj):
+        sys.stdout.write(json.dumps(obj) + "\n")
+        sys.stdout.flush()
+
+    try:
+        return _serve_lines(registry, engine, emit)
+    except BrokenPipeError:
+        return 0  # client went away; nothing left to answer
+
+
+def _serve_lines(registry, engine, emit) -> int:
+    import numpy as np
+
+    from tsspark_tpu.serve.engine import ServeError
+    from tsspark_tpu.serve.registry import RegistryError
+
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+        except ValueError as e:
+            emit({"ok": False,
+                  "error": {"type": "BadRequest", "detail": str(e)}})
+            continue
+        rid = msg.get("id")
+        try:
+            cmd = msg.get("cmd")
+            if cmd == "stats":
+                emit({"ok": True, "id": rid,
+                      "stats": engine.stats.snapshot(),
+                      "cache": engine.cache.stats(),
+                      "active_version": registry.active_version()})
+                continue
+            if cmd == "activate":
+                registry.activate(int(msg["version"]))
+                emit({"ok": True, "id": rid,
+                      "active_version": registry.active_version()})
+                continue
+            if cmd == "rollback":
+                v = registry.rollback()
+                emit({"ok": True, "id": rid, "active_version": v})
+                continue
+            deadline_ms = msg.get("deadline_ms")
+            res = engine.forecast(
+                msg["series_ids"], int(msg["horizon"]),
+                num_samples=int(msg.get("num_samples", 0)),
+                seed=int(msg.get("seed", 0)),
+                deadline_in_s=(None if deadline_ms is None
+                               else float(deadline_ms) / 1e3),
+            )
+            emit({
+                "ok": True, "id": rid, "version": res.version,
+                "series_ids": list(res.series_ids),
+                "latency_ms": round(res.latency_s * 1e3, 3),
+                "ds": np.asarray(res.ds).tolist(),
+                **{k: np.asarray(v).tolist()
+                   for k, v in res.values.items()},
+            })
+        except (ServeError, RegistryError) as e:
+            err = (e.to_dict() if isinstance(e, ServeError)
+                   else {"type": "RegistryError", "reason": e.reason,
+                         "detail": str(e)})
+            emit({"ok": False, "id": rid, "error": err})
+        except (KeyError, TypeError, ValueError) as e:
+            emit({"ok": False, "id": rid,
+                  "error": {"type": "BadRequest", "detail": str(e)}})
+    return 0
+
+
+def main(argv=None) -> int:
+    # Pin the backend at the CONFIG level, not just the env var:
+    # ``python -m tsspark_tpu.serve`` imports the package (and thus jax)
+    # before this line runs, so JAX_PLATFORMS is already captured — the
+    # config update is what actually keeps a smoke/CI run off a
+    # (possibly wedged) accelerator tunnel.  Same defense as
+    # ``python -m tsspark_tpu.analysis`` and tests/conftest.py.
+    if os.environ.get("TSSPARK_SERVE_DEVICE", "cpu") == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tsspark_tpu.serve",
+        description="forecast serving daemon / load generator "
+                    "(docs/SERVING.md)",
+    )
+    ap.add_argument("--registry", default=None,
+                    help="registry root (daemon: required; loadgen: "
+                    "reused when it exists, else built synthetic)")
+    ap.add_argument("--loadgen", type=int, default=None, metavar="N",
+                    help="replay a synthetic mix of N requests and "
+                    "emit a SERVE_*.json report")
+    ap.add_argument("--dir", default=None,
+                    help="loadgen scratch root (default: cwd)")
+    ap.add_argument("--report", default=None,
+                    help="loadgen report path (default: SERVE_<unix>.json)")
+    ap.add_argument("--series", type=int, default=48,
+                    help="loadgen synthetic series count")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-queue", type=int, default=4096)
+    ap.add_argument("--max-batch", type=int, default=128)
+    ap.add_argument("--cache-capacity", type=int, default=8192)
+    args = ap.parse_args(argv)
+
+    if args.loadgen is not None:
+        return _loadgen(args)
+    if not args.registry:
+        ap.error("daemon mode needs --registry (or pass --loadgen N)")
+    return _daemon(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Version-keyed forecast LRU.
+
+Keys carry the registry version, so a stale entry can never satisfy a
+request against a newer activation even without explicit invalidation;
+the explicit ``invalidate`` (wired to ``ParamRegistry.subscribe``)
+exists to free the memory and to make the flip observable in the
+hit/miss counters.
+
+Entries are PER SERIES, not per request: a request for (a, b, c) that
+follows one for (b, c, d) re-dispatches only ``a`` — series-level reuse
+is where a heavy-traffic mix actually overlaps.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Hashable, Optional
+
+
+class ForecastCache:
+    """Thread-safe LRU of per-series forecast rows.
+
+    Key: ``(version, series_id, horizon_bucket, num_samples, seed)``.
+    Value: dict of ``(H,)`` arrays (plus the ds row) — whatever the
+    engine scatters per series.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self.capacity = int(capacity)
+        self._data: "collections.OrderedDict[Hashable, Dict]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def get(self, key: Hashable) -> Optional[Dict]:
+        with self._lock:
+            val = self._data.get(key)
+            if val is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return val
+
+    def put(self, key: Hashable, value: Dict) -> None:
+        if self.capacity <= 0:
+            return
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+
+    def invalidate(self, version: Optional[int] = None) -> int:
+        """Drop entries for versions OTHER than ``version`` (``None``
+        drops everything).  Returns the count dropped.  Called on
+        registry activation: entries for the newly active version are
+        the only ones a future request can still hit."""
+        with self._lock:
+            if version is None:
+                dropped = len(self._data)
+                self._data.clear()
+            else:
+                stale = [k for k in self._data if k[0] != version]
+                for k in stale:
+                    del self._data[k]
+                dropped = len(stale)
+            self.invalidations += dropped
+            return dropped
+
+    def stats(self) -> Dict:
+        total = self.hits + self.misses
+        return {
+            "size": len(self._data),
+            "capacity": self.capacity,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "invalidations": self.invalidations,
+        }

@@ -1,0 +1,369 @@
+"""Versioned, atomic parameter registry: the serving side's source of truth.
+
+A fit (orchestrate run, streaming driver, plain backend fit) PUBLISHES a
+``FitState`` snapshot; the serving engine READS whichever version is
+ACTIVE.  The two sides never share mutable state — activation is one
+atomic manifest rename, so a prediction daemon mid-request sees the old
+version or the new one, never a mix, and a bad deploy rolls back with
+one more rename.
+
+Layout under the registry root::
+
+    manifest.json            # atomic index: active/previous + catalog
+    v000001/state.npz|.json  # one utils.checkpoint snapshot per version
+    v000002/...
+
+Write protocol (crash-safe by ordering): the snapshot files land first
+(each itself atomic via utils.checkpoint -> utils.atomic), the manifest
+referencing them is replaced last.  A manifest can therefore never name
+files that do not fully exist.
+
+Versions are monotonically increasing integers; the manifest also pins
+the config fingerprint (utils.checkpoint.config_fingerprint) and the
+serve ``NUMERICS_REV``, so a reader refuses snapshots fitted under an
+incompatible parameter layout or numerics regime instead of silently
+serving garbage.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fcntl
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from tsspark_tpu.config import NUMERICS_REV, ProphetConfig
+from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.utils import checkpoint as ckpt
+from tsspark_tpu.utils.atomic import atomic_write
+
+_MANIFEST = "manifest.json"
+_FORMAT = 1
+
+
+class RegistryError(RuntimeError):
+    """Structured registry failure: corrupt manifest, incompatible
+    snapshot, unknown version.  ``reason`` is a stable machine-readable
+    tag; the message carries the human detail."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+
+
+def take_fitstate(state: FitState, idx: np.ndarray) -> FitState:
+    """Row-gather of a FitState — the read-path analog of the compaction
+    gathers (``ops.lbfgs.take_state`` / ``design.take_fit_data``): every
+    per-series leaf is taken on axis 0, host float64 meta leaves stay
+    host float64 (a jnp gather would silently quantize ``ds_start``).
+    """
+    idx = np.asarray(idx, np.int64)
+
+    def take(a):
+        if isinstance(a, np.ndarray):
+            return np.take(a, idx, axis=0)
+        return jnp.take(jnp.asarray(a), jnp.asarray(idx), axis=0)
+
+    return jax.tree.map(take, state)
+
+
+@dataclasses.dataclass(frozen=True)
+class Snapshot:
+    """One loaded registry version: the batch FitState plus the id->row
+    map and per-series cadence the read path needs."""
+
+    version: int
+    state: FitState
+    series_ids: Tuple[str, ...]
+    step: np.ndarray                      # (B,) median cadence, days
+    row_of: Dict[str, int]
+
+    @classmethod
+    def build(cls, version: int, state: FitState, series_ids,
+              step: Optional[np.ndarray]) -> "Snapshot":
+        ids = tuple(str(s) for s in series_ids)
+        n = len(ids)
+        if step is None:
+            step = np.ones(n)
+        step = np.where(np.asarray(step, np.float64) > 0, step, 1.0)
+        return cls(version=version, state=state, series_ids=ids,
+                   step=step, row_of={s: i for i, s in enumerate(ids)})
+
+    def rows(self, series_ids) -> Tuple[np.ndarray, List[str]]:
+        """Row indices for ``series_ids`` + the ids this version lacks."""
+        idx, missing = [], []
+        for s in series_ids:
+            i = self.row_of.get(str(s))
+            (missing.append(str(s)) if i is None else idx.append(i))
+        return np.asarray(idx, np.int64), missing
+
+    def take(self, idx: np.ndarray) -> Tuple[FitState, np.ndarray]:
+        """(gathered FitState, gathered cadence) for row indices."""
+        return take_fitstate(self.state, idx), np.take(self.step, idx)
+
+
+class ParamRegistry:
+    """Publish / activate / rollback fitted-parameter versions."""
+
+    def __init__(self, root: str, config: ProphetConfig,
+                 numerics_rev: int = NUMERICS_REV, strict: bool = True):
+        self.root = root
+        self.config = config
+        self.numerics_rev = int(numerics_rev)
+        self.strict = strict
+        self._listeners: List[Callable[[Optional[int]], None]] = []
+        os.makedirs(root, exist_ok=True)
+        self._read_manifest()  # validate eagerly: fail at attach time
+
+    @classmethod
+    def open(cls, root: str, **kwargs) -> "ParamRegistry":
+        """Attach to an existing registry, rebuilding the model config
+        from the manifest — a serving daemon needs no side-channel
+        config file."""
+        path = os.path.join(root, _MANIFEST)
+        try:
+            with open(path) as fh:
+                m = json.load(fh)
+        except OSError:
+            raise RegistryError("missing-manifest",
+                                f"no registry at {root!r}")
+        except ValueError as e:
+            raise RegistryError("corrupt-manifest", f"{path}: {e}")
+        if not isinstance(m, dict) or "config" not in m:
+            raise RegistryError(
+                "corrupt-manifest", f"{path}: no embedded model config"
+            )
+        config = ckpt._config_from_dict(m["config"])
+        return cls(root, config, **kwargs)
+
+    # -- manifest I/O ----------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, _MANIFEST)
+
+    def _fresh_manifest(self) -> Dict:
+        return {
+            "format": _FORMAT,
+            "fingerprint": ckpt.config_fingerprint(self.config),
+            "numerics_rev": self.numerics_rev,
+            "config": dataclasses.asdict(self.config),
+            "active_version": None,
+            "previous_version": None,
+            "versions": {},
+        }
+
+    def _read_manifest(self) -> Dict:
+        path = self._manifest_path()
+        if not os.path.exists(path):
+            return self._fresh_manifest()
+        try:
+            with open(path) as fh:
+                m = json.load(fh)
+        except ValueError as e:
+            raise RegistryError("corrupt-manifest", f"{path}: {e}")
+        if not isinstance(m, dict) or m.get("format") != _FORMAT:
+            raise RegistryError(
+                "corrupt-manifest",
+                f"{path}: format {m.get('format') if isinstance(m, dict) else '?'}"
+                f" != {_FORMAT}",
+            )
+        if self.strict:
+            fp = ckpt.config_fingerprint(self.config)
+            if m.get("fingerprint") != fp:
+                raise RegistryError(
+                    "fingerprint-mismatch",
+                    f"registry was published under config fingerprint "
+                    f"{m.get('fingerprint')}, reader has {fp}; pass "
+                    "strict=False to force-attach",
+                )
+            if m.get("numerics_rev") != self.numerics_rev:
+                raise RegistryError(
+                    "numerics-rev-mismatch",
+                    f"registry numerics_rev {m.get('numerics_rev')} != "
+                    f"reader {self.numerics_rev}: parameters fitted under "
+                    "a different numerics regime must be republished",
+                )
+        active = m.get("active_version")
+        if active is not None and str(active) not in m.get("versions", {}):
+            raise RegistryError(
+                "corrupt-manifest",
+                f"active_version {active} is not in the version catalog",
+            )
+        return m
+
+    def _write_manifest(self, m: Dict) -> None:
+        atomic_write(
+            self._manifest_path(),
+            lambda fh: json.dump(m, fh, indent=1),
+            mode="w",
+        )
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """Advisory exclusive lock serializing manifest
+        read-modify-writes: two concurrent publishers must not allocate
+        the same version number or drop each other's catalog entry.
+        The lock file itself is never read (flock works on the open
+        file description, not the contents); readers stay lock-free —
+        the atomic manifest replace already gives them old-or-new."""
+        fh = open(os.path.join(self.root, ".manifest.lock"), "a")
+        try:
+            fcntl.flock(fh, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fh, fcntl.LOCK_UN)
+            fh.close()
+
+    # -- queries ---------------------------------------------------------------
+
+    def manifest_key(self) -> Optional[Tuple[int, int, int]]:
+        """Cheap change detector for the manifest ((ino, mtime_ns,
+        size), or None when no manifest exists yet): every manifest
+        replace is an ``os.replace`` of a freshly created temp file, so
+        the inode changes even when two flips land inside one
+        filesystem-timestamp granule at identical size (activate ->
+        rollback swapping two same-width integers).  Hot read paths
+        stat this instead of re-parsing the JSON per batch."""
+        try:
+            st = os.stat(self._manifest_path())
+        except OSError:
+            return None
+        return (st.st_ino, st.st_mtime_ns, st.st_size)
+
+    def versions(self) -> Tuple[int, ...]:
+        return tuple(sorted(
+            int(v) for v in self._read_manifest()["versions"]
+        ))
+
+    def active_version(self) -> Optional[int]:
+        return self._read_manifest()["active_version"]
+
+    # -- writes ----------------------------------------------------------------
+
+    def publish(self, state: FitState, series_ids,
+                step: Optional[np.ndarray] = None,
+                activate: bool = True) -> int:
+        """Persist one snapshot as the next version (snapshot files
+        first, manifest last); optionally activate it.  Returns the new
+        version number.  Concurrent publishers serialize on the
+        manifest lock (``_locked``)."""
+        ids = np.asarray([str(s) for s in series_ids])
+        if len(ids) != int(np.asarray(state.theta).shape[0]):
+            raise ValueError(
+                f"{len(ids)} series ids for "
+                f"{np.asarray(state.theta).shape[0]} state rows"
+            )
+        extras = {}
+        if step is not None:
+            extras["step"] = np.asarray(step, np.float64)
+        # Lock only the version ALLOCATION and the manifest update, not
+        # the (potentially tens-of-MB) snapshot serialization between
+        # them — an activate/rollback must never stall behind a bulk
+        # publish.  The claimed directory makes allocation crash-safe:
+        # a publisher that dies mid-write leaves an orphan dir the
+        # existence check skips, never a reused version number.
+        with self._locked():
+            m = self._read_manifest()
+            version = max((int(v) for v in m["versions"]), default=0) + 1
+            while os.path.exists(os.path.join(self.root,
+                                              f"v{version:06d}")):
+                version += 1
+            vdir = f"v{version:06d}"
+            os.makedirs(os.path.join(self.root, vdir))
+        ckpt.save_state(
+            os.path.join(self.root, vdir, "state"), state,
+            self.config, series_ids=ids, extras=extras,
+        )
+        with self._locked():
+            m = self._read_manifest()
+            m["versions"][str(version)] = {
+                "path": vdir,
+                "n_series": int(len(ids)),
+                "published_unix": round(time.time(), 3),
+            }
+            if activate:
+                m["previous_version"] = m["active_version"]
+                m["active_version"] = version
+            self._write_manifest(m)
+        if activate:
+            self._notify(version)
+        return version
+
+    def activate(self, version: int) -> None:
+        """Flip the active pointer to an already-published version."""
+        with self._locked():
+            m = self._read_manifest()
+            if str(int(version)) not in m["versions"]:
+                raise RegistryError(
+                    "unknown-version",
+                    f"version {version} was never published",
+                )
+            flipped = m["active_version"] != int(version)
+            if flipped:
+                m["previous_version"] = m["active_version"]
+                m["active_version"] = int(version)
+                self._write_manifest(m)
+        if flipped:
+            self._notify(int(version))
+
+    def rollback(self) -> int:
+        """Re-activate the previously active version (one level deep —
+        the bad-deploy escape hatch).  Returns the version restored."""
+        m = self._read_manifest()
+        prev = m["previous_version"]
+        if prev is None:
+            raise RegistryError("no-rollback-target",
+                                "no previously active version recorded")
+        self.activate(prev)
+        return prev
+
+    # -- reads -----------------------------------------------------------------
+
+    def load(self, version: Optional[int] = None) -> Snapshot:
+        """Load a version (default: the active one) as a Snapshot."""
+        m = self._read_manifest()
+        if version is None:
+            version = m["active_version"]
+            if version is None:
+                raise RegistryError("no-active-version",
+                                    "nothing has been activated yet")
+        entry = m["versions"].get(str(int(version)))
+        if entry is None:
+            raise RegistryError("unknown-version",
+                                f"version {version} was never published")
+        base = os.path.join(self.root, entry["path"], "state")
+        try:
+            state, ids, extras = ckpt.load_state(
+                base, self.config, strict=self.strict, return_extras=True,
+            )
+        except (OSError, ValueError, KeyError) as e:
+            raise RegistryError(
+                "corrupt-snapshot", f"version {version} at {base}: {e}"
+            )
+        if ids is None or len(ids) != int(entry["n_series"]):
+            raise RegistryError(
+                "corrupt-snapshot",
+                f"version {version}: snapshot carries "
+                f"{0 if ids is None else len(ids)} series ids, manifest "
+                f"says {entry['n_series']}",
+            )
+        return Snapshot.build(int(version), state, ids,
+                              extras.get("step"))
+
+    # -- invalidation fan-out --------------------------------------------------
+
+    def subscribe(self, fn: Callable[[Optional[int]], None]) -> None:
+        """Call ``fn(new_active_version)`` after every in-process
+        activation (engines invalidate their caches through this)."""
+        self._listeners.append(fn)
+
+    def _notify(self, version: Optional[int]) -> None:
+        for fn in self._listeners:
+            fn(version)

@@ -41,6 +41,30 @@ from tsspark_tpu.streaming.state import ParamStore
 from tsspark_tpu.streaming.warmstart import transfer_theta
 
 
+def median_steps(grid: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Per-series median observed cadence (days) over one union grid.
+
+    ``y`` is the (B, T) materialized batch with NaN holes; a series'
+    cadence is the median gap between ITS observed grid points.  One
+    vectorized pass — sorting NaN-masked grid copies pushes the holes to
+    the tail so the finite diffs are exactly the per-series gaps — in
+    place of the per-series ``union_grid`` loop the forecast path used
+    to run.  Rows with fewer than two observations get the daily
+    default (1.0).
+    """
+    y = np.asarray(y)
+    obs = np.isfinite(y)
+    step = np.ones(y.shape[0])
+    rows = np.flatnonzero(obs.sum(axis=1) > 1)
+    if rows.size:
+        g = np.where(obs[rows], np.asarray(grid, np.float64)[None, :],
+                     np.nan)
+        # Grid is ascending, so sorting only moves the NaNs to the tail.
+        d = np.diff(np.sort(g, axis=1), axis=1)
+        step[rows] = np.nanmedian(d, axis=1)
+    return step
+
+
 @dataclass
 class RefitStats:
     micro_batches: int = 0
@@ -71,6 +95,7 @@ class StreamingForecaster:
         store: Optional[ParamStore] = None,
         warm_start: bool = True,
         autotune_state: Optional[str] = None,
+        engine=None,
         **backend_kwargs,
     ):
         """``warm_start=False`` disables the parameter-store transfer:
@@ -85,7 +110,14 @@ class StreamingForecaster:
         every micro-batch, and the learned width is the one measured
         fastest on this runtime.  An explicit ``chunk_size`` in
         ``backend_kwargs`` wins; a missing/corrupt state file is
-        ignored (it is pure cache)."""
+        ignored (it is pure cache).
+
+        ``engine``: a serve-side prediction engine
+        (tsspark_tpu.serve.PredictionEngine).  When attached,
+        :meth:`forecast` routes through it — streaming and serving then
+        share ONE batched, cached, deadline-aware read path instead of
+        maintaining two.  The engine reads the last PUBLISHED registry
+        version, so keep it fresh with :meth:`publish`."""
         if autotune_state is not None and "chunk_size" not in backend_kwargs:
             from tsspark_tpu.perf import load_learned_chunk
 
@@ -102,7 +134,13 @@ class StreamingForecaster:
         self._hist = native.HistoryStore(max_history)
         self._code_of: Dict[str, int] = {}
         self._ds_was_datetime = False
+        self.engine = engine
         self.stats = RefitStats()
+
+    def attach_engine(self, engine) -> None:
+        """Route subsequent :meth:`forecast` calls through ``engine``
+        (``None`` detaches and restores the direct store read)."""
+        self.engine = engine
 
     # -- ingestion -------------------------------------------------------------
 
@@ -151,7 +189,9 @@ class StreamingForecaster:
         state = self.backend.fit(
             jnp.asarray(grid), jnp.asarray(y), init=theta0
         )
-        self.store.update(touched, state)
+        # Cadence is recorded WITH the refreshed params so the forecast
+        # path never re-derives it from history (see median_steps).
+        self.store.update(touched, state, step=median_steps(grid, y))
 
         dt = time.time() - t0
         self.stats.micro_batches += 1
@@ -194,10 +234,40 @@ class StreamingForecaster:
 
     # -- forecasting out of the store ------------------------------------------
 
+    def publish(self, registry, activate: bool = True) -> int:
+        """Publish the current parameter store into a serve registry
+        (one new version; see ParamStore.publish)."""
+        return self.store.publish(registry, activate=activate)
+
     def forecast(self, series_ids: Sequence, horizon: int,
                  num_samples: Optional[int] = None) -> pd.DataFrame:
-        """Forecast from the latest stored parameters (no refit)."""
+        """Forecast from the latest stored parameters (no refit).
+
+        With an attached serve engine the request rides the shared
+        micro-batched read path (coalescing, version-keyed cache,
+        deadline admission); otherwise it reads the store directly.
+        Either way, unknown series raise ``KeyError`` — but the source
+        of truth follows the path: the engine serves the PUBLISHED
+        registry snapshot, the direct path this driver's live store.
+        """
         ids = [str(s) for s in series_ids]
+        if self.engine is not None:
+            from tsspark_tpu.serve.engine import UnknownSeries
+
+            try:
+                res = self.engine.forecast(
+                    ids, horizon,
+                    # Same default the direct path's predict applies.
+                    num_samples=(self.config.uncertainty_samples
+                                 if num_samples is None else num_samples),
+                )
+            except UnknownSeries as e:
+                raise KeyError(
+                    f"no fitted params for series: "
+                    f"{list(e.missing)[:5]} (registry version "
+                    f"{e.version}; publish() to refresh)"
+                ) from e
+            return self._frame(ids, horizon, res.ds, res.values)
         missing = [s for s in ids if s not in self.store]
         if missing:
             raise KeyError(f"no fitted params for series: {missing[:5]}")
@@ -210,18 +280,22 @@ class StreamingForecaster:
             converged=jnp.ones(len(ids), bool),
             n_iters=jnp.zeros(len(ids), jnp.int32),
         )
-        # Continue each series' own calendar at its observed cadence.
+        # Continue each series' own calendar at its observed cadence,
+        # recorded at update time (median_steps) — one broadcast, no
+        # per-series history scans.
         last = np.asarray(meta.ds_start + meta.ds_span)
-        step = np.empty(len(ids))
-        for i, sid in enumerate(ids):
-            code = self._code_of.get(sid)
-            days = (self._hist.union_grid(np.asarray([code], np.int64))
-                    if code is not None else np.empty(0))
-            step[i] = float(np.median(np.diff(days))) if len(days) > 1 else 1.0
+        step = self.store.lookup_step(ids)
         grid = last[:, None] + step[:, None] * np.arange(1, horizon + 1)
-        fc = self.backend.predict(state, jnp.asarray(grid),
-                                  num_samples=num_samples)
-        ds_out = grid.reshape(-1)
+        # Host float64 grid straight through (the serve engine's feed
+        # too): a jnp cast here would quantize absolute epoch days to
+        # f32 BEFORE prepare_predict_data's f64 time mapping.
+        fc = self.backend.predict(state, grid, num_samples=num_samples)
+        return self._frame(ids, horizon, grid, fc)
+
+    def _frame(self, ids, horizon: int, grid, fc) -> pd.DataFrame:
+        """Long-frame view of a (B, H) forecast dict (shared by the
+        direct and engine-routed read paths)."""
+        ds_out = np.asarray(grid).reshape(-1)
         if self._ds_was_datetime:
             ds_out = _days_to_ts(ds_out)
         rows = {

@@ -71,11 +71,22 @@ def _unflatten_meta(rows: np.ndarray, config: ProphetConfig) -> ScalingMeta:
 
 
 class ParamStore:
-    """Per-series (theta row, scaling meta row) storage."""
+    """Per-series (theta row, scaling meta row, cadence) storage.
+
+    The trailing row column is the series' observed median step in days
+    (its cadence), recorded by the streaming driver at update time so
+    the forecast read path can build every future grid with one
+    vectorized broadcast instead of re-deriving each series' cadence
+    from history (N native ``union_grid`` calls per forecast).  Zero
+    means "never recorded"; readers substitute the daily default.
+    """
 
     def __init__(self, config: ProphetConfig):
         self.config = config
-        self._table = native.ParamTable(config.num_params + _meta_dim(config))
+        # +1: the cadence column (see class docstring).
+        self._table = native.ParamTable(
+            config.num_params + _meta_dim(config) + 1
+        )
         self._code_of: Dict[str, int] = {}
         self._id_of: List[str] = []
 
@@ -100,12 +111,31 @@ class ParamStore:
     def __contains__(self, series_id: str) -> bool:
         return str(series_id) in self._code_of
 
-    def update(self, series_ids: Sequence, state: FitState) -> None:
+    def update(self, series_ids: Sequence, state: FitState,
+               step: Optional[np.ndarray] = None) -> None:
+        """Upsert fitted rows.  ``step``: per-series median cadence in
+        days (``None`` preserves each series' previously recorded value,
+        so callers that never measure cadence don't erase it)."""
+        if step is None:
+            step = self._raw_steps(series_ids)
         rows = np.concatenate(
-            [np.asarray(state.theta, np.float64), _flatten_meta(state.meta)],
+            [np.asarray(state.theta, np.float64), _flatten_meta(state.meta),
+             np.asarray(step, np.float64)[:, None]],
             axis=1,
         )
         self._table.update(self._codes(series_ids, intern=True), rows)
+
+    def _raw_steps(self, series_ids: Sequence) -> np.ndarray:
+        """Stored cadence column as-is (0.0 for unknown/unrecorded)."""
+        rows, found = self._table.lookup(self._codes(series_ids,
+                                                     intern=False))
+        return np.where(found, rows[:, -1], 0.0)
+
+    def lookup_step(self, series_ids: Sequence) -> np.ndarray:
+        """Per-series median cadence in days, daily default for series
+        whose cadence was never recorded."""
+        raw = self._raw_steps(series_ids)
+        return np.where(raw > 0, raw, 1.0)
 
     def lookup(
         self, series_ids: Sequence
@@ -121,35 +151,59 @@ class ParamStore:
         if not found.any():
             return None, None, found
         p = self.config.num_params
+        m = _meta_dim(self.config)
         return (
             jnp.asarray(rows[:, :p]),
-            _unflatten_meta(rows[:, p:], self.config),
+            _unflatten_meta(rows[:, p:p + m], self.config),
             found,
         )
 
-    # -- persistence -----------------------------------------------------------
+    # -- persistence / publication ---------------------------------------------
 
-    def save(self, path: str) -> None:
+    def export_state(self):
+        """Every stored series as one id-sorted batch.
+
+        Returns ``(state, ids, step)`` — the synthetic FitState (zero
+        diagnostics: the store keeps parameters, not solver history),
+        the series ids aligned to its rows, and the raw cadence column.
+        Shared by :meth:`save` and :meth:`publish` so the checkpoint and
+        the serve registry can never disagree on row layout.
+        """
         codes, rows = self._table.export()
         ids = np.asarray([self._id_of[c] for c in codes])
         order = np.argsort(ids)
         ids, rows = ids[order], rows[order]
         p = self.config.num_params
+        m = _meta_dim(self.config)
         n = len(ids)
         state = FitState(
             theta=jnp.asarray(rows[:, :p]),
-            meta=_unflatten_meta(rows[:, p:], self.config),
+            meta=_unflatten_meta(rows[:, p:p + m], self.config),
             loss=jnp.zeros(n), grad_norm=jnp.zeros(n),
             converged=jnp.ones(n, bool),
             n_iters=jnp.zeros(n, jnp.int32),
         )
-        ckpt.save_state(path, state, self.config, series_ids=ids)
+        return state, ids, rows[:, -1].copy()
+
+    def save(self, path: str) -> None:
+        state, ids, step = self.export_state()
+        ckpt.save_state(path, state, self.config, series_ids=ids,
+                        extras={"step": step})
+
+    def publish(self, registry, activate: bool = True) -> int:
+        """Publish the whole store as one new serve-registry version
+        (tsspark_tpu.serve.registry.ParamRegistry) — the streaming-side
+        write path into the serving subsystem.  Returns the version."""
+        state, ids, step = self.export_state()
+        return registry.publish(state, ids, step=np.where(step > 0, step, 1.0),
+                                activate=activate)
 
     @classmethod
     def load(cls, path: str, config: ProphetConfig, strict: bool = True
              ) -> "ParamStore":
-        state, ids = ckpt.load_state(path, config, strict=strict)
+        state, ids, extras = ckpt.load_state(path, config, strict=strict,
+                                             return_extras=True)
         store = cls(config)
         if ids is not None:
-            store.update(ids, state)
+            store.update(ids, state, step=extras.get("step"))
         return store

@@ -39,8 +39,15 @@ def save_state(
     state: FitState,
     config: ProphetConfig,
     series_ids: Optional[np.ndarray] = None,
+    extras: Optional[Dict[str, np.ndarray]] = None,
 ) -> None:
-    """Write a FitState to ``<base>.npz`` + ``<base>.json`` sidecar."""
+    """Write a FitState to ``<base>.npz`` + ``<base>.json`` sidecar.
+
+    ``extras``: side arrays that ride the same npz under ``extra_``-
+    prefixed keys (e.g. the streaming store's per-series cadence).
+    ``load_state`` ignores them — they are not part of the FitState
+    contract; consumers read them back with :func:`load_extras`.
+    """
     path = _base(path)
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
     arrays = {
@@ -54,6 +61,9 @@ def save_state(
         arrays["status"] = state.status
     arrays.update(
         {f"meta_{k}": v for k, v in state.meta._asdict().items()}
+    )
+    arrays.update(
+        {f"extra_{k}": v for k, v in (extras or {}).items()}
     )
     # Atomic npz + json (utils.atomic): a reader — a concurrent predict
     # process, a resumed streaming driver — must never np.load a torn
@@ -196,10 +206,27 @@ def load_forecaster(path: str):
     return fc
 
 
+def load_extras(path: str) -> Dict[str, np.ndarray]:
+    """The ``extras`` arrays a checkpoint was saved with (may be empty)."""
+    path = _base(path)
+    z = np.load(path + ".npz")
+    return {
+        k[len("extra_"):]: np.asarray(z[k])
+        for k in z.files if k.startswith("extra_")
+    }
+
+
 def load_state(
-    path: str, config: ProphetConfig, strict: bool = True
-) -> Tuple[FitState, Optional[np.ndarray]]:
-    """Load a FitState; verifies the config fingerprint when ``strict``."""
+    path: str, config: ProphetConfig, strict: bool = True,
+    return_extras: bool = False,
+):
+    """Load a FitState; verifies the config fingerprint when ``strict``.
+
+    Returns ``(state, series_ids)``, or ``(state, series_ids, extras)``
+    with ``return_extras`` — the latter reads the npz once instead of
+    making a large snapshot pay a second full parse via
+    :func:`load_extras` (the serve registry's version-flip path).
+    """
     path = _base(path)
     with open(path + ".json") as f:
         sidecar = json.load(f)
@@ -238,4 +265,11 @@ def load_state(
         status=jnp.asarray(z["status"]) if "status" in z.files else None,
     )
     ids = sidecar.get("series_ids")
-    return state, None if ids is None else np.asarray(ids)
+    ids = None if ids is None else np.asarray(ids)
+    if return_extras:
+        extras = {
+            k[len("extra_"):]: np.asarray(z[k])
+            for k in z.files if k.startswith("extra_")
+        }
+        return state, ids, extras
+    return state, ids
