@@ -403,6 +403,129 @@ def test_forecaster_resilient_end_to_end(tmp_path, monkeypatch):
     assert len(fc) == 6 * 7
 
 
+def test_chunk_lease_claim_steal_and_fence(tmp_path):
+    """Lease-fenced range claims: a live lease blocks rivals, a stale
+    one (expired, or owner pid dead) is stolen, and the loser of a
+    steal is fenced — ``holds_lease`` refuses its token, so its save is
+    discarded instead of double-landing the range."""
+    import json as json_mod
+    import subprocess
+
+    out = str(tmp_path)
+    assert orchestrate.claim_lease(out, 0, 32, "w1")
+    # Live lease (our own pid, future expiry): a rival cannot claim...
+    assert not orchestrate.claim_lease(out, 0, 32, "w2")
+    # ...but the holder re-claims (renews) its own lease freely.
+    assert orchestrate.claim_lease(out, 0, 32, "w1")
+    assert orchestrate.holds_lease(out, 0, 32, "w1")
+    assert not orchestrate.holds_lease(out, 0, 32, "w2")
+
+    # Expired lease: stealable even when the owner pid is alive (the
+    # owner is fenced at save time, which keeps the steal safe).
+    with open(orchestrate._lease_path(out, 0, 32), "w") as fh:
+        json_mod.dump({"token": "w1", "pid": os.getpid(),
+                       "expires_unix": 0.0}, fh)
+    assert orchestrate.claim_lease(out, 0, 32, "w2")
+    assert not orchestrate.holds_lease(out, 0, 32, "w1")  # fenced
+    assert orchestrate.holds_lease(out, 0, 32, "w2")
+
+    # Dead-owner lease: reclaimed immediately, before expiry (the
+    # watchdog's SIGKILL leaves exactly this state behind).
+    dead = subprocess.Popen(["true"])
+    dead.wait()  # reaped: its pid no longer exists
+    with open(orchestrate._lease_path(out, 64, 96), "w") as fh:
+        json_mod.dump({"token": "gone", "pid": dead.pid,
+                       "expires_unix": 4e12}, fh)
+    assert orchestrate.claim_lease(out, 64, 96, "w3")
+
+    # Torn lease record (writer died mid-create): reads as stale.
+    with open(orchestrate._lease_path(out, 96, 128), "w") as fh:
+        fh.write('{"token": "to')
+    assert orchestrate.claim_lease(out, 96, 128, "w4")
+
+    # A live lease blocks OVERLAPPING claims at any width, not just the
+    # exact range — claim grids differ across workers (tuner sizing,
+    # chunk halving), and two non-identical overlapping leases would
+    # double-land series.
+    assert orchestrate.claim_lease(out, 128, 160, "wa")
+    assert not orchestrate.claim_lease(out, 136, 144, "wb")  # inside
+    assert not orchestrate.claim_lease(out, 152, 176, "wb")  # straddles
+    assert orchestrate.claim_lease(out, 160, 192, "wb")      # adjacent
+    # The holder itself may re-claim a sub-range of its own coverage
+    # grid without self-conflict (same token).
+    assert orchestrate.claim_lease(out, 136, 144, "wa")
+
+    # Release only honors the holder's token.
+    orchestrate.release_lease(out, 0, 32, "w1")  # loser: no-op
+    assert orchestrate.holds_lease(out, 0, 32, "w2")
+    orchestrate.release_lease(out, 0, 32, "w2")
+    assert orchestrate.read_lease(out, 0, 32) is None
+    assert orchestrate.claim_lease(out, 0, 32, "w5")
+
+
+def test_sigkill_mid_chunk_restart_lands_exactly_once(tmp_path,
+                                                      monkeypatch):
+    """The crash-resume acceptance (ISSUE 5 satellite): SIGKILL a fit
+    worker mid-chunk (exit-mode fault after its first save, plus a
+    silent chunk corruption), restart through the parent loop, and
+    assert every series lands exactly once — coverage tiles [0, n) with
+    no gap or overlap — with no ``*.corrupt`` quarantine file leaking
+    into the assembled results."""
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.data import datasets
+    from tsspark_tpu.resilience import faults
+    from tsspark_tpu.resilience.policy import RetryPolicy
+
+    batch = datasets.m5_like(n_series=48, n_days=96)
+    scratch = tmp_path / "scratch"
+    data_dir = str(scratch / "data")
+    out_dir = str(scratch / "out")
+    orchestrate.spill_data(
+        data_dir, batch.ds, np.nan_to_num(batch.y), mask=batch.mask,
+        regressors=batch.regressors,
+    )
+    orchestrate.save_run_config(
+        out_dir, _model_config(), SolverConfig(max_iters=60)
+    )
+    plan = (
+        faults.FaultPlan(state_dir=str(tmp_path / "faults"))
+        # the worker dies right after landing its first chunk...
+        .fail("fit_worker_chunk", after=0, attempts=1, mode="exit",
+              rc=31)
+        # ...and one later save is silently corrupted on disk.
+        .fail("chunk_save", series=40, attempts=1, mode="corrupt")
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    state = orchestrate.run_resilient(
+        data_dir=data_dir, out_dir=out_dir, series=48, chunk=16,
+        min_chunk=16, segment=0, phase1_iters=0, deadline=None,
+        progress_timeout=600.0, probe_accelerator=False,
+        retry_policy=RetryPolicy(max_attempts=9, base_delay_s=0.2,
+                                 max_delay_s=0.2),
+    )
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert state["complete"] and state["retries"] >= 1
+
+    # Exactly once: completed ranges tile [0, 48) disjointly.
+    done = sorted(orchestrate.completed_ranges(out_dir))
+    cur = 0
+    for lo, hi in done:
+        assert lo == cur, f"gap or overlap at {lo} (covered to {cur})"
+        cur = hi
+    assert cur == 48
+    # The injected corruption was quarantined, re-fit, and never
+    # assembled: the corrupt file sits outside the resume glob and the
+    # full state loads clean with every row finite.
+    assert glob.glob(os.path.join(out_dir, "*.corrupt"))
+    fit_state = orchestrate.load_fit_state(out_dir, 48)
+    assert np.asarray(fit_state.theta).shape[0] == 48
+    assert np.isfinite(np.asarray(fit_state.theta)).all()
+    # Any lease a dead worker left behind is immediately reclaimable —
+    # a resumed run never deadlocks on its predecessor's leases.
+    for lo, hi in done:
+        assert orchestrate.claim_lease(out_dir, lo, hi, "post-check")
+
+
 def test_run_resilient_gives_up_on_deterministic_failure(tmp_path,
                                                          monkeypatch):
     """A child that dies with ZERO progress every attempt (here: the data

@@ -24,6 +24,8 @@ from tsspark_tpu.resilience.policy import (  # noqa: E402
     PROBE,
     STREAM_POLL,
     WORKER_RETRY,
+    CircuitBreaker,
+    CircuitOpen,
     RetryPolicy,
 )
 from tsspark_tpu.resilience.report import (  # noqa: E402
@@ -112,7 +114,138 @@ def test_retry_policy_call_retries_then_raises():
     assert calls["n"] == 3  # attempts bounded
 
 
+# -- circuit breaker -------------------------------------------------------
+
+
+def test_circuit_breaker_state_machine():
+    """Closed -> open at the failure threshold, open -> half-open after
+    the reset window (one trial at a time), trial success closes, trial
+    failure re-opens — all on an injected clock."""
+    now = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                        name="dep", clock=lambda: now["t"])
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed" and br.allow()  # below threshold
+    br.record_failure()
+    assert br.state == "open" and br.opens == 1
+    assert not br.allow() and br.fast_fails == 1
+    assert br.retry_after_s() == 10.0
+    now["t"] = 10.0
+    assert br.state == "half-open"
+    assert br.allow()          # the single trial
+    assert not br.allow()      # a second concurrent trial is refused
+    br.record_failure()        # trial failed: re-open for a new window
+    assert br.state == "open" and br.opens == 2
+    now["t"] = 20.0
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    # A success resets the consecutive-failure count entirely.
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_retry_policy_call_respects_breaker():
+    """RetryPolicy.call sheds fast through an open breaker instead of
+    retrying a dead dependency to its attempt budget."""
+    calls = {"n": 0}
+
+    def always_bad():
+        calls["n"] += 1
+        raise OSError("down")
+
+    br = CircuitBreaker(failure_threshold=2, reset_timeout_s=60.0,
+                        name="broker")
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.0)
+    with pytest.raises(OSError):
+        pol.call(always_bad, breaker=br)  # 2 attempts trip the breaker
+    assert calls["n"] == 2 and br.state == "open"
+    with pytest.raises(CircuitOpen):
+        pol.call(always_bad, breaker=br)
+    assert calls["n"] == 2  # shed BEFORE any attempt ran
+
+
+def test_breaker_trial_slot_survives_foreign_exception():
+    """A half-open trial that dies on a NON-retryable exception (a
+    caller bug, not a dependency failure) must still resolve the trial
+    slot — the breaker re-opens instead of wedging with the trial
+    marked in flight forever."""
+    now = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=1, reset_timeout_s=10.0,
+                        name="dep", clock=lambda: now["t"])
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.0)
+    with pytest.raises(OSError):
+        pol.call(lambda: (_ for _ in ()).throw(OSError("down")),
+                 retry_on=(OSError,), breaker=br)
+    assert br.state == "open"
+    now["t"] = 10.0  # half-open: next call is the trial
+
+    def caller_bug():
+        raise ValueError("not a dependency failure")
+
+    with pytest.raises(ValueError):
+        pol.call(caller_bug, retry_on=(OSError,), breaker=br)
+    assert br.state == "open"  # re-opened, NOT wedged half-open
+    now["t"] = 20.0
+    assert br.allow()  # a fresh trial is admitted after the window
+
+
+def test_resilient_source_sheds_through_open_breaker(monkeypatch,
+                                                     tmp_path):
+    """The streaming poll loop: a broker that keeps failing opens the
+    shared breaker, and the next poll raises CircuitOpen immediately —
+    no further retry sleeps against a dead dependency."""
+    from tsspark_tpu.streaming.source import ResilientSource
+
+    class DeadSource:
+        polls = 0
+
+        def poll(self):
+            DeadSource.polls += 1
+            raise ConnectionError("broker down")
+
+        def commit(self):
+            pass
+
+    br = CircuitBreaker(failure_threshold=3, reset_timeout_s=60.0,
+                        name="kafka")
+    src = ResilientSource(
+        DeadSource(),
+        RetryPolicy(max_attempts=3, base_delay_s=0.0, max_delay_s=0.0),
+        breaker=br,
+    )
+    with pytest.raises(ConnectionError):
+        src.poll()
+    assert DeadSource.polls == 3 and br.state == "open"
+    with pytest.raises(CircuitOpen):
+        src.poll()
+    assert DeadSource.polls == 3  # shed fast, zero new attempts
+
+
 # -- faults ----------------------------------------------------------------
+
+
+def test_sleep_mode_stalls_without_failing(tmp_path, monkeypatch):
+    """The slow-I/O fault class: a "sleep" rule delays the armed call
+    and lets it proceed — no flag, no raise, just latency."""
+    import time as time_mod
+
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "st")).fail(
+        "fit_chunk", mode="sleep", attempts=1, delay_s=0.25,
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    t0 = time_mod.time()
+    assert faults.inject("fit_chunk") is False  # stalled, not flagged
+    assert time_mod.time() - t0 >= 0.25
+    t0 = time_mod.time()
+    assert faults.inject("fit_chunk") is False  # window consumed
+    assert time_mod.time() - t0 < 0.2
 
 
 def test_fault_plan_windows_and_series_targeting(tmp_path, monkeypatch):

@@ -239,6 +239,114 @@ def test_engine_retry_policy_covers_transient_faults(tmp_path, fitted,
     assert res.values["yhat"].shape == (1, 7)
 
 
+def test_registry_corrupt_active_snapshot_falls_back(tmp_path, fitted):
+    """A corrupt ACTIVE snapshot must not take down the read path: the
+    CRC check rejects it and the registry serves the last good version
+    (with a warning and ``fallback_from`` set); an explicitly requested
+    version still raises."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)  # v1 active
+    v2 = reg.publish(state._replace(theta=state.theta * 1.01), ids)
+    assert reg.active_version() == v2
+    # Silent corruption: flip bytes at several offsets in the active
+    # snapshot (same spread as faults.corrupt_file — a single flip can
+    # land entirely inside npz alignment padding no loader parses).
+    path = os.path.join(reg.root, f"v{v2:06d}", "state.npz")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        for k in range(1, 8):
+            fh.seek(size * k // 8)
+            chunk = fh.read(16)
+            fh.seek(size * k // 8)
+            fh.write(bytes(b ^ 0xFF for b in chunk))
+
+    with pytest.warns(RuntimeWarning, match="last good"):
+        snap = reg.load()
+    assert snap.version == 1 and snap.fallback_from == v2
+    with pytest.raises(RegistryError) as e:
+        reg.load(v2)  # explicit request: no silent substitution
+    assert e.value.reason == "corrupt-snapshot"
+
+    # The engine keeps serving through the fallback — and does NOT
+    # thrash reloads (the served version differs from the active
+    # pointer by design while the corruption stands).
+    eng = PredictionEngine(reg)
+    with pytest.warns(RuntimeWarning):
+        res = eng.forecast(["s0"], 7)
+    assert res.version == 1
+    assert eng.forecast(["s1"], 7).version == 1  # steady state, no warn
+    # Republishing a good version clears the degradation.
+    v3 = reg.publish(state, ids)
+    assert eng.forecast(["s0"], 7).version == v3
+
+
+def test_engine_retries_registry_after_breaker_window(tmp_path, fitted):
+    """While the registry breaker is open the engine serves its held
+    snapshot WITHOUT marking the missed flip as seen — once the window
+    elapses, the next pump retries the (recovered) registry instead of
+    staying pinned to the stale version forever."""
+    import time as time_mod
+
+    from tsspark_tpu.resilience.policy import CircuitBreaker
+
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)  # v1 active
+    eng = PredictionEngine(
+        reg,
+        registry_breaker=CircuitBreaker(failure_threshold=1,
+                                        reset_timeout_s=0.05,
+                                        name="registry"),
+    )
+    assert eng.forecast(["s0"], 7).version == 1
+    # Cross-process flip: a SECOND registry handle publishes v2, so the
+    # engine only sees the manifest key change (no in-process listener).
+    reg2 = ParamRegistry(reg.root, CFG)
+    v2 = reg2.publish(state._replace(theta=state.theta * 1.01), ids)
+
+    # The reload attempt fails transiently -> breaker opens.
+    real_load = reg.load
+    reg.load = lambda *a, **k: (_ for _ in ()).throw(OSError("hiccup"))
+    try:
+        with pytest.raises(OSError):
+            eng.forecast(["s0"], 7)
+        # Breaker open: the engine degrades to the held v1 snapshot.
+        assert eng.forecast(["s0"], 7).version == 1
+    finally:
+        reg.load = real_load
+    # Window elapses; registry recovered: the engine must pick up v2.
+    time_mod.sleep(0.06)
+    assert eng.forecast(["s0"], 7).version == v2
+
+
+def test_cache_not_pinned_by_activation_race(tmp_path, fitted):
+    """ISSUE 5 satellite: an activation landing between the snapshot
+    read and the cache insert used to pin a stale version-keyed entry
+    (inserted AFTER the activation's invalidation swept the cache).
+    The engine now re-checks the snapshot slot before inserting."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    eng = PredictionEngine(reg)
+    orig = eng._dispatch
+
+    def racing_dispatch(snap, sids, hb, num_samples, seed, n_requests):
+        out = orig(snap, sids, hb, num_samples, seed, n_requests)
+        # The race: v2 activates (listener invalidates the cache) while
+        # this batch's dispatch is still in flight.
+        reg.publish(state._replace(theta=state.theta * 1.03), ids)
+        return out
+
+    eng._dispatch = racing_dispatch
+    try:
+        res = eng.forecast(["s0"], 7)
+    finally:
+        eng._dispatch = orig
+    assert res.version == 1  # the in-flight batch still serves v1...
+    assert len(eng.cache) == 0  # ...but pins NOTHING under v1
+    assert eng.cache.key_versions() == []
+    nxt = eng.forecast(["s0"], 7)
+    assert nxt.version == 2 and eng.cache.key_versions() == [2]
+
+
 def test_engine_cache_invalidated_on_version_flip(tmp_path, fitted):
     backend, state, ids = fitted
     reg = _registry(tmp_path, fitted)

@@ -125,6 +125,24 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "os.replace of a failed chunk/prep file out of the resume "
         "globs (atomic by construction; kept for forensics)",
     ),
+    ArtifactSpec(
+        "chunk-lease", ("lease_",),
+        ("claim_lease", "release_lease"),
+        "fit-worker range lease (orchestrate.claim_lease): fresh claims "
+        "are atomic O_EXCL creates, steals/renewals atomic replaces; a "
+        "torn record (writer died mid-create) reads as stale and is "
+        "stolen whole — readers tolerate it by design, and the save "
+        "path fences on the lease token so a stolen range can never "
+        "double-land",
+        exempt=True,
+    ),
+    ArtifactSpec(
+        "chaos-report", ("CHAOS_",),
+        ("write_scorecard",),
+        "chaos-storm scorecard (tsspark_tpu.chaos): injection schedule, "
+        "invariant verdicts, MTTR per fault class; written once at "
+        "storm end, atomic so a watcher never parses a partial JSON",
+    ),
     # Specific marker specs must precede "checkpoint": its generic
     # ".json" marker would otherwise swallow "times.jsonl",
     # "manifest.json" and "SERVE_*.json" (first marker match wins).
@@ -205,6 +223,10 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/serve/engine.py",
     "tsspark_tpu/serve/cache.py",
     "tsspark_tpu/serve/__main__.py",
+    "tsspark_tpu/chaos/storm.py",
+    "tsspark_tpu/chaos/harness.py",
+    "tsspark_tpu/chaos/invariants.py",
+    "tsspark_tpu/chaos/__main__.py",
 )
 
 _WRITE_FNS = {"save", "savez", "savez_compressed", "dump"}

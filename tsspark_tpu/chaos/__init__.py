@@ -1,0 +1,48 @@
+"""Chaos-engineering harness (``docs/RESILIENCE.md``, "Chaos harness &
+failure domains").
+
+``python -m tsspark_tpu.chaos --seed 0`` composes a seeded, fully
+deterministic fault storm (``storm.compose``) — worker kills, torn
+artifact writes, spawn failures, slow-I/O stalls, wedged accelerator
+probes, registry snapshot corruption, streaming poll faults, serve
+dispatch faults, queue-overload bursts, activation races — and drives
+the whole pipeline through it: orchestrate fit workers -> registry
+publish/activate -> streaming driver -> prediction engine under
+loadgen.  The invariant checkers (``invariants``) then verify the
+properties that make the storm a regression gate rather than a demo:
+
+* every series lands exactly once (coverage tiles with no gap/overlap,
+  and the result is bitwise identical to a fault-free run);
+* no torn artifact is ever read (CRC quarantine + atomic-write temps
+  all accounted for; a corrupt active registry snapshot degrades to the
+  last good version, never into forecasts);
+* engine-batched forecasts stay bitwise equal to direct
+  ``backend.predict`` throughout;
+* recovery after each injected fault stays under the profile's budget
+  (MTTR per fault class, measured off the fault harness's
+  cross-process claim files).
+
+The outcome is a ``CHAOS_*.json`` scorecard — the robustness analog of
+``BENCH_*``/``SERVE_*`` — with the full injection schedule recorded, so
+the same seed reproduces the same storm anywhere.
+"""
+
+from tsspark_tpu.chaos.harness import run_storm, summarize, write_scorecard
+from tsspark_tpu.chaos.storm import (
+    PROFILES,
+    Injection,
+    StormPlan,
+    StormProfile,
+    compose,
+)
+
+__all__ = [
+    "Injection",
+    "PROFILES",
+    "StormPlan",
+    "StormProfile",
+    "compose",
+    "run_storm",
+    "summarize",
+    "write_scorecard",
+]

@@ -1,0 +1,80 @@
+"""``python -m tsspark_tpu.chaos`` — run a seeded chaos storm.
+
+Composes the deterministic fault storm for ``--seed``/``--profile``,
+drives the full pipeline through it (orchestrate -> registry ->
+streaming -> serve loadgen), verifies the invariants, and writes a
+``CHAOS_*.json`` scorecard.  Exit code 0 iff every invariant held.
+
+Like the analysis and serve entry points, this pins JAX to CPU before
+anything imports it: a chaos run injects its own faults — it must never
+block on a genuinely wedged accelerator tunnel (the storm's wedge is
+simulated through the probe injection point instead).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # Persistent compile cache, same keying as the orchestrator's child
+    # workers: a storm re-runs the same small programs many times.
+    from tsspark_tpu.utils.platform import host_cpu_tag
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("TSSPARK_JAX_CACHE") or os.path.join(
+            repo_root, f".jax_cache_{host_cpu_tag()}"
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from tsspark_tpu.chaos.harness import (
+        run_storm,
+        summarize,
+        write_scorecard,
+    )
+    from tsspark_tpu.chaos.storm import PROFILES
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tsspark_tpu.chaos",
+        description="deterministic chaos storm over the full pipeline "
+                    "(docs/RESILIENCE.md)",
+    )
+    ap.add_argument("--seed", type=int, default=0,
+                    help="storm seed; the same seed reproduces the same "
+                    "injection schedule")
+    ap.add_argument("--profile", choices=sorted(PROFILES),
+                    default="full")
+    ap.add_argument("--dir", default=None,
+                    help="scratch root (default: a temp dir, removed "
+                    "afterwards)")
+    ap.add_argument("--report", default=None,
+                    help="scorecard path (default: CHAOS_<unix>.json)")
+    ap.add_argument("--keep-scratch", action="store_true",
+                    help="keep the storm's scratch dirs for forensics")
+    ap.add_argument("--deadline-s", type=float, default=600.0,
+                    help="hard wall bound on the orchestrate stages")
+    args = ap.parse_args(argv)
+
+    report = run_storm(
+        seed=args.seed, profile=args.profile, scratch=args.dir,
+        keep_scratch=args.keep_scratch, deadline_s=args.deadline_s,
+    )
+    out = write_scorecard(report, args.report)
+    print(summarize(report))
+    print(f"scorecard -> {out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,609 @@
+"""The chaos harness: drive the full pipeline through a seeded storm.
+
+One ``run_storm`` call fits a synthetic batch through the resilient
+orchestrator (subprocess fit workers), publishes the result into a serve
+registry, runs the streaming driver over a micro-batch source, and then
+load-generates against the prediction engine — with the storm's faults
+(``storm.compose``) armed across every stage — while the invariant
+checkers (``invariants``) verify that nothing was lost, duplicated,
+torn, or slow to recover.  The outcome is a ``CHAOS_*.json`` scorecard
+(the robustness analog of ``BENCH_*``/``SERVE_*``): faults injected,
+invariants checked, MTTR per fault class, and one overall ``ok``.
+
+Determinism: the schedule is a pure function of ``(seed, profile)``
+(recorded verbatim in the scorecard), injection firing is claimed
+through the resilience fault harness's cross-process counters, and the
+loadgen request mix is derived from the same seed — so a regression in
+any recovery path reproduces under the same seed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from tsspark_tpu.chaos import invariants as inv
+from tsspark_tpu.chaos.storm import (
+    PROFILES,
+    REGISTRY_SNAPSHOT_POINT,
+    StormPlan,
+    compose,
+)
+from tsspark_tpu.config import (
+    ProphetConfig,
+    SeasonalityConfig,
+    SolverConfig,
+)
+from tsspark_tpu.resilience import faults
+from tsspark_tpu.resilience.policy import CircuitBreaker, RetryPolicy
+from tsspark_tpu.utils.atomic import atomic_write
+
+#: Fast schedules for the storm's parent loop: the storm injects the
+#: failures, so the recovery machinery must not pad MTTR with
+#: production-sized sleeps.
+_RETRY = RetryPolicy(max_attempts=9, base_delay_s=0.1, backoff=1.0,
+                     max_delay_s=0.1)
+_PROBE = RetryPolicy(max_attempts=None, base_delay_s=0.2, backoff=1.5,
+                     max_delay_s=1.0, attempt_timeout_s=60.0)
+
+
+def _synthetic_batch(seed: int, series: int, days: int):
+    """Deterministic finite batch: level + trend + weekly cycle."""
+    rng = np.random.default_rng(seed)
+    t = np.arange(float(days))
+    level = rng.uniform(5.0, 50.0, (series, 1))
+    slope = rng.uniform(-0.02, 0.05, (series, 1))
+    amp = rng.uniform(0.5, 3.0, (series, 1))
+    y = (level + slope * t[None, :]
+         + amp * np.sin(2 * np.pi * t[None, :] / 7.0)
+         + rng.normal(0.0, 0.2, (series, days)))
+    return t, y.astype(np.float32)
+
+
+def _config(max_iters: int):
+    cfg = ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=3,
+    )
+    return cfg, SolverConfig(max_iters=max_iters)
+
+
+def _direct_forecast(backend, snap, sids, horizon: int):
+    """The reference read path: gather the snapshot rows and call
+    ``backend.predict`` directly (the parity oracle the engine is pinned
+    against in tests/test_serve.py)."""
+    idx, _ = snap.rows(sids)
+    sub, step = snap.take(idx)
+    last = np.asarray(sub.meta.ds_start + sub.meta.ds_span, np.float64)
+    grid = last[:, None] + step[:, None] * np.arange(1, horizon + 1)
+    out = backend.predict(sub, grid, num_samples=0)
+    return grid, {k: np.asarray(v) for k, v in out.items()}
+
+
+# ---------------------------------------------------------------------------
+# stage A: orchestrate under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_orchestrate(scratch: str, name: str, ds, y, cfg, solver,
+                     storm: StormPlan, deadline_s: float) -> Dict:
+    from tsspark_tpu import orchestrate
+
+    from tsspark_tpu.resilience.integrity import ChunkIntegrityError
+
+    prof = storm.profile
+    data_dir = os.path.join(scratch, name, "data")
+    out_dir = os.path.join(scratch, name, "out")
+    os.makedirs(out_dir, exist_ok=True)
+    orchestrate.spill_data(data_dir, ds, y)
+    orchestrate.save_run_config(out_dir, cfg, solver)
+    t0 = time.time()
+    state: Dict = {}
+    integrity_rounds = 0
+    # Same bounded integrity loop as fit_resilient: a corruption that
+    # only surfaces at assembly re-queues its range (quarantined by
+    # load_fit_state) and the parent loop refits it.
+    while True:
+        state = orchestrate.run_resilient(
+            data_dir=data_dir, out_dir=out_dir, series=prof.series,
+            chunk=prof.chunk, min_chunk=prof.chunk, segment=0,
+            phase1_iters=prof.phase1_iters, no_phase1_tune=True,
+            deadline=time.time() + deadline_s, reserve=lambda: 5.0,
+            progress_timeout=300.0,
+            probe_accelerator=prof.probe_accelerator or None,
+            retry_policy=_RETRY, probe_policy=_PROBE, state=state,
+        )
+        if not state.get("complete"):
+            break
+        try:
+            orchestrate.load_fit_state(out_dir, prof.series)
+            break
+        except ChunkIntegrityError:
+            integrity_rounds += 1
+            if integrity_rounds > 3:
+                raise
+            marker = os.path.join(out_dir, "phase2_done")
+            if os.path.exists(marker):
+                os.remove(marker)
+    return {
+        "out_dir": out_dir,
+        "complete": bool(state.get("complete")),
+        "retries": int(state.get("retries", 0)),
+        "integrity_rounds": integrity_rounds,
+        "probes": state.get("probes"),
+        "wall_s": round(time.time() - t0, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# stage C: streaming driver under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_streaming(registry, cfg, storm: StormPlan, seed: int) -> Dict:
+    import pandas as pd
+
+    from tsspark_tpu.streaming.driver import StreamingForecaster
+    from tsspark_tpu.streaming.source import InMemorySource
+
+    prof = storm.profile
+    rng = np.random.default_rng(seed + 1)
+    base = 40
+    batches = []
+    for b in range(prof.stream_batches):
+        rows = []
+        for s in range(prof.stream_series):
+            lo = base * (b > 0) + 10 * max(0, b - 1)
+            n = base if b == 0 else 10
+            t = np.arange(lo, lo + n, dtype=float)
+            yv = (20.0 + s + 0.05 * t
+                  + rng.normal(0.0, 0.1, n))
+            rows.append(pd.DataFrame({
+                "series_id": f"stream{s}", "ds": t, "y": yv,
+            }))
+        batches.append(pd.concat(rows, ignore_index=True))
+    driver = StreamingForecaster(
+        cfg, SolverConfig(max_iters=20), backend="tpu", chunk_size=8,
+    )
+    breaker = CircuitBreaker(failure_threshold=4, reset_timeout_s=0.2,
+                             name="stream-source")
+    t0 = time.time()
+    stats = driver.run(
+        InMemorySource(batches),
+        poll_policy=RetryPolicy(max_attempts=4, base_delay_s=0.0,
+                                max_delay_s=0.0),
+        poll_breaker=breaker,
+    )
+    version = driver.publish(registry)
+    return {
+        "wall_s": round(time.time() - t0, 3),
+        "micro_batches": stats.micro_batches,
+        "series_refit": stats.series_refit,
+        "published_version": version,
+        "breaker": breaker.snapshot(),
+        "end_time": time.time(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# stage D: prediction engine under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_serve(registry, ids: List[str], state_v1, storm: StormPlan,
+               mttr: Dict[str, Optional[float]]) -> Dict:
+    from tsspark_tpu.resilience.faults import FaultInjected
+    from tsspark_tpu.serve.engine import (
+        BackendUnavailable,
+        EngineOverloaded,
+        ForecastRequest,
+        PredictionEngine,
+        ServeError,
+    )
+    from tsspark_tpu.serve.registry import RegistryError
+
+    prof = storm.profile
+    breaker = CircuitBreaker(failure_threshold=3, reset_timeout_s=0.3,
+                             name="backend")
+    engine = PredictionEngine(
+        registry, max_queue=prof.serve_queue, max_batch=16,
+        retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                                 max_delay_s=0.0),
+        breaker=breaker,
+        registry_breaker=CircuitBreaker(3, 0.3, name="registry"),
+    )
+    snaps: Dict[int, object] = {}
+
+    def snap_of(version: int):
+        if version not in snaps:
+            snaps[version] = registry.load(version, fallback=False)
+        return snaps[version]
+
+    counters = {
+        "requests": 0, "completed": 0, "failed": 0, "fast_failed": 0,
+        "overload_rejected": 0, "parity_checks": 0,
+        "parity_failures": [],
+    }
+    t_first_fail: Optional[float] = None
+    t_recovered: Optional[float] = None
+    t_race: Optional[float] = None
+    race_version: Optional[int] = None
+
+    def check_parity(res, sids, horizon) -> None:
+        snap = snap_of(res.version)
+        grid, direct = _direct_forecast(engine.backend, snap, sids,
+                                        horizon)
+        counters["parity_checks"] += 1
+        if not np.array_equal(np.asarray(res.ds), grid):
+            counters["parity_failures"].append(
+                f"ds mismatch v{res.version} {sids} h={horizon}"
+            )
+        for k, v in direct.items():
+            if not np.array_equal(np.asarray(res.values[k]), v):
+                counters["parity_failures"].append(
+                    f"{k} mismatch v{res.version} {sids} h={horizon}"
+                )
+
+    def attempt(sids, horizon, num_samples=0, seed=0, parity=False):
+        nonlocal t_first_fail, t_recovered
+        counters["requests"] += 1
+        try:
+            res = engine.forecast(sids, horizon,
+                                  num_samples=num_samples, seed=seed,
+                                  timeout_s=30.0)
+        except BackendUnavailable:
+            counters["fast_failed"] += 1
+            if t_first_fail is None:
+                t_first_fail = time.time()
+            return None
+        except (ServeError, RegistryError, FaultInjected):
+            counters["failed"] += 1
+            if t_first_fail is None:
+                t_first_fail = time.time()
+            return None
+        counters["completed"] += 1
+        if t_first_fail is not None and t_recovered is None:
+            t_recovered = time.time()
+        if t_race is not None and "activation-race" not in mttr:
+            mttr["activation-race"] = time.time() - t_race
+        if parity and num_samples == 0:
+            check_parity(res, sids, horizon)
+        return res
+
+    overload = storm.direct("queue-overload")
+    race = storm.direct("activation-race")
+    t0 = time.time()
+    for i in range(prof.loadgen_requests):
+        if overload is not None and i == overload.at_request:
+            t_burst = time.time()
+            rejected = 0
+            pending = []
+            for j in range(prof.serve_queue + 8):
+                try:
+                    pending.append(engine.submit(ForecastRequest.make(
+                        [ids[j % len(ids)]], 5,
+                    )))
+                except EngineOverloaded:
+                    rejected += 1
+            while engine.pump() > 0:
+                pass
+            for p in pending:
+                try:
+                    p.result(0.0)
+                except Exception:
+                    pass  # storm faults may fail some; counted below
+            counters["overload_rejected"] = rejected
+            # Recovery: the queue admits again as soon as it drained.
+            try:
+                ok = engine.submit(ForecastRequest.make([ids[0]], 5))
+                while not ok.done():
+                    engine.pump()
+                mttr["queue-overload"] = time.time() - t_burst
+            except EngineOverloaded:
+                mttr["queue-overload"] = None
+        if race is not None and i == race.at_request:
+            # Publish + activate mid-loadgen: the activation listener
+            # invalidates the cache while dispatches may be in flight —
+            # the exact race the engine's stale-insert guard closes.
+            race_version = registry.publish(
+                state_v1._replace(
+                    theta=np.asarray(state_v1.theta) * 1.02
+                ),
+                ids, step=np.ones(len(ids)),
+            )
+            t_race = time.time()
+        k = 1 + (i % 3)
+        sids = [ids[(i * 7 + j * 3) % len(ids)] for j in range(k)]
+        res = attempt(sids, (5, 7, 12)[i % 3], parity=(i % 4 == 0))
+        if res is None and breaker.state != CircuitBreaker.CLOSED:
+            # A well-behaved client honors the breaker's retry-after
+            # instead of hammering fast-fails; the storm does too, so
+            # the warm loop also exercises the half-open recovery.
+            time.sleep(breaker.retry_after_s() + 0.01)
+
+    # Drain the serve-fault window and watch the breaker cycle all the
+    # way: guaranteed-miss requests (unique sampling seeds) force a
+    # dispatch each round until the armed raise-slots are exhausted, the
+    # breaker has opened at least once, and it has closed again through
+    # a successful half-open trial.
+    extra = 0
+    while (t_first_fail is None or t_recovered is None
+           or breaker.opens == 0
+           or breaker.state != CircuitBreaker.CLOSED) and extra < 80:
+        extra += 1
+        if breaker.state == CircuitBreaker.OPEN:
+            time.sleep(breaker.retry_after_s() + 0.01)
+        attempt([ids[extra % len(ids)]], 5, num_samples=1,
+                seed=10_000 + extra)
+    if t_first_fail is not None:
+        mttr["serve-fault"] = (
+            None if t_recovered is None else t_recovered - t_first_fail
+        )
+    # One final deterministic request on the post-race version closes
+    # the parity loop across the activation flip.
+    attempt([ids[0], ids[1]], 7, parity=True)
+
+    cache_versions = engine.cache.key_versions()
+    active = registry.active_version()
+    return {
+        "wall_s": round(time.time() - t0, 3),
+        "counters": {k: v for k, v in counters.items()
+                     if k != "parity_failures"},
+        "parity_failures": counters["parity_failures"],
+        "engine": engine.stats.snapshot(),
+        "cache": engine.cache.stats(),
+        "breaker": breaker.snapshot(),
+        "breaker_opened": breaker.opens > 0,
+        "race_version": race_version,
+        "cache_key_versions": cache_versions,
+        "active_version": active,
+        "cache_consistent": all(v == active for v in cache_versions),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the storm
+# ---------------------------------------------------------------------------
+
+
+def run_storm(seed: int = 0, profile: str = "full",
+              scratch: Optional[str] = None,
+              keep_scratch: bool = False,
+              deadline_s: float = 600.0) -> Dict:
+    """Run the composed storm end to end; returns the scorecard dict
+    (see ``write_scorecard`` for the file form)."""
+    from tsspark_tpu import orchestrate
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    storm = compose(seed, profile)
+    prof = storm.profile
+    own_scratch = scratch is None
+    scratch = scratch or tempfile.mkdtemp(prefix="tsspark_chaos_")
+    os.makedirs(scratch, exist_ok=True)
+    cfg, solver = _config(prof.max_iters)
+    ds, y = _synthetic_batch(seed, prof.series, prof.days)
+    ids = [f"s{i:04d}" for i in range(prof.series)]
+
+    plan, rule_cls = storm.build_fault_plan(
+        os.path.join(scratch, "faults")
+    )
+    env_old = os.environ.get(faults.ENV_VAR)
+    resident_old = os.environ.get("BENCH_NO_RESIDENT")
+    # Pin ONE phase-2 mechanism for the faulted run and its fault-free
+    # reference: a crash-resumed worker has partial device-resident
+    # coverage and takes the host path, which matches the resident path
+    # only to f32 noise — the bitwise invariant needs both runs on the
+    # same mechanism (same pin as tests/test_resilience.py).
+    os.environ["BENCH_NO_RESIDENT"] = "1"
+    stages: Dict[str, Dict] = {}
+    mttr: Dict[str, Optional[float]] = {}
+    invariants: Dict[str, Dict] = {}
+    try:
+        # ---- stage A: orchestrate under storm ------------------------
+        os.environ[faults.ENV_VAR] = plan.to_env()
+        stages["orchestrate"] = _run_orchestrate(
+            scratch, "storm", ds, y, cfg, solver, storm, deadline_s
+        )
+        t_end_orch = time.time()
+        os.environ.pop(faults.ENV_VAR, None)
+        out_dir = stages["orchestrate"]["out_dir"]
+
+        fired = inv.fault_firing_times(
+            plan.state_dir, rule_cls, plan.rules
+        )
+        orch_classes = {i.cls for i in storm.injections
+                        if i.stage in ("orchestrate",)}
+        mttr.update(inv.orchestrate_mttr(
+            {c: t for c, t in fired.items() if c in orch_classes},
+            out_dir, t_end_orch,
+        ))
+
+        # ---- exactly-once: coverage + bitwise vs fault-free ----------
+        ranges = orchestrate.completed_ranges(out_dir)
+        invariants["series_exactly_once"] = inv.coverage_exactly_once(
+            ranges, prof.series
+        )
+        got_state = orchestrate.load_fit_state(out_dir, prof.series)
+        stages["reference"] = _run_orchestrate(
+            scratch, "reference", ds, y, cfg, solver, storm, deadline_s
+        )
+        ref_state = orchestrate.load_fit_state(
+            stages["reference"]["out_dir"], prof.series
+        )
+        bitwise = inv.states_bitwise_equal(got_state, ref_state)
+        invariants["series_exactly_once"]["bitwise_vs_reference"] = \
+            bitwise
+        invariants["series_exactly_once"]["ok"] &= bitwise["ok"]
+        if not stages["orchestrate"]["complete"]:
+            invariants["series_exactly_once"]["ok"] = False
+            invariants["series_exactly_once"].setdefault(
+                "errors", []
+            ).append("orchestrate run did not complete its coverage")
+
+        # ---- stage B: registry publish + corrupt-active fallback -----
+        os.environ[faults.ENV_VAR] = plan.to_env()
+        registry = ParamRegistry(os.path.join(scratch, "registry"), cfg)
+        v1 = orchestrate.publish_fit_state(
+            registry, out_dir, ids, step=np.ones(prof.series)
+        )
+        v2 = registry.publish(
+            got_state._replace(theta=np.asarray(got_state.theta) * 1.01),
+            ids, step=np.ones(prof.series),
+        )
+        snap_path = os.path.join(
+            registry.root, f"v{v2:06d}", "state.npz"
+        )
+        corrupted = faults.corrupt_file(REGISTRY_SNAPSHOT_POINT,
+                                        snap_path)
+        t_corrupt = time.time()
+        import warnings as _warnings
+
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", RuntimeWarning)
+            fb_snap = registry.load()
+        mttr["registry-corrupt"] = time.time() - t_corrupt
+        invariants["registry_fallback"] = {
+            "ok": (corrupted and fb_snap.version == v1
+                   and fb_snap.fallback_from == v2),
+            "corrupt_version": v2,
+            "served_version": fb_snap.version,
+            "fallback_from": fb_snap.fallback_from,
+        }
+        stages["registry"] = {"v1": v1, "v2_corrupt": v2,
+                              "fallback_served": fb_snap.version}
+
+        # ---- stage C: streaming under storm --------------------------
+        stages["streaming"] = _run_streaming(registry, cfg, storm, seed)
+        stream_fired = inv.fault_firing_times(
+            plan.state_dir, rule_cls, plan.rules
+        ).get("stream-fault", [])
+        if stream_fired:
+            end = stages["streaming"]["end_time"]
+            mttr["stream-fault"] = max(
+                (end - t for t in stream_fired), default=None
+            )
+
+        # ---- stage D: engine loadgen under storm ---------------------
+        registry.activate(v1)  # loadgen runs over the full batch
+        stages["serve"] = _run_serve(registry, ids, got_state, storm,
+                                     mttr)
+
+        # ---- cross-stage invariants ----------------------------------
+        corrupt_injected = sum(
+            1 for i in storm.injections
+            if i.mode == "corrupt" and i.stage == "orchestrate"
+        )
+        invariants["no_torn_reads"] = inv.no_torn_reads(
+            out_dir, corrupt_injected
+        )
+        # The registry side of no-torn-reads: the corrupt snapshot was
+        # never parsed into forecasts (fallback invariant above).
+        invariants["no_torn_reads"]["ok"] &= \
+            invariants["registry_fallback"]["ok"]
+
+        serve = stages["serve"]
+        invariants["engine_direct_parity"] = {
+            "ok": (not serve["parity_failures"]
+                   and serve["counters"]["parity_checks"] > 0),
+            "requests_checked": serve["counters"]["parity_checks"],
+            "failures": serve["parity_failures"],
+        }
+        invariants["cache_version_consistent"] = {
+            "ok": serve["cache_consistent"],
+            "cache_key_versions": serve["cache_key_versions"],
+            "active_version": serve["active_version"],
+        }
+        invariants["breaker_cycled"] = {
+            "ok": serve["breaker_opened"]
+            and serve["breaker"]["state"] == "closed",
+            "breaker": serve["breaker"],
+        }
+
+        fired_final = inv.fault_firing_times(
+            plan.state_dir, rule_cls, plan.rules
+        )
+        recovery_classes = set(fired_final) | {
+            i.cls for i in storm.injections if i.mode == "direct"
+        }
+        invariants["recovery_within_budget"] = \
+            inv.recovery_within_budget(
+                {c: mttr.get(c) for c in sorted(recovery_classes)},
+                prof.recovery_budget_s,
+            )
+        per_class = {}
+        for c, js in storm.by_class().items():
+            if js[0].mode == "direct":
+                planned = fired_n = len(js)
+            else:
+                planned = sum(j.attempts for j in js)
+                fired_n = len(fired_final.get(c, []))
+            per_class[c] = {"planned": planned, "fired": fired_n}
+        ok = all(v.get("ok") for v in invariants.values())
+        report = {
+            "kind": "chaos-storm",
+            "unix": round(time.time(), 3),
+            "seed": seed,
+            "profile": profile,
+            "workload": {
+                "series": prof.series, "days": prof.days,
+                "chunk": prof.chunk, "max_iters": prof.max_iters,
+                "phase1_iters": prof.phase1_iters,
+                "loadgen_requests": prof.loadgen_requests,
+            },
+            "schedule": storm.schedule(),
+            "fault_classes": sorted(storm.by_class()),
+            "faults": per_class,
+            "stages": {k: {kk: vv for kk, vv in v.items()
+                           if kk not in ("out_dir", "end_time")}
+                       for k, v in stages.items()},
+            "invariants": invariants,
+            "mttr_s": {k: (None if v is None else round(v, 3))
+                       for k, v in mttr.items()},
+            "ok": ok,
+        }
+        return report
+    finally:
+        if env_old is None:
+            os.environ.pop(faults.ENV_VAR, None)
+        else:
+            os.environ[faults.ENV_VAR] = env_old
+        if resident_old is None:
+            os.environ.pop("BENCH_NO_RESIDENT", None)
+        else:
+            os.environ["BENCH_NO_RESIDENT"] = resident_old
+        if own_scratch and not keep_scratch:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+def write_scorecard(report: Dict, path: Optional[str] = None) -> str:
+    """Persist a storm scorecard as ``CHAOS_<unix>.json`` (atomic, like
+    every other report artifact)."""
+    out = path or f"CHAOS_{int(report.get('unix', time.time()))}.json"
+    atomic_write(out, lambda fh: json.dump(report, fh, indent=1),
+                 mode="w")
+    return out
+
+
+def summarize(report: Dict) -> str:
+    """One operator-facing line per storm (the CLI's stdout)."""
+    invs = report["invariants"]
+    bad = [k for k, v in invs.items() if not v.get("ok")]
+    mttr = ", ".join(
+        f"{k}={v}s" for k, v in sorted(report["mttr_s"].items())
+        if v is not None
+    )
+    return (
+        f"chaos storm seed={report['seed']} profile={report['profile']}: "
+        f"{len(report['fault_classes'])} fault classes, "
+        f"{len(invs)} invariants "
+        f"{'ALL GREEN' if report['ok'] else 'FAILED: ' + ', '.join(bad)}"
+        f" | mttr: {mttr or 'n/a'}"
+    )

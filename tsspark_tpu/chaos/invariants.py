@@ -1,0 +1,176 @@
+"""Chaos invariant checkers: what "survived the storm" means, verified.
+
+Each checker returns a JSON-able dict with an ``ok`` bool plus the
+evidence behind it; the harness composes them into the ``CHAOS_*``
+scorecard.  The four invariant families (ISSUE 5):
+
+* **exactly-once** — every series lands exactly once: completed chunk
+  ranges are pairwise disjoint AND tile ``[0, series)`` with no gap or
+  overlap, and the assembled state is bitwise identical to a fault-free
+  reference run (loss, duplication, or a double-landed stale result
+  would all break bitwise equality).
+* **no-torn-reads** — the CRC + atomic-write protocol held: every
+  corruption the storm injected was quarantined (``*.corrupt``) rather
+  than assembled, and no dead writer's atomic-write temp survives the
+  sweeps.
+* **parity** — engine-batched forecasts stay bitwise equal to a direct
+  ``backend.predict`` over the same snapshot rows, throughout the storm.
+* **recovery** — the measured time from each injected fault to the next
+  healthy signal (MTTR) stays under the profile's budget.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tsspark_tpu.utils.atomic import sweep_stale_temps
+
+_STATE_FIELDS = ("theta", "loss", "grad_norm", "converged", "n_iters",
+                 "status")
+
+
+def coverage_exactly_once(ranges: List[Tuple[int, int]],
+                          series: int) -> Dict:
+    """Ranges must tile [0, series) with no gap, overlap, or overhang —
+    the file-level form of "every series landed exactly once"."""
+    errs: List[str] = []
+    cur = 0
+    for lo, hi in sorted(ranges):
+        if lo < cur:
+            errs.append(f"overlap at {lo} (covered through {cur}): a "
+                        "series row would be assembled twice")
+        elif lo > cur:
+            errs.append(f"gap [{cur}, {lo}): series lost")
+        cur = max(cur, hi)
+    if cur < series:
+        errs.append(f"gap [{cur}, {series}): series lost")
+    elif cur > series:
+        errs.append(f"coverage overhangs to {cur} > {series}")
+    return {"ok": not errs, "series": series,
+            "ranges": [list(r) for r in sorted(ranges)], "errors": errs}
+
+
+def states_bitwise_equal(got, ref,
+                         skip_rows: Optional[np.ndarray] = None) -> Dict:
+    """Bitwise comparison of two assembled FitStates (solver outputs +
+    scaling meta).  ``skip_rows``: rows excluded from the comparison
+    (quarantined series, which a faulted run deliberately NaNs)."""
+    n = int(np.asarray(ref.theta).shape[0])
+    rows = np.ones(n, bool)
+    if skip_rows is not None and len(skip_rows):
+        rows[np.asarray(skip_rows, np.int64)] = False
+    mismatches: List[str] = []
+
+    def cmp(name, a, b):
+        a = np.asarray(a)[rows]
+        b = np.asarray(b)[rows]
+        if a.shape != b.shape or not np.array_equal(a, b):
+            mismatches.append(name)
+
+    for f in _STATE_FIELDS:
+        ga, rf = getattr(got, f, None), getattr(ref, f, None)
+        if ga is None or rf is None:
+            continue
+        cmp(f, ga, rf)
+    for f in ref.meta._fields:
+        cmp(f"meta.{f}", getattr(got.meta, f), getattr(ref.meta, f))
+    return {"ok": not mismatches, "rows_compared": int(rows.sum()),
+            "mismatched_fields": mismatches}
+
+
+def no_torn_reads(out_dir: str, corrupt_injected: int) -> Dict:
+    """The integrity protocol's evidence after the storm: every injected
+    corruption was quarantined out of the resume globs, and no dead
+    writer's atomic temp survived the sweeps (a zero-age sweep here
+    counts AND removes any orphan the run left behind)."""
+    quarantined = sorted(
+        os.path.basename(p)
+        for p in glob.glob(os.path.join(out_dir, "*.corrupt"))
+    )
+    stale_temps = sweep_stale_temps(out_dir, max_age_s=0.0,
+                                    recursive=True)
+    ok = len(quarantined) >= corrupt_injected
+    return {
+        "ok": ok,
+        "corrupt_injected": corrupt_injected,
+        "quarantined": quarantined,
+        "stale_temps_reaped": stale_temps,
+        "errors": ([] if ok else [
+            f"{corrupt_injected} corruption(s) injected but only "
+            f"{len(quarantined)} quarantined file(s) found — a torn "
+            "payload may have been read"
+        ]),
+    }
+
+
+def recovery_within_budget(mttr_s: Dict[str, Optional[float]],
+                           budget_s: float) -> Dict:
+    """Every fault class that fired must have recovered within the
+    budget; a class with no recovery signal (None) is a failure."""
+    errs = []
+    for cls, t in mttr_s.items():
+        if t is None:
+            errs.append(f"{cls}: no recovery observed")
+        elif t > budget_s:
+            errs.append(f"{cls}: recovered in {t:.1f}s > budget "
+                        f"{budget_s:.0f}s")
+    return {
+        "ok": not errs,
+        "budget_s": budget_s,
+        "mttr_s": {k: (None if v is None else round(v, 3))
+                   for k, v in mttr_s.items()},
+        "errors": errs,
+    }
+
+
+def fault_firing_times(state_dir: str, rule_cls: Dict[str, str],
+                       rules: List[dict]) -> Dict[str, List[float]]:
+    """Per-class wall-clock firing times, read off the fault plan's
+    claim files: slot ``n`` of rule ``r`` fired iff
+    ``after <= n < after + attempts`` and its claim file exists — the
+    file's mtime is the moment the call was armed, no matter which
+    process made it."""
+    out: Dict[str, List[float]] = {}
+    for rule in rules:
+        cls = rule_cls.get(rule["id"])
+        if cls is None:
+            continue
+        for n in range(rule["after"], rule["after"] + rule["attempts"]):
+            path = os.path.join(state_dir, f"{rule['id']}.{n}")
+            try:
+                out.setdefault(cls, []).append(os.path.getmtime(path))
+            except OSError:
+                continue  # slot never reached: the fault did not fire
+    return out
+
+
+def orchestrate_mttr(fired: Dict[str, List[float]], out_dir: str,
+                     end_time: float) -> Dict[str, Optional[float]]:
+    """MTTR for the orchestrate-stage classes: time from each firing to
+    the next chunk result landing after it (the pipeline's "healthy
+    again" signal), the phase-2 sentinel, or the stage end."""
+    progress = sorted(
+        os.path.getmtime(p)
+        for p in glob.glob(os.path.join(out_dir, "chunk_*.npz"))
+    )
+    marker = os.path.join(out_dir, "phase2_done")
+    if os.path.exists(marker):
+        progress.append(os.path.getmtime(marker))
+    progress.sort()
+    out: Dict[str, Optional[float]] = {}
+    for cls, times in fired.items():
+        worst: Optional[float] = 0.0
+        for t in times:
+            nxt = next((p for p in progress if p > t), None)
+            if nxt is None:
+                nxt = end_time if end_time > t else None
+            if nxt is None:
+                worst = None
+                break
+            worst = max(worst, nxt - t)
+        out[cls] = worst
+    return out

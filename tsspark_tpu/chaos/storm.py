@@ -1,0 +1,221 @@
+"""Seeded fault-storm composition.
+
+A storm is a deterministic function of ``(seed, profile)``: which fault
+classes fire, at which injection points, with which windows and
+parameters.  The schedule is data (``Injection`` rows recorded verbatim
+in the ``CHAOS_*`` scorecard), so the same seed reproduces the same
+storm on any machine — the property that turns "survived a fault storm"
+from an anecdote into a regression gate.
+
+Two kinds of injection:
+
+* env-plan injections ride the resilience fault harness
+  (``resilience.faults.FaultPlan`` via ``TSSPARK_FAULTS``), so the
+  orchestrator's CHILD processes see the same storm the harness armed;
+* direct injections (serve-queue overload bursts, mid-loadgen
+  activation races) are performed by the harness itself at
+  deterministic request indices.
+
+Fault classes (docs/RESILIENCE.md "Chaos harness & failure domains"):
+
+  worker-kill      fit worker dies (os._exit) right after landing a chunk
+  torn-artifact    a just-saved chunk file is silently byte-flipped
+  spawn-fail       a worker spawn fails before the child starts
+  slow-io          a chunk fit stalls (sleep) — latency, not failure
+  wedged-client    the accelerator probe reports a wedge (full profile)
+  registry-corrupt the ACTIVE registry snapshot npz is byte-flipped
+  stream-fault     streaming source polls raise transiently
+  serve-fault      engine predict dispatches raise until the breaker opens
+  queue-overload   a request burst exceeds the engine's bounded queue
+  activation-race  a publish+activate lands mid-loadgen, racing the cache
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Dict, List, Optional, Tuple
+
+from tsspark_tpu.resilience.faults import FaultPlan
+
+#: Injection point used for the registry-snapshot corruption (the
+#: harness calls ``faults.corrupt_file`` on the active version's npz —
+#: same exempt, deterministic corruption machinery as chunk files).
+REGISTRY_SNAPSHOT_POINT = "registry_snapshot"
+
+
+@dataclasses.dataclass(frozen=True)
+class Injection:
+    """One scheduled fault.  ``point`` is a resilience.faults injection
+    point for env-plan rows, or a symbolic name for direct ones."""
+
+    cls: str                  # fault class (scorecard key)
+    stage: str                # orchestrate | registry | streaming | serve
+    point: str
+    mode: str                 # faults mode, or "direct"
+    after: int = 0
+    attempts: int = 1
+    series: Optional[int] = None
+    rc: int = 23
+    delay_s: float = 0.0
+    at_request: Optional[int] = None   # direct serve injections
+
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+@dataclasses.dataclass(frozen=True)
+class StormProfile:
+    """Workload + storm sizing for one harness run."""
+
+    name: str
+    series: int
+    days: int
+    chunk: int
+    max_iters: int
+    phase1_iters: int
+    stream_series: int
+    stream_batches: int
+    loadgen_requests: int
+    serve_queue: int
+    probe_accelerator: bool      # arm wedged-client (real probe loop)
+    recovery_budget_s: float
+
+
+PROFILES: Dict[str, StormProfile] = {
+    # Small storm for the tier-1 smoke: one worker kill, everything on
+    # CPU, sized to finish in seconds once compile caches are warm.
+    "smoke": StormProfile(
+        name="smoke", series=16, days=64, chunk=8, max_iters=20,
+        phase1_iters=0, stream_series=2, stream_batches=2,
+        loadgen_requests=24, serve_queue=16, probe_accelerator=False,
+        recovery_budget_s=90.0,
+    ),
+    # The acceptance storm (python -m tsspark_tpu.chaos --seed 0):
+    # two-phase orchestrate, probe loop included, longer loadgen.
+    "full": StormProfile(
+        name="full", series=32, days=96, chunk=8, max_iters=40,
+        phase1_iters=6, stream_series=3, stream_batches=3,
+        loadgen_requests=160, serve_queue=24, probe_accelerator=True,
+        recovery_budget_s=150.0,
+    ),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class StormPlan:
+    """The composed storm: every injection, in a deterministic order
+    (env-plan rows keep their FaultPlan rule ids by position)."""
+
+    seed: int
+    profile: StormProfile
+    injections: Tuple[Injection, ...]
+
+    def env_injections(self) -> List[Injection]:
+        return [i for i in self.injections if i.mode != "direct"]
+
+    def direct(self, cls: str) -> Optional[Injection]:
+        for i in self.injections:
+            if i.cls == cls and i.mode == "direct":
+                return i
+        return None
+
+    def by_class(self) -> Dict[str, List[Injection]]:
+        out: Dict[str, List[Injection]] = {}
+        for i in self.injections:
+            out.setdefault(i.cls, []).append(i)
+        return out
+
+    def build_fault_plan(self, state_dir: str) -> Tuple[FaultPlan,
+                                                        Dict[str, str]]:
+        """(FaultPlan, {rule_id: fault class}) for the env-plan rows.
+        Rule ids are positional (``r<i>_<point>``), so the id->class map
+        is exact — the MTTR scan reads firing times off the rule ids'
+        claim files."""
+        plan = FaultPlan(state_dir=state_dir)
+        rule_cls: Dict[str, str] = {}
+        for inj in self.env_injections():
+            plan.fail(
+                inj.point, attempts=inj.attempts, after=inj.after,
+                mode=inj.mode, series=inj.series, rc=inj.rc,
+                delay_s=inj.delay_s or 0.5,
+            )
+            rule_cls[plan.rules[-1]["id"]] = inj.cls
+        return plan, rule_cls
+
+    def schedule(self) -> List[Dict]:
+        """JSON-able schedule (the scorecard's reproducibility record)."""
+        return [i.to_dict() for i in self.injections]
+
+
+def compose(seed: int, profile: str = "full") -> StormPlan:
+    """Compose the storm for ``(seed, profile)``.  Pure function of its
+    arguments: every parameter below comes from one string-seeded RNG,
+    so replays schedule identical injections."""
+    prof = PROFILES[profile]
+    rng = random.Random(f"tsspark-chaos:{seed}:{profile}")
+    inj: List[Injection] = []
+
+    # -- orchestrate stage (env plan; children inherit it) ------------
+    n_chunks = max(1, prof.series // prof.chunk)
+    inj.append(Injection(
+        cls="worker-kill", stage="orchestrate", point="fit_worker_chunk",
+        mode="exit", after=rng.randrange(0, max(1, n_chunks - 1)),
+        attempts=1, rc=rng.choice((17, 23, 29)),
+    ))
+    inj.append(Injection(
+        cls="torn-artifact", stage="orchestrate", point="chunk_save",
+        mode="corrupt", series=rng.randrange(prof.series), attempts=1,
+    ))
+    inj.append(Injection(
+        cls="spawn-fail", stage="orchestrate", point="worker_spawn",
+        mode="flag", after=0, attempts=1,
+    ))
+    inj.append(Injection(
+        cls="slow-io", stage="orchestrate", point="fit_chunk",
+        mode="sleep", after=rng.randrange(0, n_chunks), attempts=1,
+        delay_s=round(rng.uniform(0.2, 0.6), 3),
+    ))
+    if prof.probe_accelerator:
+        inj.append(Injection(
+            cls="wedged-client", stage="orchestrate", point="device_probe",
+            mode="flag", after=0, attempts=rng.choice((1, 2)),
+        ))
+
+    # -- registry stage (corruption via the exempt fault machinery) ---
+    inj.append(Injection(
+        cls="registry-corrupt", stage="registry",
+        point=REGISTRY_SNAPSHOT_POINT, mode="corrupt", attempts=1,
+    ))
+
+    # -- streaming stage ----------------------------------------------
+    inj.append(Injection(
+        cls="stream-fault", stage="streaming", point="stream_poll",
+        mode="raise", after=rng.randrange(0, 2),
+        attempts=rng.choice((1, 2)),
+    ))
+
+    # -- serve stage --------------------------------------------------
+    # serve-fault sizing opens the dispatch breaker deliberately: the
+    # engine retries each dispatch twice (harness policy), the breaker
+    # threshold is 3, so 6 armed raise-slots = exactly 3 failed
+    # dispatches = the breaker opens on the last one, then the storm
+    # watches it recover through half-open.
+    fault_start = rng.randrange(4, 8)
+    inj.append(Injection(
+        cls="serve-fault", stage="serve", point="serve_predict",
+        mode="raise", after=fault_start, attempts=6,
+    ))
+    third = max(4, prof.loadgen_requests // 3)
+    inj.append(Injection(
+        cls="queue-overload", stage="serve", point="submit-burst",
+        mode="direct", at_request=rng.randrange(2, third),
+    ))
+    inj.append(Injection(
+        cls="activation-race", stage="serve", point="publish-activate",
+        mode="direct",
+        at_request=rng.randrange(2 * third, prof.loadgen_requests - 2),
+    ))
+
+    return StormPlan(seed=seed, profile=prof, injections=tuple(inj))

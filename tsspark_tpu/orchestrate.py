@@ -340,6 +340,154 @@ def load_prep(out_dir, lo, hi, chunk=None):
         return None
 
 
+# --------------------------------------------------------------------------
+# lease-fenced range claims
+# --------------------------------------------------------------------------
+#
+# plan_chunks keeps claims disjoint WITHIN one worker's view, but a
+# watchdog-killed worker's half-finished range used to be reclaimable the
+# instant the parent respawned — and a predecessor that was stalled (not
+# dead) when the watchdog gave up on it could still flush its result
+# later, double-landing the range.  Leases close that window: a worker
+# claims ``lease_<lo>_<hi>.json`` (atomic O_EXCL create) before fitting a
+# range, may steal only a STALE lease (owner pid dead, or expiry passed
+# — the watchdog's kill is SIGKILL, so dead-pid reclaim is immediate),
+# and re-checks that it still holds the lease token immediately before
+# saving the chunk: a worker whose lease was stolen discards its result
+# instead of racing the thief's save (fencing).  A torn lease file (its
+# writer died inside the O_EXCL create) reads as stale and is stolen
+# atomically via os.replace.
+
+#: A lease outlives any healthy chunk fit (the stall watchdog kills a
+#: silent worker long before this), but a crashed owner is reclaimed
+#: immediately via the dead-pid check — expiry only backstops the
+#: pid-reuse corner.
+LEASE_TTL_S = 600.0
+
+
+def _lease_path(out_dir: str, lo: int, hi: int) -> str:
+    return os.path.join(out_dir, f"lease_{lo:06d}_{hi:06d}.json")
+
+
+def read_lease(out_dir: str, lo: int, hi: int) -> Optional[dict]:
+    """The current lease record, or None when absent/torn (both mean
+    claimable)."""
+    try:
+        with open(_lease_path(out_dir, lo, hi)) as fh:
+            d = json.load(fh)
+        return d if isinstance(d, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _lease_stale(lease: dict) -> bool:
+    if time.time() >= float(lease.get("expires_unix", 0.0)):
+        return True
+    pid = int(lease.get("pid", -1))
+    if pid > 0 and pid != os.getpid():
+        try:
+            os.kill(pid, 0)  # liveness probe only (signal 0 sends nothing)
+        except OSError:
+            return True  # owner process is gone; its lease is dead
+    return False
+
+
+def _live_overlapping_lease(out_dir: str, lo: int, hi: int,
+                            token: str) -> bool:
+    """True when another worker's LIVE lease overlaps ``[lo, hi)`` on a
+    DIFFERENT range name.  Lease files are keyed by exact range, but
+    claim grids differ across workers (tuner-sized claims, the parent's
+    chunk halving) — without this scan two workers could hold
+    non-conflicting lease files over overlapping series and double-land
+    them.
+
+    A STALE overlapping lease does not block — and is REMOVED here:
+    claiming over it must fence its (dead or expired) owner, whose
+    save-time ``holds_lease`` checks its own exact file, not ours."""
+    for p in glob.glob(os.path.join(out_dir, "lease_*.json")):
+        stem = os.path.basename(p)[len("lease_"):-len(".json")]
+        try:
+            l2, h2 = (int(x) for x in stem.split("_"))
+        except ValueError:
+            continue  # foreign file name matched the glob
+        if (l2, h2) == (lo, hi) or not (l2 < hi and lo < h2):
+            continue
+        try:
+            with open(p) as fh:
+                cur = json.load(fh)
+        except ValueError:
+            cur = None  # torn record reads as stale
+        except OSError:
+            continue  # already gone
+        if isinstance(cur, dict) and cur.get("token") == token:
+            continue  # our own coverage at another width
+        if isinstance(cur, dict) and not _lease_stale(cur):
+            return True
+        try:
+            os.remove(p)  # fence the stale owner out of its save
+        except OSError:
+            pass
+    return False
+
+
+def claim_lease(out_dir: str, lo: int, hi: int, token: str,
+                ttl_s: float = LEASE_TTL_S) -> bool:
+    """Claim the fit lease on range ``[lo, hi)``.
+
+    Returns True when this ``token`` now holds the lease (fresh claim,
+    renewal of its own lease, or steal of a stale one); False when a
+    LIVE lease belongs to another worker — on this exact range OR any
+    overlapping one (claim grids differ across workers).  The
+    fresh-claim path is an atomic ``O_CREAT|O_EXCL``; steals/renewals
+    replace the file atomically (utils.atomic), so a concurrent reader
+    sees the old record or the new one, never a torn mix."""
+    if _live_overlapping_lease(out_dir, lo, hi, token):
+        return False
+    path = _lease_path(out_dir, lo, hi)
+    payload = json.dumps({
+        "token": token, "pid": os.getpid(),
+        "expires_unix": round(time.time() + ttl_s, 3),
+    })
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        with os.fdopen(fd, "w") as fh:
+            fh.write(payload)
+        return True
+    except FileExistsError:
+        pass
+    except OSError:
+        return False  # unwritable out dir: claim fails soft
+    cur = read_lease(out_dir, lo, hi)
+    if cur is not None and cur.get("token") != token \
+            and not _lease_stale(cur):
+        return False
+    # Own lease (renew), stale lease (steal), or torn record: replace
+    # whole.  Two racers both seeing "stale" both replace — last rename
+    # wins whole, and the loser is fenced out at save time by
+    # holds_lease, so the range still lands exactly once.
+    atomic_write(path, lambda fh: fh.write(payload), mode="w")
+    return True
+
+
+def holds_lease(out_dir: str, lo: int, hi: int, token: str) -> bool:
+    """Fencing check: does ``token`` still own the range?  Run
+    immediately before a chunk save — a worker whose lease was stolen
+    (it stalled past reclaim) must discard its result, not race the
+    thief's save."""
+    cur = read_lease(out_dir, lo, hi)
+    return cur is not None and cur.get("token") == token
+
+
+def release_lease(out_dir: str, lo: int, hi: int, token: str) -> None:
+    """Drop the lease after its chunk landed (only the holder's token
+    may release — a thief's lease is never yanked by the fenced loser)."""
+    if holds_lease(out_dir, lo, hi, token):
+        try:
+            os.remove(_lease_path(out_dir, lo, hi))
+        except OSError:
+            pass
+
+
 def completed_ranges(out_dir: str):
     done = []
     for f in glob.glob(os.path.join(out_dir, "chunk_*.npz")):
@@ -574,19 +722,25 @@ def fit_worker(args) -> int:
     # With the tuner each claim is sized at submit time, so the claim
     # grid follows the learned chunk size mid-run; locally-claimed
     # ranges count as covered because the writer thread may not have
-    # flushed their files yet.
+    # flushed their files yet.  Every claim is additionally LEASED
+    # (claim_lease): a range a live sibling holds is skipped, a dead
+    # predecessor's range is stolen, and the save path re-checks the
+    # lease token so a stalled worker whose range was reclaimed can
+    # never double-land it.
     claimed: List[Tuple[int, int]] = []
+    lease_token = f"{os.getpid()}.{int(t_worker0 * 1e3)}"
 
     def next_claim():
         width = tuner.next_size() if tuner is not None else args.chunk
         todo2 = plan_chunks(
             completed_ranges(args.out) + claimed, args.lo, args.hi, width
         )
-        if not todo2:
-            return None
-        lo2, hi2 = todo2[0]
-        claimed.append((lo2, hi2))
-        return lo2, hi2, width
+        for lo2, hi2 in todo2:
+            if not claim_lease(args.out, lo2, hi2, lease_token):
+                continue  # a LIVE sibling owns this range; leave it
+            claimed.append((lo2, hi2))
+            return lo2, hi2, width
+        return None
 
     prefetch_depth = 3
     # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
@@ -620,7 +774,18 @@ def fit_worker(args) -> int:
         width, live series, series/s, compile-miss, and the wall offset
         of the flush — what bench.py folds into BENCH extras via
         ``perf.summarize_times``."""
+        if not holds_lease(args.out, lo, hi, lease_token):
+            # Fenced: this worker stalled long enough for its lease to
+            # be reclaimed — the range belongs to the thief now, and
+            # saving here would double-land it (or clobber the thief's
+            # freshly saved result with a stale one).
+            print(
+                f"[orchestrate] lease on [{lo}, {hi}) lost; discarding "
+                f"this worker's result (fenced)", file=sys.stderr,
+            )
+            return
         save_chunk_atomic(args.out, lo, hi, state)
+        release_lease(args.out, lo, hi, lease_token)
         try:  # prep payload served its purpose; bound scratch disk
             os.remove(_prep_path(args.out, lo, hi))
         except OSError:
@@ -787,6 +952,14 @@ def fit_worker(args) -> int:
 
     # ---- phase 2: compacted straggler pass over the whole series range ----
     marker = os.path.join(args.out, "phase2_done")
+    # Quarantine anything corrupted DURING this worker's own phase 1 (a
+    # torn save or media fault the start-of-run sweep could not have
+    # seen): phase 2 np.loads every chunk file — a corrupt one used to
+    # kill the worker that had just fit it (found by the chaos harness)
+    # — and the single-phase marker below must never certify coverage
+    # that includes a corrupt file.
+    if integrity.sweep_chunks(args.out):
+        return 0  # ranges re-queued; the parent's rescan refits them
     if not two_phase:
         # Single-phase run (phase1_iters == 0 OR >= full depth): there is
         # no phase-2 work, but the parent's pending check only knows

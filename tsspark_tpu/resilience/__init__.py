@@ -10,7 +10,12 @@ place that behavior is defined:
                  deterministic jitter, per-attempt deadlines, total
                  budget.  Replaces the ad-hoc sleep/retry constants that
                  used to be scattered through ``orchestrate.py`` and the
-                 streaming poll loops.
+                 streaming poll loops.  ``CircuitBreaker``: closed/open/
+                 half-open failure gate that remembers failures ACROSS
+                 calls, so a dead dependency is shed fast instead of
+                 retried to every caller's deadline (wired into the
+                 streaming poll, the serve engine's dispatch, and its
+                 registry polling).
   faults.py    — ``FaultPlan`` / ``inject``: env-driven, deterministic
                  fault injection at named points (worker spawn, device
                  probe, chunk save, chunk fit, streaming poll), so every
@@ -29,7 +34,11 @@ See ``docs/RESILIENCE.md`` for the operator-facing walkthrough.
 
 from tsspark_tpu.resilience.faults import FaultInjected, FaultPlan, inject
 from tsspark_tpu.resilience.integrity import ChunkIntegrityError
-from tsspark_tpu.resilience.policy import RetryPolicy
+from tsspark_tpu.resilience.policy import (
+    CircuitBreaker,
+    CircuitOpen,
+    RetryPolicy,
+)
 from tsspark_tpu.resilience.report import (
     QuarantineRecord,
     ResilienceReport,
@@ -41,6 +50,8 @@ from tsspark_tpu.resilience.report import (
 
 __all__ = [
     "ChunkIntegrityError",
+    "CircuitBreaker",
+    "CircuitOpen",
     "FaultInjected",
     "FaultPlan",
     "QuarantineRecord",

@@ -36,11 +36,12 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import time
 from typing import Dict, List, Optional
 
 ENV_VAR = "TSSPARK_FAULTS"
 
-_MODES = ("raise", "exit", "flag", "corrupt")
+_MODES = ("raise", "exit", "flag", "corrupt", "sleep")
 
 # Guard against a runaway call counter chewing the state dir: no test
 # plan legitimately sees this many calls at one point.
@@ -77,11 +78,14 @@ class FaultPlan:
                  simulates a worker death), "flag" (``inject`` returns
                  True; the site fails soft, e.g. a probe returning
                  False), "corrupt" (``corrupt_file`` flips bytes in the
-                 file the site just wrote).
+                 file the site just wrote), "sleep" (``inject`` stalls
+                 ``delay_s`` seconds, then lets the call proceed — a
+                 slow-I/O / slow-dependency simulation, not a failure).
       series   — only fire when the call's ``(lo, hi)`` context covers
                  this series index (how a poison SERIES is simulated:
                  the chunk containing it dies wherever it lands).
       rc       — exit code for "exit" mode.
+      delay_s  — stall duration for "sleep" mode.
     """
 
     def __init__(self, state_dir: Optional[str] = None):
@@ -92,7 +96,7 @@ class FaultPlan:
 
     def fail(self, point: str, *, attempts: int = 1, after: int = 0,
              mode: str = "raise", series: Optional[int] = None,
-             rc: int = 23) -> "FaultPlan":
+             rc: int = 23, delay_s: float = 0.5) -> "FaultPlan":
         if mode not in _MODES:
             raise ValueError(f"mode {mode!r} not in {_MODES}")
         if attempts < 1 or after < 0:
@@ -101,6 +105,7 @@ class FaultPlan:
             "id": f"r{len(self.rules)}_{point}",
             "point": point, "attempts": int(attempts), "after": int(after),
             "mode": mode, "series": series, "rc": int(rc),
+            "delay_s": float(delay_s),
         })
         return self
 
@@ -194,6 +199,12 @@ def inject(point: str, *, lo: Optional[int] = None,
             os._exit(rule["rc"])
         if rule["mode"] == "raise":
             raise FaultInjected(point, rule["id"])
+        if rule["mode"] == "sleep":
+            # A stall, not a failure: the call proceeds after the delay
+            # (and the site is NOT flagged), so the only observable
+            # effect is latency — exactly what slow media/IO looks like.
+            time.sleep(float(rule.get("delay_s", 0.5)))
+            continue
         flagged = True
     return flagged
 

@@ -20,8 +20,124 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import random
+import threading
 import time
 from typing import Callable, Optional, Tuple, Type
+
+
+class CircuitOpen(RuntimeError):
+    """Raised when a call is refused because its circuit breaker is open:
+    the dependency has failed enough in a row that retrying it before the
+    reset window elapses only burns the caller's deadline."""
+
+    def __init__(self, name: str, retry_after_s: float):
+        super().__init__(
+            f"circuit for {name!r} is open; retry in {retry_after_s:.2f}s"
+        )
+        self.name = name
+        self.retry_after_s = retry_after_s
+
+
+class CircuitBreaker:
+    """Closed / open / half-open failure gate for one dependency.
+
+    A ``RetryPolicy`` bounds how long ONE call keeps trying; a breaker
+    remembers failures ACROSS calls, so a dead dependency (wedged
+    accelerator client, corrupt registry, downed broker) is shed fast —
+    ``allow()`` returns False for ``reset_timeout_s`` after
+    ``failure_threshold`` consecutive failures — instead of every caller
+    independently retrying to its deadline.  After the window one
+    half-open trial call probes the dependency: its success closes the
+    circuit, its failure re-opens it for another window.
+
+    Thread-safe (the serving engine's pump and a publisher thread may
+    race it).  ``clock`` injects a fake time source for tests; state is
+    derived from the clock on demand, so an idle breaker transitions
+    open -> half-open without a background timer.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, failure_threshold: int = 5,
+                 reset_timeout_s: float = 30.0, name: str = "dependency",
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout_s = float(reset_timeout_s)
+        self.name = name
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._opened_at: Optional[float] = None
+        self._trial_inflight = False
+        # Observability counters (chaos scorecards, engine stats).
+        self.opens = 0
+        self.fast_fails = 0
+
+    def _state_locked(self) -> str:
+        if self._opened_at is None:
+            return self.CLOSED
+        if self._clock() - self._opened_at >= self.reset_timeout_s:
+            return self.HALF_OPEN
+        return self.OPEN
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def allow(self) -> bool:
+        """May a call proceed right now?  Half-open admits exactly one
+        trial at a time; refusals are counted in ``fast_fails``."""
+        with self._lock:
+            st = self._state_locked()
+            if st == self.CLOSED:
+                return True
+            if st == self.HALF_OPEN and not self._trial_inflight:
+                self._trial_inflight = True
+                return True
+            self.fast_fails += 1
+            return False
+
+    def retry_after_s(self) -> float:
+        """Seconds until the next half-open trial would be admitted."""
+        with self._lock:
+            if self._opened_at is None:
+                return 0.0
+            return max(
+                0.0,
+                self.reset_timeout_s - (self._clock() - self._opened_at),
+            )
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._trial_inflight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            st = self._state_locked()
+            self._failures += 1
+            if st == self.HALF_OPEN or (
+                st == self.CLOSED
+                and self._failures >= self.failure_threshold
+            ):
+                self._opened_at = self._clock()
+                self._trial_inflight = False
+                self.opens += 1
+
+    def snapshot(self) -> dict:
+        """JSON-able state for reports/scorecards."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "state": self._state_locked(),
+                "failures": self._failures,
+                "opens": self.opens,
+                "fast_fails": self.fast_fails,
+            }
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,25 +222,55 @@ class RetryPolicy:
 
     def call(self, fn: Callable, *,
              retry_on: Tuple[Type[BaseException], ...] = (Exception,),
-             on_retry: Optional[Callable[[int, BaseException], None]] = None):
+             on_retry: Optional[Callable[[int, BaseException], None]] = None,
+             breaker: Optional["CircuitBreaker"] = None):
         """Run ``fn()`` under this policy: retry on ``retry_on`` with the
         backoff schedule until an attempt succeeds, the attempt budget is
         exhausted, or the total budget runs out — then re-raise the last
-        error.  The streaming poll loops ride this helper."""
+        error.  The streaming poll loops ride this helper.
+
+        ``breaker``: a ``CircuitBreaker`` consulted before EVERY attempt
+        and fed every outcome.  A call that starts against an open
+        breaker raises ``CircuitOpen`` without attempting anything — a
+        dependency that has been failing across calls is shed fast
+        instead of retried to the deadline.  A breaker that OPENS
+        mid-call stops the retry loop but re-raises the underlying
+        error (the real failure must not be masked by the gate that
+        merely reacted to it)."""
         deadline = self.deadline_from(time.time())
         for attempt in itertools.count():
+            if breaker is not None and not breaker.allow():
+                raise CircuitOpen(breaker.name, breaker.retry_after_s())
             try:
-                return fn()
+                result = fn()
             except retry_on as e:
+                if breaker is not None:
+                    breaker.record_failure()
                 out_of_attempts = not self.allows(attempt + 1)
                 out_of_budget = (
                     deadline is not None and time.time() >= deadline
                 )
-                if out_of_attempts or out_of_budget:
+                breaker_tripped = (
+                    breaker is not None
+                    and breaker.state != CircuitBreaker.CLOSED
+                )
+                if out_of_attempts or out_of_budget or breaker_tripped:
                     raise
                 if on_retry is not None:
                     on_retry(attempt, e)
                 self.sleep(attempt, deadline)
+            except BaseException:
+                # Non-retryable escape (caller bug, KeyboardInterrupt):
+                # the attempt still has to resolve the breaker's
+                # half-open trial slot, or the breaker wedges with the
+                # trial marked in flight and never admits another call.
+                if breaker is not None:
+                    breaker.record_failure()
+                raise
+            else:
+                if breaker is not None:
+                    breaker.record_success()
+                return result
 
 
 # The pre-existing schedules, named.  Call sites default to these so the

@@ -28,6 +28,7 @@ streaming and serving share one batched read path.
 
 from tsspark_tpu.serve.cache import ForecastCache
 from tsspark_tpu.serve.engine import (
+    BackendUnavailable,
     EngineOverloaded,
     EngineStats,
     ForecastRequest,
@@ -47,6 +48,7 @@ from tsspark_tpu.serve.registry import (
 )
 
 __all__ = [
+    "BackendUnavailable",
     "EngineOverloaded",
     "EngineStats",
     "ForecastCache",

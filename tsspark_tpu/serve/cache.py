@@ -32,6 +32,13 @@ class ForecastCache:
             collections.OrderedDict()
         )
         self._lock = threading.Lock()
+        # Version gate (set by invalidate): once an activation has
+        # declared a current version, put() drops entries keyed to any
+        # other version UNDER THIS LOCK — an engine dispatch racing the
+        # activation (snapshot read before the flip, insert after the
+        # invalidation sweep) can therefore never pin a retired-version
+        # entry.  None = no activation seen yet, accept everything.
+        self._accept_version: Optional[Hashable] = None
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
@@ -53,6 +60,10 @@ class ForecastCache:
         if self.capacity <= 0:
             return
         with self._lock:
+            if (self._accept_version is not None
+                    and isinstance(key, tuple) and key
+                    and key[0] != self._accept_version):
+                return  # keyed to a retired version: never pin it
             self._data[key] = value
             self._data.move_to_end(key)
             while len(self._data) > self.capacity:
@@ -60,10 +71,13 @@ class ForecastCache:
 
     def invalidate(self, version: Optional[int] = None) -> int:
         """Drop entries for versions OTHER than ``version`` (``None``
-        drops everything).  Returns the count dropped.  Called on
-        registry activation: entries for the newly active version are
-        the only ones a future request can still hit."""
+        drops everything and clears the version gate).  Returns the
+        count dropped.  Called on registry activation: entries for the
+        newly active version are the only ones a future request can
+        still hit — and the gate makes in-flight dispatches' late
+        inserts for the retired version no-ops (see ``put``)."""
         with self._lock:
+            self._accept_version = version
             if version is None:
                 dropped = len(self._data)
                 self._data.clear()
@@ -74,6 +88,14 @@ class ForecastCache:
                 dropped = len(stale)
             self.invalidations += dropped
             return dropped
+
+    def key_versions(self) -> list:
+        """Sorted distinct registry versions present in the cache keys —
+        the chaos harness's staleness probe: after an activation settles,
+        every key should carry the active version (a foreign version
+        here is an entry pinned by the activation/insert race)."""
+        with self._lock:
+            return sorted({k[0] for k in self._data})
 
     def stats(self) -> Dict:
         total = self.hits + self.misses

@@ -43,8 +43,13 @@ from tsspark_tpu.backends.registry import ForecastBackend, get_backend
 from tsspark_tpu.config import SolverConfig
 from tsspark_tpu.parallel.sharding import compacted_width, next_pow2
 from tsspark_tpu.resilience import faults
+from tsspark_tpu.resilience.policy import CircuitBreaker
 from tsspark_tpu.serve.cache import ForecastCache
-from tsspark_tpu.serve.registry import ParamRegistry, Snapshot
+from tsspark_tpu.serve.registry import (
+    ParamRegistry,
+    RegistryError,
+    Snapshot,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -129,6 +134,25 @@ class EngineOverloaded(ServeError):
     """The bounded request queue is full (admission control)."""
 
     reason = "overloaded"
+
+
+class BackendUnavailable(ServeError):
+    """The dispatch circuit breaker is open: the backend has failed
+    enough consecutive dispatches that requests are shed fast instead of
+    each burning its deadline on doomed retries."""
+
+    reason = "circuit-open"
+
+    def __init__(self, name: str, retry_after_s: float):
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"{name} circuit open; retry in {retry_after_s:.2f}s"
+        )
+
+    def to_dict(self) -> Dict:
+        d = super().to_dict()
+        d["retry_after_s"] = round(self.retry_after_s, 3)
+        return d
 
 
 class PendingForecast:
@@ -260,7 +284,15 @@ class PredictionEngine:
         recorder=None,
         retry_policy=None,
         retry_on: Tuple = (Exception,),
+        breaker: Optional[CircuitBreaker] = None,
+        registry_breaker: Optional[CircuitBreaker] = None,
     ):
+        """``breaker``: circuit breaker over backend dispatch — when a
+        dead backend has failed it open, requests fail fast with the
+        structured ``BackendUnavailable`` instead of retrying to their
+        deadlines.  ``registry_breaker``: same gate over registry
+        snapshot loads; while it is open the engine keeps serving the
+        snapshot it already holds (stale beats down)."""
         self.registry = registry
         self.backend = backend if backend is not None else get_backend(
             "tpu", registry.config, SolverConfig()
@@ -272,12 +304,15 @@ class PredictionEngine:
         self.recorder = recorder
         self.retry_policy = retry_policy
         self.retry_on = retry_on
+        self.breaker = breaker
+        self.registry_breaker = registry_breaker
         self.stats = EngineStats()
         self._queue: "queue.Queue[PendingForecast]" = queue.Queue(
             maxsize=int(max_queue)
         )
         self._snapshot: Optional[Snapshot] = None
         self._manifest_key: Optional[Tuple[int, ...]] = None
+        self._active_seen: Optional[int] = None
         self._pump_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -298,7 +333,13 @@ class PredictionEngine:
         manifest JSON: an unchanged stat key (mtime_ns, size) proves the
         active pointer cannot have moved — cross-process flips are
         caught by the key changing, in-process ones by the subscribe
-        hook clearing ``_snapshot``."""
+        hook clearing ``_snapshot``.
+
+        Reloads compare the ACTIVE pointer, not the loaded snapshot's
+        version: when the registry fell back to the last good version
+        under a corrupt active snapshot, the served version legitimately
+        differs from the active one and must not trigger a reload every
+        pump."""
         # One local read of the shared slot: _on_activate (a publisher
         # thread) may null self._snapshot at any point — the local keeps
         # this pump on a coherent snapshot (at worst one batch serves
@@ -309,11 +350,50 @@ class PredictionEngine:
         if snap is not None and key == self._manifest_key:
             return snap
         active = self.registry.active_version()
-        if snap is None or snap.version != active:
-            snap = self.registry.load(active)
-            self.cache.invalidate(snap.version)
-            self._snapshot = snap
+        if snap is None or active != self._active_seen:
+            loaded = self._load_active()
+            if loaded is None:
+                # Registry breaker open: serve the held snapshot but do
+                # NOT advance the seen markers — the flip has not been
+                # loaded yet, and marking it seen would pin this engine
+                # to the stale snapshot forever once the breaker's
+                # window elapses.  The next pump retries (the breaker
+                # gate keeps retries cheap while it stays open).
+                return snap
+            self.cache.invalidate(loaded.version)
+            self._snapshot = loaded
+            self._active_seen = active
+            snap = loaded
         self._manifest_key = key
+        return snap
+
+    def _load_active(self) -> Optional[Snapshot]:
+        """Registry load guarded by ``registry_breaker``.  Returns None
+        while the breaker refuses the load AND a held snapshot exists
+        (serving one version behind beats serving nothing — the caller
+        must then leave its staleness markers untouched so the load is
+        retried after the window); with nothing held the failure
+        surfaces as a structured RegistryError."""
+        br = self.registry_breaker
+        if br is not None and not br.allow():
+            if self._snapshot is not None:
+                return None
+            raise RegistryError(
+                "circuit-open",
+                f"registry load suppressed by open breaker; retry in "
+                f"{br.retry_after_s():.2f}s",
+            )
+        try:
+            snap = self.registry.load()
+        except BaseException:
+            # BaseException: a half-open trial slot must be resolved
+            # even on KeyboardInterrupt, or the breaker wedges with the
+            # trial marked in flight forever.
+            if br is not None:
+                br.record_failure()
+            raise
+        if br is not None:
+            br.record_success()
         return snap
 
     # -- request intake --------------------------------------------------------
@@ -440,6 +520,12 @@ class PredictionEngine:
                     pend._fail(e)
                 self.stats.failed += len(live)
                 return len(pends)
+            # Activation-race note: if an activation lands while the
+            # dispatch runs, its listener invalidates the cache — and
+            # the cache's version gate (ForecastCache.put, atomic under
+            # the cache lock) drops these late inserts for the retired
+            # version instead of pinning them.  The results still serve
+            # this batch's requests either way.
             for sid, row in fresh.items():
                 rows[sid] = row
                 self.cache.put((version, sid, hb, num_samples, seed),
@@ -491,13 +577,32 @@ class PredictionEngine:
             # would time only the enqueue (perf.PerfRecorder contract).
             return {k: np.asarray(v) for k, v in out.items()}
 
+        # Dispatch circuit breaker: a backend that has been failing
+        # across dispatches sheds this one fast (structured error, no
+        # retries burned); each dispatch counts as ONE breaker outcome
+        # even when the retry policy makes several attempts inside it.
+        if self.breaker is not None and not self.breaker.allow():
+            raise BackendUnavailable(
+                self.breaker.name, self.breaker.retry_after_s()
+            )
         ctx = (self.recorder.dispatch(width, live=n, kind="predict")
                if self.recorder is not None else contextlib.nullcontext())
-        with ctx:
-            if self.retry_policy is not None:
-                out = self.retry_policy.call(run, retry_on=self.retry_on)
-            else:
-                out = run()
+        # ok-flag + finally (not except Exception): even a BaseException
+        # escape must resolve the breaker's half-open trial slot, or the
+        # breaker wedges with the trial marked in flight forever.
+        ok = False
+        try:
+            with ctx:
+                if self.retry_policy is not None:
+                    out = self.retry_policy.call(run,
+                                                 retry_on=self.retry_on)
+                else:
+                    out = run()
+            ok = True
+        finally:
+            if self.breaker is not None:
+                (self.breaker.record_success if ok
+                 else self.breaker.record_failure)()
         self.stats.dispatches += 1
         self.stats.occupancy.append((n, width, n_requests))
         result: Dict[str, Dict] = {}

@@ -33,6 +33,7 @@ import fcntl
 import json
 import os
 import time
+import warnings
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,8 +42,9 @@ import jax.numpy as jnp
 
 from tsspark_tpu.config import NUMERICS_REV, ProphetConfig
 from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.resilience import integrity
 from tsspark_tpu.utils import checkpoint as ckpt
-from tsspark_tpu.utils.atomic import atomic_write
+from tsspark_tpu.utils.atomic import atomic_write, sweep_stale_temps
 
 _MANIFEST = "manifest.json"
 _FORMAT = 1
@@ -77,13 +79,18 @@ def take_fitstate(state: FitState, idx: np.ndarray) -> FitState:
 @dataclasses.dataclass(frozen=True)
 class Snapshot:
     """One loaded registry version: the batch FitState plus the id->row
-    map and per-series cadence the read path needs."""
+    map and per-series cadence the read path needs.
+
+    ``fallback_from``: set when this snapshot was served because the
+    ACTIVE version failed its integrity/load check (see
+    ``ParamRegistry.load``) — the version that could not be loaded."""
 
     version: int
     state: FitState
     series_ids: Tuple[str, ...]
     step: np.ndarray                      # (B,) median cadence, days
     row_of: Dict[str, int]
+    fallback_from: Optional[int] = None
 
     @classmethod
     def build(cls, version: int, state: FitState, series_ids,
@@ -120,6 +127,10 @@ class ParamRegistry:
         self.strict = strict
         self._listeners: List[Callable[[Optional[int]], None]] = []
         os.makedirs(root, exist_ok=True)
+        # A publisher SIGKILLed mid-snapshot orphans a pid-suffixed
+        # atomic-write temp inside its version dir; reap dead writers'
+        # orphans here the way the fit workers sweep their scratch.
+        sweep_stale_temps(root, recursive=True)
         self._read_manifest()  # validate eagerly: fail at attach time
 
     @classmethod
@@ -326,19 +337,75 @@ class ParamRegistry:
 
     # -- reads -----------------------------------------------------------------
 
-    def load(self, version: Optional[int] = None) -> Snapshot:
-        """Load a version (default: the active one) as a Snapshot."""
+    def load(self, version: Optional[int] = None,
+             fallback: bool = True) -> Snapshot:
+        """Load a version (default: the active one) as a Snapshot.
+
+        The snapshot npz must pass its payload-CRC check
+        (resilience.integrity — stamped by utils.checkpoint.save_state)
+        before it is parsed: a torn OR silently corrupted file raises
+        ``corrupt-snapshot`` instead of being assembled into forecasts.
+
+        When the ACTIVE version (``version=None``) fails that check and
+        ``fallback`` is on, the previously active version — then the
+        rest of the catalog, newest first — is tried instead: a corrupt
+        active snapshot must degrade the read path to the last GOOD
+        version (with a loud warning and ``Snapshot.fallback_from``
+        set), never take it down.  An explicitly requested version
+        always raises."""
         m = self._read_manifest()
+        requested = version
         if version is None:
             version = m["active_version"]
             if version is None:
                 raise RegistryError("no-active-version",
                                     "nothing has been activated yet")
+        try:
+            return self._load_version(m, int(version))
+        except RegistryError as e:
+            if (requested is not None or not fallback
+                    or e.reason != "corrupt-snapshot"):
+                raise
+            for v in self._fallback_candidates(m, int(version)):
+                try:
+                    snap = self._load_version(m, v)
+                except RegistryError:
+                    continue
+                warnings.warn(
+                    f"active registry version {version} failed its "
+                    f"integrity/load check ({e}); serving last good "
+                    f"version {v} — republish or rollback to clear",
+                    RuntimeWarning,
+                )
+                return dataclasses.replace(snap,
+                                           fallback_from=int(version))
+            raise
+
+    def _fallback_candidates(self, m: Dict, bad: int) -> List[int]:
+        """Versions to try when the active snapshot is corrupt: the
+        previously active one first (the rollback target — most likely
+        known-good), then the remaining catalog newest-first."""
+        out: List[int] = []
+        prev = m.get("previous_version")
+        if prev is not None and int(prev) != bad:
+            out.append(int(prev))
+        for v in sorted((int(x) for x in m["versions"]), reverse=True):
+            if v != bad and v not in out:
+                out.append(v)
+        return out
+
+    def _load_version(self, m: Dict, version: int) -> Snapshot:
         entry = m["versions"].get(str(int(version)))
         if entry is None:
             raise RegistryError("unknown-version",
                                 f"version {version} was never published")
         base = os.path.join(self.root, entry["path"], "state")
+        if not integrity.verify_file(base + ".npz"):
+            raise RegistryError(
+                "corrupt-snapshot",
+                f"version {version} at {base}.npz: payload CRC mismatch "
+                "(torn or silently corrupted snapshot)",
+            )
         try:
             state, ids, extras = ckpt.load_state(
                 base, self.config, strict=self.strict, return_extras=True,

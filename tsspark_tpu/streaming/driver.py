@@ -204,15 +204,21 @@ class StreamingForecaster:
 
     def run(self, source: MicroBatchSource,
             max_batches: Optional[int] = None,
-            poll_policy: Optional[RetryPolicy] = None) -> RefitStats:
+            poll_policy: Optional[RetryPolicy] = None,
+            poll_breaker=None) -> RefitStats:
         """Drain the source (or up to ``max_batches``).
 
         ``poll_policy``: wrap the source so transient poll failures are
         retried with backoff (resilience.policy.RetryPolicy) instead of
         killing the driver mid-stream; commits still happen only after
-        a refit lands, so retries preserve at-least-once delivery."""
+        a refit lands, so retries preserve at-least-once delivery.
+        ``poll_breaker`` (resilience.CircuitBreaker) rides along: a
+        broker that keeps failing across polls is shed fast with
+        ``CircuitOpen`` instead of re-retrying every poll to its
+        deadline."""
         if poll_policy is not None:
-            source = ResilientSource(source, poll_policy)
+            source = ResilientSource(source, poll_policy,
+                                     breaker=poll_breaker)
         n = 0
         for batch in source:
             self.process(batch)

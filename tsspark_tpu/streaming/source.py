@@ -19,7 +19,11 @@ from typing import Iterable, List, Optional
 import pandas as pd
 
 from tsspark_tpu.resilience import faults
-from tsspark_tpu.resilience.policy import STREAM_POLL, RetryPolicy
+from tsspark_tpu.resilience.policy import (
+    STREAM_POLL,
+    CircuitBreaker,
+    RetryPolicy,
+)
 
 
 class MicroBatchSource(abc.ABC):
@@ -53,12 +57,20 @@ class ResilientSource(MicroBatchSource):
     outlives the policy's attempt/budget limits re-raises.  ``commit``
     passes through untouched — offsets are only ever acknowledged by the
     driver after a refit lands, so retried polls stay at-least-once.
+
+    ``breaker``: an optional ``CircuitBreaker`` shared across polls —
+    once a dead broker has failed it open, the next poll raises
+    ``CircuitOpen`` immediately instead of retrying to the policy's
+    deadline again (the caller decides whether to back off or abort;
+    offsets are untouched either way).
     """
 
     def __init__(self, source: MicroBatchSource,
-                 policy: Optional[RetryPolicy] = None):
+                 policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self._source = source
         self._policy = policy or STREAM_POLL
+        self._breaker = breaker
 
     def poll(self) -> Optional[pd.DataFrame]:
         def attempt():
@@ -75,7 +87,8 @@ class ResilientSource(MicroBatchSource):
         # policy knob is honored; a hand-rolled attempts-only loop here
         # silently ignored total_budget_s (a wall-clock budget against a
         # permanently-down broker never fired).
-        return self._policy.call(attempt, on_retry=log_retry)
+        return self._policy.call(attempt, on_retry=log_retry,
+                                 breaker=self._breaker)
 
     def commit(self) -> None:
         self._source.commit()

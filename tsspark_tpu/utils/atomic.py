@@ -65,8 +65,8 @@ def atomic_write_text(path: str, text: str) -> None:
 STALE_TEMP_S = 600.0
 
 
-def sweep_stale_temps(dirpath: str, max_age_s: float = STALE_TEMP_S
-                      ) -> int:
+def sweep_stale_temps(dirpath: str, max_age_s: float = STALE_TEMP_S,
+                      recursive: bool = False) -> int:
     """Remove dead writers' orphaned ``.*.tmp.<pid>`` files.
 
     The pid suffix keeps concurrent writers off each other's temps, but
@@ -75,23 +75,33 @@ def sweep_stale_temps(dirpath: str, max_age_s: float = STALE_TEMP_S
     sweep a crash-looping run grows its scratch dir without bound.
     Age-gated so a racing LIVE writer's in-progress temp is never
     yanked out from under its ``os.replace``.  Returns the count
-    removed."""
+    removed.
+
+    ``recursive`` walks subdirectories too — the serve registry keeps
+    one directory per published version, and a publisher killed
+    mid-snapshot orphans its temp INSIDE a version dir where the flat
+    sweep never looked (``ParamRegistry`` sweeps its root this way at
+    attach time)."""
     import time
 
     removed = 0
-    try:
-        names = os.listdir(dirpath)
-    except OSError:
-        return 0
-    now = time.time()
-    for name in names:
-        if not (name.startswith(".") and ".tmp." in name):
-            continue
-        p = os.path.join(dirpath, name)
+    if recursive:
+        listing = ((d, names) for d, _sub, names in os.walk(dirpath))
+    else:
         try:
-            if now - os.path.getmtime(p) > max_age_s:
-                os.remove(p)
-                removed += 1
+            listing = [(dirpath, os.listdir(dirpath))]
         except OSError:
-            continue  # already gone / racing writer finished its rename
+            return 0
+    now = time.time()
+    for d, names in listing:
+        for name in names:
+            if not (name.startswith(".") and ".tmp." in name):
+                continue
+            p = os.path.join(d, name)
+            try:
+                if now - os.path.getmtime(p) > max_age_s:
+                    os.remove(p)
+                    removed += 1
+            except OSError:
+                continue  # already gone / racing writer won its rename
     return removed

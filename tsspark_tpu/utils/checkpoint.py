@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from tsspark_tpu.config import ProphetConfig
 from tsspark_tpu.models.prophet.design import ScalingMeta
 from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.resilience import integrity
 from tsspark_tpu.utils.atomic import atomic_write
 
 
@@ -67,8 +68,13 @@ def save_state(
     )
     # Atomic npz + json (utils.atomic): a reader — a concurrent predict
     # process, a resumed streaming driver — must never np.load a torn
-    # checkpoint or parse a half-written sidecar.
+    # checkpoint or parse a half-written sidecar.  The payload CRC stamp
+    # (resilience.integrity, same as chunk/prep files) additionally lets
+    # readers detect SILENT corruption — the serve registry refuses a
+    # mismatching active snapshot and falls back to the last good
+    # version instead of serving garbage.
     host = {k: np.asarray(v) for k, v in arrays.items()}
+    host = integrity.stamp(host)
     atomic_write(path + ".npz", lambda fh: np.savez(fh, **host))
     sidecar = {
         "fingerprint": config_fingerprint(config),
@@ -100,12 +106,14 @@ def save_forecaster(path: str, fc) -> None:
         # dominate the file size — that is the cost of the mcmc_samples
         # choice, same as upstream Prophet's serialized Stan draws.
         z = dict(np.load(path + ".npz"))
+        z.pop(integrity.INTEGRITY_KEY, None)  # re-stamp over the new set
         z.update(
             mcmc_samples=np.asarray(fc.mcmc_state.samples),
             mcmc_accept_rate=np.asarray(fc.mcmc_state.accept_rate),
             mcmc_step_size=np.asarray(fc.mcmc_state.step_size),
             mcmc_divergences=np.asarray(fc.mcmc_state.divergences),
         )
+        z = integrity.stamp(z)
         atomic_write(path + ".npz", lambda fh: np.savez(fh, **z))
     with open(path + ".json") as f:
         sidecar = json.load(f)
