@@ -319,7 +319,10 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
     # reported alongside and vs_baseline is computed against the projection
     # so a partial run can never read better than a finished one.
     projected = fit_s * args.series / n_done if n_done else 0.0
+    from tsspark_tpu.obs import context as obs
+
     extra = {
+        "trace_id": obs.trace_id(),
         "smape_insample_mean": smape,
         "converged_frac": round(float(np.mean(conv)), 4) if conv else 0.0,
         "n_iters_max": n_iters_max,
@@ -480,6 +483,13 @@ def main() -> None:
         if time.time() - newest > 6 * 3600:
             shutil.rmtree(d, ignore_errors=True)
     os.makedirs(args._out_dir, exist_ok=True)
+    # One observability trace per bench run: worker claim/fit/land spans
+    # land in the scratch's spans.jsonl, and the summary is stamped with
+    # the trace id so BENCH artifacts join the run ledger
+    # (python -m tsspark_tpu.obs report <out dir>).
+    from tsspark_tpu.obs import context as obs
+
+    obs.start_run(os.path.join(args._out_dir, "spans.jsonl"))
     orchestrate.save_run_config(
         args._out_dir, _model_config(),
         SolverConfig(max_iters=args.max_iters),

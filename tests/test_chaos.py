@@ -43,9 +43,13 @@ def test_smoke_storm_all_invariants_green(tmp_path):
     whole pipeline.  Every invariant must hold — zero lost/duplicated
     series (bitwise vs the fault-free reference), zero torn reads,
     registry fallback served, engine/direct bitwise parity, the breaker
-    cycled closed, and recovery inside the budget."""
+    cycled closed, recovery inside the budget, and the observability
+    trace joined (zero orphan spans; span-derived MTTR matching the
+    claim-file-mtime measurement within 1 s)."""
+    ledger_path = str(tmp_path / "RUNLEDGER_smoke.json")
     report = run_storm(seed=0, profile="smoke",
-                       scratch=str(tmp_path / "storm"))
+                       scratch=str(tmp_path / "storm"),
+                       ledger_path=ledger_path)
     assert report["ok"], report["invariants"]
     assert len(report["fault_classes"]) >= 5
     inv = report["invariants"]
@@ -72,3 +76,25 @@ def test_smoke_storm_all_invariants_green(tmp_path):
     ]
     assert loaded["ok"] is True
     assert os.path.basename(out).startswith("CHAOS_")
+
+    # Observability acceptance (ISSUE 7): one joined timeline under a
+    # single trace id covering every subsystem, zero orphan spans, and
+    # per-class MTTR readable off the spans alone — agreeing with the
+    # harness's claim-file-mtime measurement within 1 s.
+    tj = inv["trace_joined"]
+    assert tj["ok"], tj
+    assert tj["orphan_spans"] == []
+    assert tj["subsystems_missing"] == []
+    assert report["trace_id"] == tj["trace_id"]
+    for cls, delta in tj["mttr_delta_s"].items():
+        assert delta <= 1.0, f"{cls}: span/mtime MTTR differ by {delta}s"
+    with open(ledger_path) as fh:
+        led = json.load(fh)
+    assert led["kind"] == "run-ledger"
+    assert led["trace_id"] == report["trace_id"]
+    assert led["orphan_spans"] == []
+    assert len(led["processes"]) >= 3  # harness + fit worker attempts
+    # The ledger renders end to end (the `obs report` entry point).
+    from tsspark_tpu.obs.__main__ import main as obs_main
+
+    assert obs_main(["report", ledger_path]) == 0

@@ -1,0 +1,424 @@
+"""Observability subsystem (tsspark_tpu/obs, docs/OBSERVABILITY.md):
+trace/span context and cross-process propagation, the metrics registry,
+the run ledger, and the instrumentation's overhead bound.
+
+The cross-process acceptance reuses the PR-5 lease machinery: a
+SIGKILLed fit worker's reclaimed ranges must yield a ledger whose claim
+spans link to the stolen claim, with zero orphan spans; and the serve
+loadgen's request spans must reconcile with the SERVE_*.json latency
+percentiles (they are one measurement, recorded twice).
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from tsspark_tpu import orchestrate  # noqa: E402
+from tsspark_tpu.obs import context, ledger as ledger_mod, metrics  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _unbind_obs_run():
+    """Every test leaves the process-global run binding as it found it
+    (a leaked binding would spray spans from unrelated tests into a
+    deleted tmp dir)."""
+    yield
+    context.end_run(None)
+
+
+# ---------------------------------------------------------------------------
+# context: spans, events, parents, crash visibility
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_records_and_orphan_check(tmp_path):
+    spans_path = str(tmp_path / "spans.jsonl")
+    prev = context.start_run(spans_path)
+    assert prev is None and context.active()
+    with context.span("stage.orchestrate") as root:
+        with context.span("chunk.fit", lo=0, hi=8) as child:
+            assert context.current_span_id() == child
+        context.event("fault", tag="worker-kill", mode="exit")
+    context.end_run(prev)
+
+    spans, events = ledger_mod.merge_spans(
+        context.read_records(spans_path)
+    )
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["chunk.fit"]["parent_id"] == root
+    assert by_name["stage.orchestrate"]["parent_id"] is None
+    assert by_name["chunk.fit"]["attrs"] == {"lo": 0, "hi": 8}
+    assert all(s["trace_id"] == spans[0]["trace_id"] for s in spans)
+    # The event rode the stage span.
+    assert events[0]["span_id"] == root
+    assert events[0]["attrs"]["tag"] == "worker-kill"
+    assert ledger_mod.orphan_spans(spans) == []
+    # An inactive context records nothing and costs nothing.
+    with context.span("ghost"):
+        pass
+    assert len(context.read_records(spans_path)) == 3
+
+
+def test_open_span_survives_a_killed_writer(tmp_path):
+    """The crash-safe parent contract: the ``open`` record written at
+    span begin keeps a killed process's children out of the orphan
+    list; a span never closed reports status ``open``."""
+    spans_path = str(tmp_path / "spans.jsonl")
+    context.start_run(spans_path)
+    wid = context.open_span("fit.worker", make_current=True)
+    context.record("chunk.claim", time.time(), 0.0, lo=0, hi=8)
+    # ...process dies here: no close_span ever runs.
+    context.end_run(None)
+    spans, _ = ledger_mod.merge_spans(context.read_records(spans_path))
+    worker = next(s for s in spans if s["name"] == "fit.worker")
+    claim = next(s for s in spans if s["name"] == "chunk.claim")
+    assert worker["status"] == "open" and worker["dur_s"] is None
+    assert claim["parent_id"] == wid
+    assert ledger_mod.orphan_spans(spans) == []
+
+
+def test_env_propagation_round_trip(tmp_path, monkeypatch):
+    spans_path = str(tmp_path / "spans.jsonl")
+    context.start_run(spans_path, trace_id="feedbeefcafe")
+    with context.span("stage.orchestrate") as parent:
+        env = {}
+        context.inject_env(env)
+    context.end_run(None)
+    monkeypatch.setenv(context.ENV_VAR, env[context.ENV_VAR])
+    assert context.adopt_env()
+    assert context.trace_id() == "feedbeefcafe"
+    # The injected parent became the adopted current span.
+    assert context.current_span_id() == parent
+    context.end_run(None)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_pow2_buckets_and_prometheus(tmp_path):
+    reg = metrics.MetricsRegistry()
+    reg.counter("tsspark_serve_requests_total", result="completed").inc(5)
+    reg.counter("tsspark_serve_requests_total", result="shed").inc()
+    reg.gauge("tsspark_serve_queue_depth").set(17)
+    h = reg.histogram("tsspark_serve_request_seconds")
+    for v in (0.0003, 0.0009, 0.0017, 0.9, 3.0):
+        h.observe(v)
+    # Pow-2 buckets: each observation lands at 2**ceil(log2(v)).
+    assert h.count == 5
+    assert h.buckets[-10] == 1          # 0.0009 <= 2**-10
+    assert h.buckets[-9] == 1           # 0.0017 <= 2**-9
+    assert h.quantile(0.5) in (2.0 ** -9, 2.0 ** -10)
+    text = reg.to_prometheus()
+    assert 'tsspark_serve_requests_total{result="completed"} 5' in text
+    assert "tsspark_serve_queue_depth 17" in text
+    assert 'le="+Inf"} 5' in text
+    assert "tsspark_serve_request_seconds_count 5" in text
+
+    # Atomic snapshot export round-trips and is ledger-joinable.
+    out = str(tmp_path / "metrics_test.json")
+    reg.export(out, trace_id="aaaabbbbcccc")
+    with open(out) as fh:
+        snap = json.load(fh)
+    assert snap["kind"] == "metrics-snapshot"
+    assert snap["trace_id"] == "aaaabbbbcccc"
+    assert metrics.prometheus_text(snap["metrics"]) == text
+
+
+# ---------------------------------------------------------------------------
+# satellite: monotonic timers + trace-stamped structured logs
+# ---------------------------------------------------------------------------
+
+
+def test_timed_and_timers_survive_wall_clock_steps(monkeypatch):
+    """Durations must come off the monotonic clock: a wall-clock step
+    backwards mid-block (NTP correction) may not produce a negative
+    duration."""
+    from tsspark_tpu.utils.logging import StructuredLogger, timed
+    from tsspark_tpu.utils.profiling import Timers
+
+    seen = {}
+
+    class _Sink:
+        def info(self, event, **fields):
+            seen.update(fields)
+
+    # Wall clock jumps 1000 s BACKWARDS between enter and exit.
+    walls = iter([2_000_000.0, 1_999_000.0, 1_998_000.0])
+    monkeypatch.setattr(time, "time", lambda: next(walls))
+    with timed(_Sink(), "step"):
+        pass
+    assert 0.0 <= seen["seconds"] < 1.0
+
+    t = Timers()
+    with t.section("s"):
+        pass
+    assert 0.0 <= t.summary()["s"]["total_s"] < 1.0
+
+
+def test_structured_logger_stamps_trace_ids(tmp_path, capsys):
+    from tsspark_tpu.utils.logging import get_logger
+
+    log = get_logger("tsspark.test_obs")
+    log._logger.setLevel(logging.INFO)
+    context.start_run(str(tmp_path / "spans.jsonl"),
+                      trace_id="0123456789ab")
+    with context.span("stage.test") as sid:
+        log.info("inside_span", n=1)
+    context.end_run(None)
+    log.info("outside_span", n=2)
+    lines = [json.loads(l) for l in
+             capsys.readouterr().err.strip().splitlines() if l.strip()]
+    inside = next(l for l in lines if l["event"] == "inside_span")
+    outside = next(l for l in lines if l["event"] == "outside_span")
+    assert inside["trace_id"] == "0123456789ab"
+    assert inside["span_id"] == sid
+    assert "trace_id" not in outside
+
+
+# ---------------------------------------------------------------------------
+# cross-process propagation: SIGKILL mid-run, reclaimed-range lineage
+# ---------------------------------------------------------------------------
+
+
+def _model_config():
+    from tsspark_tpu.config import ProphetConfig, SeasonalityConfig
+
+    return ProphetConfig(
+        seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+        n_changepoints=4,
+    )
+
+
+def test_sigkill_reclaim_spans_parent_to_stolen_claim(tmp_path,
+                                                      monkeypatch):
+    """A worker killed mid-run leaves leases behind; the respawned
+    worker steals them.  The ledger must show that lineage: the thief's
+    ``chunk.claim`` links ``stolen_from`` to the dead worker's claim
+    span (readable because claim spans are written AT claim time), the
+    reclaimed range's ``chunk.fit`` parents to the thief's claim, and
+    no span in the whole multi-process run is an orphan."""
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.data import datasets
+    from tsspark_tpu.resilience import faults
+    from tsspark_tpu.resilience.policy import RetryPolicy
+
+    batch = datasets.m5_like(n_series=48, n_days=96)
+    scratch = tmp_path / "scratch"
+    data_dir = str(scratch / "data")
+    out_dir = str(scratch / "out")
+    # No regressor spill: the weekly-only test config carries no
+    # RegressorConfig, and the packer rejects a mismatched reg array.
+    orchestrate.spill_data(
+        data_dir, batch.ds, np.nan_to_num(batch.y), mask=batch.mask,
+    )
+    orchestrate.save_run_config(
+        out_dir, _model_config(), SolverConfig(max_iters=40)
+    )
+    plan = (
+        faults.FaultPlan(state_dir=str(tmp_path / "faults"))
+        .fail("fit_worker_chunk", after=0, attempts=1, mode="exit",
+              rc=31, tag="worker-kill")
+    )
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    context.start_run(os.path.join(out_dir, "spans.jsonl"))
+    state = orchestrate.run_resilient(
+        data_dir=data_dir, out_dir=out_dir, series=48, chunk=16,
+        min_chunk=16, segment=0, phase1_iters=0, deadline=None,
+        progress_timeout=600.0, probe_accelerator=False,
+        retry_policy=RetryPolicy(max_attempts=9, base_delay_s=0.2,
+                                 max_delay_s=0.2),
+    )
+    context.end_run(None)
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert state["complete"] and state["retries"] >= 1
+
+    led = ledger_mod.build_ledger(str(scratch))
+    spans = led["spans"]
+    by_id = {s["span_id"]: s for s in spans}
+    assert led["orphan_spans"] == []
+    assert len(led["processes"]) >= 3  # parent + >= 2 worker attempts
+
+    # The kill is on the trace, and the dead worker's span stayed open.
+    kills = [e for e in led["events"]
+             if e["name"] == "fault" and e["attrs"]["tag"] == "worker-kill"]
+    assert len(kills) == 1
+    dead_pid = kills[0]["pid"]
+    dead_worker = next(s for s in spans if s["name"] == "fit.worker"
+                       and s["pid"] == dead_pid)
+    assert dead_worker["status"] == "open"
+
+    stolen = [s for s in spans if s["name"] == "chunk.claim"
+              and s["attrs"].get("stolen_from")]
+    assert stolen, "no reclaimed-range claim recorded a stolen_from link"
+    for claim in stolen:
+        orig = by_id[claim["attrs"]["stolen_from"]]
+        # The link resolves to the DEAD worker's claim on the same range.
+        assert orig["name"] == "chunk.claim"
+        assert orig["pid"] == dead_pid != claim["pid"]
+        assert (orig["attrs"]["lo"], orig["attrs"]["hi"]) == \
+            (claim["attrs"]["lo"], claim["attrs"]["hi"])
+        # And the reclaimed range's fit parents to the thief's claim.
+        fit = next(s for s in spans if s["name"] == "chunk.fit"
+                   and s["parent_id"] == claim["span_id"])
+        assert fit["attrs"]["lo"] == claim["attrs"]["lo"]
+    # MTTR for the kill is derivable from spans alone.
+    assert led["mttr_s"]["worker-kill"] is not None
+
+
+# ---------------------------------------------------------------------------
+# serve loadgen spans reconcile with the SERVE_*.json report
+# ---------------------------------------------------------------------------
+
+
+def test_loadgen_spans_reconcile_with_serve_report(tmp_path):
+    """Engine request spans and the SERVE report's latency percentiles
+    are ONE measurement recorded twice — same clock, same values — so
+    the span-side p50/p99 must reproduce the report's within float
+    noise, and the report's trace id must match the span log's."""
+    from tsspark_tpu.serve import __main__ as serve_main
+
+    report_path = str(tmp_path / "SERVE_test.json")
+    rc = serve_main.main([
+        "--loadgen", "300", "--dir", str(tmp_path), "--series", "8",
+        "--report", report_path, "--seed", "3",
+    ])
+    context.end_run(None)
+    assert rc == 0
+    with open(report_path) as fh:
+        report = json.load(fh)
+    assert report["trace_id"]
+
+    led = ledger_mod.build_ledger(str(tmp_path / "serve_scratch"))
+    assert led["trace_id"] == report["trace_id"]
+    durs = np.asarray([
+        s["dur_s"] for s in led["spans"]
+        if s["name"] == "serve.request" and s["status"] == "ok"
+    ])
+    assert len(durs) == report["engine"]["completed"]
+    for q in (50, 99):
+        got = float(np.percentile(durs, q)) * 1e3
+        want = report["engine"]["latency_ms"][f"p{q}"]
+        assert got == pytest.approx(want, rel=0.01, abs=0.05), \
+            f"p{q}: spans {got} vs report {want}"
+    # The loadgen's metrics snapshot joined the same trace.
+    assert any(m["trace_id"] == report["trace_id"]
+               for m in led["metrics"])
+    assert led["red"]["serve.dispatch"]["n"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# overhead smoke: tracing must stay out of the fit's way
+# ---------------------------------------------------------------------------
+
+
+def test_instrumentation_overhead_under_2pct(tmp_path):
+    """The instrumentation volume a traced fit of this size emits must
+    cost < 2% of the fit's wall time.  Measured directly — N span/metric
+    records timed against the same compacted fit the records would
+    describe — rather than as a wall-clock A/B of two subprocess runs,
+    whose spawn/compile noise exceeds the 2% band being asserted."""
+    import jax.numpy as jnp
+
+    from tsspark_tpu.backends.tpu import TpuBackend
+    from tsspark_tpu.config import SolverConfig
+
+    rng = np.random.default_rng(0)
+    n, t_len = 128, 128
+    ds = np.arange(t_len, dtype=np.float64)
+    y = (10.0 + 0.02 * ds[None, :]
+         + rng.normal(0, 0.3, (n, t_len))).astype(np.float32)
+    backend = TpuBackend(_model_config(), SolverConfig(max_iters=40),
+                         chunk_size=64, compact=True)
+    backend.fit(ds, jnp.asarray(y))  # warm the compile cache
+    t0 = time.perf_counter()
+    backend.fit(ds, jnp.asarray(y))
+    fit_wall = time.perf_counter() - t0
+
+    # A traced orchestrate run of this shape (2 chunks) emits ~a dozen
+    # records; measure 100x that volume and scale down.
+    context.start_run(str(tmp_path / "spans.jsonl"))
+    reg = metrics.MetricsRegistry()
+    counter = reg.counter("tsspark_fit_chunks_total")
+    hist = reg.histogram("tsspark_fit_chunk_seconds")
+    n_records = 1200
+    t0 = time.perf_counter()
+    for i in range(n_records):
+        context.record("chunk.fit", time.time(), 0.01, lo=i, hi=i + 64,
+                       width=64, compile_miss=False)
+        counter.inc()
+        hist.observe(0.01)
+    obs_wall = (time.perf_counter() - t0) / 100.0
+    context.end_run(None)
+    assert obs_wall < 0.02 * fit_wall, (
+        f"instrumentation {obs_wall * 1e3:.2f}ms vs 2% of fit "
+        f"{fit_wall * 1e3:.1f}ms"
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI: obs report / ledger / prom, and perf's ledger input
+# ---------------------------------------------------------------------------
+
+
+def _tiny_run(tmp_path):
+    context.start_run(str(tmp_path / "spans.jsonl"))
+    with context.span("stage.orchestrate"):
+        with context.span("chunk.claim", lo=0, hi=8):
+            pass
+        context.record("chunk.fit", time.time(), 0.25, lo=0, hi=8,
+                       width=8)
+        context.record("chunk.land", time.time(), 0.001, lo=0, hi=8)
+    context.record("registry.publish", time.time(), 0.01, version=1)
+    context.record("registry.activate", time.time(), 0.001, version=1)
+    context.record("serve.request", time.time(), 0.002, cached=1,
+                   n_series=1, horizon=7, version=1)
+    reg = metrics.MetricsRegistry()
+    reg.counter("tsspark_fit_chunks_total").inc()
+    reg.export(str(tmp_path / "metrics_t.json"),
+               trace_id=context.trace_id())
+    with open(tmp_path / "times.jsonl", "w") as fh:
+        fh.write(json.dumps({"lo": 0, "hi": 8, "fit_s": 0.25, "t": 0.3,
+                             "width": 8, "series_per_s": 32.0}) + "\n")
+    context.end_run(None)
+
+
+def test_obs_cli_ledger_report_and_prom(tmp_path, capsys):
+    from tsspark_tpu.obs import __main__ as obs_main
+
+    _tiny_run(tmp_path)
+    out = str(tmp_path / "RUNLEDGER_t.json")
+    assert obs_main.main(["ledger", str(tmp_path), "-o", out]) == 0
+    assert obs_main.main(["report", out]) == 0
+    text = capsys.readouterr().out
+    assert "orphan spans: 0" in text
+    assert "chunk.claim" in text and "serve.request" in text
+    assert "serve.first_cache_hit" in text
+    assert "registry.publish" in text
+    # The timeline reads in pipeline order from one joined trace.
+    assert text.index("chunk.claim") < text.index("registry.publish")
+
+    assert obs_main.main(["prom", out]) == 0
+    assert "tsspark_fit_chunks_total 1" in capsys.readouterr().out
+
+
+def test_perf_cli_accepts_run_ledger(tmp_path, capsys):
+    from tsspark_tpu.obs import __main__ as obs_main
+    from tsspark_tpu.perf import __main__ as perf_main
+
+    _tiny_run(tmp_path)
+    out = str(tmp_path / "RUNLEDGER_t.json")
+    obs_main.main(["ledger", str(tmp_path), "-o", out])
+    capsys.readouterr()
+    assert perf_main.main([out]) == 0
+    text = capsys.readouterr().out
+    assert "chunks fitted:     1" in text
+    assert "series/s by chunk size:" in text

@@ -103,13 +103,13 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
     ),
     ArtifactSpec(
         "heartbeat", ("heartbeat",),
-        ("fit_worker.heartbeat",),
+        ("_fit_worker_body.heartbeat",),
         "liveness mtime touched by the fit worker per dispatch; read "
         "(mtime only) by the parent watchdog",
     ),
     ArtifactSpec(
         "phase2-sentinel", ("phase2_done",),
-        ("fit_worker", "_cpu_fill"),
+        ("_fit_worker_body", "_cpu_fill"),
         "created exactly once when straggler coverage completes (or the "
         "run degrades to CPU); presence gates the parent's done check; "
         "removed only by the integrity re-queue path",
@@ -142,6 +142,30 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "chaos-storm scorecard (tsspark_tpu.chaos): injection schedule, "
         "invariant verdicts, MTTR per fault class; written once at "
         "storm end, atomic so a watcher never parses a partial JSON",
+    ),
+    ArtifactSpec(
+        "span-log", ("spans.jsonl",),
+        ("Run.write", "append_line"),
+        "per-run observability span log (tsspark_tpu.obs): every "
+        "process of a run appends whole lines through utils.atomic."
+        "append_line (one O_APPEND write per record, so concurrent "
+        "writers never interleave); readers tolerate a torn last line",
+        append_ok=True,
+    ),
+    ArtifactSpec(
+        "metrics-snapshot", ("metrics_",),
+        ("MetricsRegistry.export",),
+        "atomic metrics snapshot (obs.metrics): counters/gauges/pow-2 "
+        "histograms exported once per process at run end, keyed into "
+        "the run ledger by trace id; readers never see a torn JSON",
+    ),
+    ArtifactSpec(
+        "run-ledger", ("RUNLEDGER_",),
+        ("write_ledger",),
+        "the joined observability ledger (obs.ledger): spans + metric "
+        "snapshots + perf rows + report refs under one trace id, "
+        "written once at run end, atomic so a watcher never parses a "
+        "partial JSON",
     ),
     # Specific marker specs must precede "checkpoint": its generic
     # ".json" marker would otherwise swallow "times.jsonl",
@@ -227,6 +251,10 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/chaos/harness.py",
     "tsspark_tpu/chaos/invariants.py",
     "tsspark_tpu/chaos/__main__.py",
+    "tsspark_tpu/obs/context.py",
+    "tsspark_tpu/obs/metrics.py",
+    "tsspark_tpu/obs/ledger.py",
+    "tsspark_tpu/obs/__main__.py",
 )
 
 _WRITE_FNS = {"save", "savez", "savez_compressed", "dump"}

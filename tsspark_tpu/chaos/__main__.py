@@ -60,19 +60,29 @@ def main(argv=None) -> int:
                     "afterwards)")
     ap.add_argument("--report", default=None,
                     help="scorecard path (default: CHAOS_<unix>.json)")
+    ap.add_argument("--ledger", default=None,
+                    help="run-ledger path (default: RUNLEDGER_<unix>"
+                    ".json; render with `python -m tsspark_tpu.obs "
+                    "report`)")
     ap.add_argument("--keep-scratch", action="store_true",
                     help="keep the storm's scratch dirs for forensics")
     ap.add_argument("--deadline-s", type=float, default=600.0,
                     help="hard wall bound on the orchestrate stages")
     args = ap.parse_args(argv)
 
+    import time
+
     report = run_storm(
         seed=args.seed, profile=args.profile, scratch=args.dir,
         keep_scratch=args.keep_scratch, deadline_s=args.deadline_s,
+        ledger_path=(args.ledger
+                     or f"RUNLEDGER_{int(time.time())}.json"),
     )
     out = write_scorecard(report, args.report)
     print(summarize(report))
     print(f"scorecard -> {out}")
+    print(f"run ledger -> {report.get('ledger_path')} "
+          f"(python -m tsspark_tpu.obs report)")
     return 0 if report["ok"] else 1
 
 

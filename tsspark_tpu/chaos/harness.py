@@ -37,6 +37,9 @@ from tsspark_tpu.chaos.storm import (
     StormPlan,
     compose,
 )
+from tsspark_tpu.obs import context as obs
+from tsspark_tpu.obs import ledger as obs_ledger
+from tsspark_tpu.obs.metrics import DEFAULT as METRICS
 from tsspark_tpu.config import (
     ProphetConfig,
     SeasonalityConfig,
@@ -274,6 +277,7 @@ def _run_serve(registry, ids: List[str], state_v1, storm: StormPlan,
             t_recovered = time.time()
         if t_race is not None and "activation-race" not in mttr:
             mttr["activation-race"] = time.time() - t_race
+            obs.event("recovered", tag="activation-race")
         if parity and num_samples == 0:
             check_parity(res, sids, horizon)
         return res
@@ -284,6 +288,11 @@ def _run_serve(registry, ids: List[str], state_v1, storm: StormPlan,
     for i in range(prof.loadgen_requests):
         if overload is not None and i == overload.at_request:
             t_burst = time.time()
+            # Direct injections never ride the env fault plan, so the
+            # harness itself annotates the trace (paired with the
+            # "recovered" event below — obs.ledger.derive_mttr reads
+            # this class's MTTR straight off the pair).
+            obs.event("fault", tag="queue-overload", mode="direct")
             rejected = 0
             pending = []
             for j in range(prof.serve_queue + 8):
@@ -307,6 +316,7 @@ def _run_serve(registry, ids: List[str], state_v1, storm: StormPlan,
                 while not ok.done():
                     engine.pump()
                 mttr["queue-overload"] = time.time() - t_burst
+                obs.event("recovered", tag="queue-overload")
             except EngineOverloaded:
                 mttr["queue-overload"] = None
         if race is not None and i == race.at_request:
@@ -320,6 +330,8 @@ def _run_serve(registry, ids: List[str], state_v1, storm: StormPlan,
                 ids, step=np.ones(len(ids)),
             )
             t_race = time.time()
+            obs.event("fault", tag="activation-race", mode="direct",
+                      version=race_version)
         k = 1 + (i % 3)
         sids = [ids[(i * 7 + j * 3) % len(ids)] for j in range(k)]
         res = attempt(sids, (5, 7, 12)[i % 3], parity=(i % 4 == 0))
@@ -377,9 +389,18 @@ def _run_serve(registry, ids: List[str], state_v1, storm: StormPlan,
 def run_storm(seed: int = 0, profile: str = "full",
               scratch: Optional[str] = None,
               keep_scratch: bool = False,
-              deadline_s: float = 600.0) -> Dict:
+              deadline_s: float = 600.0,
+              ledger_path: Optional[str] = None) -> Dict:
     """Run the composed storm end to end; returns the scorecard dict
-    (see ``write_scorecard`` for the file form)."""
+    (see ``write_scorecard`` for the file form).
+
+    The whole storm runs under ONE observability trace
+    (tsspark_tpu.obs): stage spans wrap orchestrate/registry/streaming/
+    serve, fault firings annotate the trace, and the resulting run
+    ledger is joined back into the scorecard — the ``trace_joined``
+    invariant requires zero orphan spans and span-derived MTTR agreeing
+    with the claim-file-mtime measurement within 1 s.  ``ledger_path``
+    additionally persists the ledger as a ``RUNLEDGER_*.json``."""
     from tsspark_tpu import orchestrate
     from tsspark_tpu.serve.registry import ParamRegistry
 
@@ -388,6 +409,10 @@ def run_storm(seed: int = 0, profile: str = "full",
     own_scratch = scratch is None
     scratch = scratch or tempfile.mkdtemp(prefix="tsspark_chaos_")
     os.makedirs(scratch, exist_ok=True)
+    prev_run = obs.start_run(os.path.join(scratch, "spans.jsonl"))
+    # Fresh run, fresh counts: the end-of-storm snapshot must describe
+    # THIS storm, not a prior run in the same process.
+    METRICS.reset()
     cfg, solver = _config(prof.max_iters)
     ds, y = _synthetic_batch(seed, prof.series, prof.days)
     ids = [f"s{i:04d}" for i in range(prof.series)]
@@ -409,10 +434,11 @@ def run_storm(seed: int = 0, profile: str = "full",
     try:
         # ---- stage A: orchestrate under storm ------------------------
         os.environ[faults.ENV_VAR] = plan.to_env()
-        stages["orchestrate"] = _run_orchestrate(
-            scratch, "storm", ds, y, cfg, solver, storm, deadline_s
-        )
-        t_end_orch = time.time()
+        with obs.span("stage.orchestrate", seed=seed, profile=profile):
+            stages["orchestrate"] = _run_orchestrate(
+                scratch, "storm", ds, y, cfg, solver, storm, deadline_s
+            )
+            t_end_orch = time.time()
         os.environ.pop(faults.ENV_VAR, None)
         out_dir = stages["orchestrate"]["out_dir"]
 
@@ -432,9 +458,11 @@ def run_storm(seed: int = 0, profile: str = "full",
             ranges, prof.series
         )
         got_state = orchestrate.load_fit_state(out_dir, prof.series)
-        stages["reference"] = _run_orchestrate(
-            scratch, "reference", ds, y, cfg, solver, storm, deadline_s
-        )
+        with obs.span("stage.reference"):
+            stages["reference"] = _run_orchestrate(
+                scratch, "reference", ds, y, cfg, solver, storm,
+                deadline_s
+            )
         ref_state = orchestrate.load_fit_state(
             stages["reference"]["out_dir"], prof.series
         )
@@ -450,26 +478,30 @@ def run_storm(seed: int = 0, profile: str = "full",
 
         # ---- stage B: registry publish + corrupt-active fallback -----
         os.environ[faults.ENV_VAR] = plan.to_env()
-        registry = ParamRegistry(os.path.join(scratch, "registry"), cfg)
-        v1 = orchestrate.publish_fit_state(
-            registry, out_dir, ids, step=np.ones(prof.series)
-        )
-        v2 = registry.publish(
-            got_state._replace(theta=np.asarray(got_state.theta) * 1.01),
-            ids, step=np.ones(prof.series),
-        )
-        snap_path = os.path.join(
-            registry.root, f"v{v2:06d}", "state.npz"
-        )
-        corrupted = faults.corrupt_file(REGISTRY_SNAPSHOT_POINT,
-                                        snap_path)
-        t_corrupt = time.time()
-        import warnings as _warnings
+        with obs.span("stage.registry"):
+            registry = ParamRegistry(os.path.join(scratch, "registry"),
+                                     cfg)
+            v1 = orchestrate.publish_fit_state(
+                registry, out_dir, ids, step=np.ones(prof.series)
+            )
+            v2 = registry.publish(
+                got_state._replace(
+                    theta=np.asarray(got_state.theta) * 1.01
+                ),
+                ids, step=np.ones(prof.series),
+            )
+            snap_path = os.path.join(
+                registry.root, f"v{v2:06d}", "state.npz"
+            )
+            corrupted = faults.corrupt_file(REGISTRY_SNAPSHOT_POINT,
+                                            snap_path)
+            t_corrupt = time.time()
+            import warnings as _warnings
 
-        with _warnings.catch_warnings():
-            _warnings.simplefilter("ignore", RuntimeWarning)
-            fb_snap = registry.load()
-        mttr["registry-corrupt"] = time.time() - t_corrupt
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore", RuntimeWarning)
+                fb_snap = registry.load()
+            mttr["registry-corrupt"] = time.time() - t_corrupt
         invariants["registry_fallback"] = {
             "ok": (corrupted and fb_snap.version == v1
                    and fb_snap.fallback_from == v2),
@@ -481,7 +513,9 @@ def run_storm(seed: int = 0, profile: str = "full",
                               "fallback_served": fb_snap.version}
 
         # ---- stage C: streaming under storm --------------------------
-        stages["streaming"] = _run_streaming(registry, cfg, storm, seed)
+        with obs.span("stage.streaming"):
+            stages["streaming"] = _run_streaming(registry, cfg, storm,
+                                                 seed)
         stream_fired = inv.fault_firing_times(
             plan.state_dir, rule_cls, plan.rules
         ).get("stream-fault", [])
@@ -492,9 +526,10 @@ def run_storm(seed: int = 0, profile: str = "full",
             )
 
         # ---- stage D: engine loadgen under storm ---------------------
-        registry.activate(v1)  # loadgen runs over the full batch
-        stages["serve"] = _run_serve(registry, ids, got_state, storm,
-                                     mttr)
+        with obs.span("stage.serve"):
+            registry.activate(v1)  # loadgen runs over the full batch
+            stages["serve"] = _run_serve(registry, ids, got_state,
+                                         storm, mttr)
 
         # ---- cross-stage invariants ----------------------------------
         corrupt_injected = sum(
@@ -546,10 +581,52 @@ def run_storm(seed: int = 0, profile: str = "full",
                 planned = sum(j.attempts for j in js)
                 fired_n = len(fired_final.get(c, []))
             per_class[c] = {"planned": planned, "fired": fired_n}
+
+        # ---- the run ledger: every stage joined under one trace ------
+        METRICS.export(os.path.join(scratch, "metrics_harness.json"),
+                       trace_id=obs.trace_id())
+        ledger = obs_ledger.build_ledger(scratch)
+        mttr_spans = ledger["mttr_s"]
+        mttr_delta = {
+            c: round(abs(mttr_spans[c] - mttr[c]), 3)
+            for c in sorted(set(mttr_spans) & set(mttr))
+            if mttr_spans[c] is not None and mttr[c] is not None
+        }
+        # Every class the mtime measurement recovered must ALSO be
+        # derivable from spans — a class whose fault events never made
+        # the trace would otherwise drop out of the delta comparison
+        # and pass vacuously.
+        mttr_missing = sorted(
+            c for c, v in mttr.items()
+            if v is not None and mttr_spans.get(c) is None
+        )
+        span_names = set(ledger["red"])
+        stage_names = {"chunk.fit", "registry.publish", "stream.batch",
+                       "serve.request"}
+        invariants["trace_joined"] = {
+            # Zero orphan spans, every subsystem on the timeline, every
+            # recovered fault class readable off the trace, and
+            # span-derived MTTR agreeing with the claim-file-mtime
+            # measurement within 1 s — the trace alone tells the same
+            # recovery story the artifacts do.
+            "ok": (not ledger["orphan_spans"]
+                   and stage_names <= span_names
+                   and not mttr_missing
+                   and all(d <= 1.0 for d in mttr_delta.values())),
+            "trace_id": ledger["trace_id"],
+            "spans": len(ledger["spans"]),
+            "processes": len(ledger["processes"]),
+            "orphan_spans": ledger["orphan_spans"],
+            "subsystems_missing": sorted(stage_names - span_names),
+            "mttr_missing_in_spans": mttr_missing,
+            "mttr_spans_s": mttr_spans,
+            "mttr_delta_s": mttr_delta,
+        }
         ok = all(v.get("ok") for v in invariants.values())
         report = {
             "kind": "chaos-storm",
             "unix": round(time.time(), 3),
+            "trace_id": ledger["trace_id"],
             "seed": seed,
             "profile": profile,
             "workload": {
@@ -567,10 +644,21 @@ def run_storm(seed: int = 0, profile: str = "full",
             "invariants": invariants,
             "mttr_s": {k: (None if v is None else round(v, 3))
                        for k, v in mttr.items()},
+            "mttr_spans_s": mttr_spans,
             "ok": ok,
         }
+        if ledger_path is not None:
+            ledger["reports"] = [{
+                "kind": report["kind"], "unix": report["unix"],
+                "trace_id": report["trace_id"], "ok": report["ok"],
+                "joined": True,
+            }]
+            report["ledger_path"] = obs_ledger.write_ledger(
+                ledger, ledger_path
+            )
         return report
     finally:
+        obs.end_run(prev_run)
         if env_old is None:
             os.environ.pop(faults.ENV_VAR, None)
         else:

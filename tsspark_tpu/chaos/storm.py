@@ -140,6 +140,10 @@ class StormPlan:
                 inj.point, attempts=inj.attempts, after=inj.after,
                 mode=inj.mode, series=inj.series, rc=inj.rc,
                 delay_s=inj.delay_s or 0.5,
+                # The class rides the rule: a firing's span-ledger event
+                # then carries it, so MTTR is derivable from the trace
+                # alone (obs.ledger.derive_mttr).
+                tag=inj.cls,
             )
             rule_cls[plan.rules[-1]["id"]] = inj.cls
         return plan, rule_cls

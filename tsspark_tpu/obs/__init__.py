@@ -1,0 +1,43 @@
+"""Unified observability: cross-process tracing, metrics, run ledger.
+
+Three layers (docs/OBSERVABILITY.md):
+
+* ``obs.context`` — trace/span context on a contextvar, propagated to
+  child processes through the spawn environment and appended
+  crash-safely to a per-run ``spans.jsonl``;
+* ``obs.metrics`` — a process-local registry of counters/gauges/pow-2
+  histograms with atomic snapshot export and a Prometheus text mode;
+* ``obs.ledger`` — the ``RUNLEDGER_*.json`` joiner: spans, metric
+  snapshots, perf telemetry, and stamped reports under one trace id,
+  with MTTR, RED, and orphan checks derived from the trace.
+
+``python -m tsspark_tpu.obs report`` renders the end-to-end timeline.
+"""
+
+from tsspark_tpu.obs.context import (  # noqa: F401
+    active,
+    adopt_env,
+    close_span,
+    current_ids,
+    current_span_id,
+    end_run,
+    event,
+    inject_env,
+    new_id,
+    open_span,
+    record,
+    remote_context,
+    span,
+    start_run,
+    trace_id,
+)
+from tsspark_tpu.obs.ledger import (  # noqa: F401
+    build_ledger,
+    derive_mttr,
+    write_ledger,
+)
+from tsspark_tpu.obs.metrics import (  # noqa: F401
+    DEFAULT as METRICS,
+    MetricsRegistry,
+    prometheus_text,
+)

@@ -1,0 +1,190 @@
+"""``python -m tsspark_tpu.obs`` — render a run's observability story.
+
+Subcommands::
+
+    report [target]   end-to-end timeline + RED/SLO summary.  ``target``
+                      is a RUNLEDGER_*.json, a directory holding
+                      spans.jsonl files (a run scratch), or omitted —
+                      then the newest RUNLEDGER_*.json in the cwd.
+    ledger <dir> [-o OUT]   build + write a RUNLEDGER from a scratch dir
+    prom <target>     Prometheus text from a metrics_*.json snapshot or
+                      a ledger's embedded snapshots
+
+Device-free: never imports JAX (same contract as ``-m tsspark_tpu.perf``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _load_ledger(target: Optional[str]) -> Dict:
+    from tsspark_tpu.obs import ledger as ledger_mod
+
+    if target is None:
+        cands = sorted(glob.glob("RUNLEDGER_*.json"),
+                       key=lambda p: os.path.getmtime(p))
+        if not cands:
+            raise SystemExit(
+                "no RUNLEDGER_*.json in the cwd; pass a ledger file or "
+                "a run scratch directory"
+            )
+        target = cands[-1]
+    if os.path.isdir(target):
+        return ledger_mod.build_ledger(target)
+    with open(target) as fh:
+        d = json.load(fh)
+    if d.get("kind") != "run-ledger":
+        raise SystemExit(f"{target}: not a run ledger (kind={d.get('kind')})")
+    return d
+
+
+def _fmt_dur(dur) -> str:
+    if dur is None:
+        return "…open"
+    if dur >= 1.0:
+        return f"{dur:.2f}s"
+    return f"{dur * 1e3:.1f}ms"
+
+
+def _render_timeline(ledger: Dict, max_rows: int) -> List[str]:
+    spans = ledger.get("spans", [])
+    t_base = ledger.get("t0") or 0.0
+    children: Dict[Optional[str], List[Dict]] = {}
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent is not None and parent not in by_id:
+            parent = None  # orphan: render at the root, flagged below
+        children.setdefault(parent, []).append(s)
+    for group in children.values():
+        group.sort(key=lambda s: s.get("t0") or 0.0)
+    lines: List[str] = []
+
+    def walk(parent: Optional[str], depth: int) -> None:
+        for s in children.get(parent, ()):
+            if len(lines) >= max_rows:
+                return
+            attrs = s.get("attrs") or {}
+            bits = " ".join(
+                f"{k}={v}" for k, v in sorted(attrs.items())
+                if isinstance(v, (int, float, str, bool))
+            )
+            mark = " !" if s.get("status") == "err" else ""
+            lines.append(
+                f"  [{(s.get('t0') or 0.0) - t_base:9.3f}s] "
+                f"{'  ' * depth}{s.get('name')} "
+                f"({_fmt_dur(s.get('dur_s'))}) pid={s.get('pid')}"
+                f"{(' ' + bits) if bits else ''}{mark}"
+            )
+            walk(s["span_id"], depth + 1)
+
+    walk(None, 0)
+    if len(lines) >= max_rows:
+        lines.append(f"  ... ({len(spans)} spans total; --max-rows to "
+                     f"see more)")
+    return lines
+
+
+def _report(args) -> int:
+    ledger = _load_ledger(args.target)
+    t_base = ledger.get("t0") or 0.0
+    print(
+        f"run ledger: trace {ledger.get('trace_id')} | "
+        f"{len(ledger.get('spans', []))} spans across "
+        f"{len(ledger.get('processes', []))} process(es) | "
+        f"wall {ledger.get('wall_s')}s"
+    )
+    orphans = ledger.get("orphan_spans", [])
+    print(f"orphan spans: {len(orphans)}"
+          + (f"  {orphans[:8]}" if orphans else ""))
+    ms = ledger.get("milestones") or {}
+    if ms:
+        print("milestones (s from trace start):")
+        for k, v in sorted(ms.items(), key=lambda kv: kv[1]):
+            print(f"  {v - t_base:9.3f}  {k}")
+    print("timeline:")
+    for line in _render_timeline(ledger, args.max_rows):
+        print(line)
+    red = ledger.get("red") or {}
+    if red:
+        print("RED summary (per span name):")
+        for name, r in sorted(red.items()):
+            rate = f"{r['rate_per_s']}/s" if r.get("rate_per_s") else "-"
+            print(
+                f"  {name:<22} n={r['n']:<6} err={r['err']:<4} "
+                f"open={r.get('open', 0):<3} rate={rate:<10} "
+                f"p50={r['p50_ms']}ms p99={r['p99_ms']}ms "
+                f"max={r['max_ms']}ms"
+            )
+    mttr = {k: v for k, v in (ledger.get("mttr_s") or {}).items()}
+    if mttr:
+        print("MTTR from spans (per fault class):")
+        for cls, v in sorted(mttr.items()):
+            print(f"  {cls:<18} "
+                  + (f"{v}s" if v is not None else "NO RECOVERY"))
+    reports = ledger.get("reports") or []
+    if reports:
+        print("joined reports:")
+        for r in reports:
+            print(f"  {r.get('kind')} trace={r.get('trace_id')} "
+                  f"ok={r.get('ok')} joined={r.get('joined')}")
+    return 0
+
+
+def _ledger(args) -> int:
+    from tsspark_tpu.obs import ledger as ledger_mod
+
+    ledger = ledger_mod.build_ledger(args.dir)
+    out = ledger_mod.write_ledger(ledger, args.out)
+    print(
+        f"run ledger: {len(ledger['spans'])} spans, "
+        f"{len(ledger['events'])} events, trace "
+        f"{ledger['trace_id']} -> {out}"
+    )
+    return 0
+
+
+def _prom(args) -> int:
+    from tsspark_tpu.obs.metrics import prometheus_text
+
+    with open(args.target) as fh:
+        d = json.load(fh)
+    if d.get("kind") == "metrics-snapshot":
+        sys.stdout.write(prometheus_text(d.get("metrics", {})))
+        return 0
+    if d.get("kind") == "run-ledger":
+        for snap in d.get("metrics", []):
+            sys.stdout.write(prometheus_text(snap.get("metrics", {})))
+        return 0
+    raise SystemExit(f"{args.target}: neither a metrics snapshot nor a "
+                     "run ledger")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tsspark_tpu.obs",
+        description="observability reports (docs/OBSERVABILITY.md)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_rep = sub.add_parser("report", help="timeline + RED/SLO summary")
+    p_rep.add_argument("target", nargs="?", default=None)
+    p_rep.add_argument("--max-rows", type=int, default=200)
+    p_led = sub.add_parser("ledger", help="build a RUNLEDGER from a dir")
+    p_led.add_argument("dir")
+    p_led.add_argument("-o", "--out", default=None)
+    p_prom = sub.add_parser("prom", help="Prometheus text dump")
+    p_prom.add_argument("target")
+    args = ap.parse_args(argv)
+    return {"report": _report, "ledger": _ledger, "prom": _prom}[args.cmd](
+        args
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,251 @@
+"""Process-local metrics registry: counters, gauges, pow-2 histograms.
+
+Naming convention (docs/OBSERVABILITY.md): ``tsspark_<subsystem>_<what>
+_<unit>`` — ``tsspark_serve_request_seconds``, ``tsspark_fit_chunks_
+total``.  Labels are a small dict baked into the handle at registration
+(``counter("...", result="shed")``), so the hot path is one attribute
+increment with no formatting.
+
+Histograms bucket on the pow-2 ladder — the same shape discipline the
+engine's coalescing buckets and the fit path's compaction widths walk
+(``parallel.sharding``) — as ``{exponent: count}`` with exact
+sum/count/min/max alongside, so a snapshot stays a few dozen ints no
+matter how many observations land.
+
+Export: ``MetricsRegistry.export`` writes an atomic JSON snapshot
+(``metrics_*.json`` next to the run's other artifacts; the run ledger
+joins them by trace id), and ``to_prometheus`` renders the standard
+text exposition format for scrape-style consumers
+(``python -m tsspark_tpu.obs prom``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from tsspark_tpu.utils.atomic import atomic_write
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labelkey(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter.  Handle methods take a lock: ``value += n``
+    is load/add/store bytecode the GIL can interleave, and the engine's
+    background pump thread shares handles with submitting threads."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self) -> None:
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+    def _reset(self) -> None:
+        with self._lock:
+            self.value = 0
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        # A single store is atomic under the GIL; no lock needed.
+        self.value = float(v)  # lint-ok[host-sync]: host-side metrics handle; never called under a trace (name-collision with traced .set methods)
+
+    def _reset(self) -> None:
+        self.value = 0.0
+
+
+#: Exponent clamp: 2**-30 s ≈ 1 ns and 2**30 ≈ 34 years/1G — everything
+#: this package measures fits far inside.
+_EXP_MIN, _EXP_MAX = -30, 30
+
+
+class Histogram:
+    """Pow-2-bucketed histogram: bucket ``e`` counts observations with
+    ``2**(e-1) < v <= 2**e`` (zero/negative land in the bottom)."""
+
+    __slots__ = ("buckets", "count", "total", "vmin", "vmax", "_lock")
+
+    def __init__(self) -> None:
+        self.buckets: Dict[int, int] = {}
+        self.count = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if v > 0.0:
+            e = min(max(math.ceil(math.log2(v)), _EXP_MIN), _EXP_MAX)
+        else:
+            e = _EXP_MIN
+        with self._lock:
+            self.buckets[e] = self.buckets.get(e, 0) + 1
+            self.count += 1
+            self.total += v
+            self.vmin = v if self.vmin is None else min(self.vmin, v)
+            self.vmax = v if self.vmax is None else max(self.vmax, v)
+
+    def _reset(self) -> None:
+        with self._lock:
+            self.buckets.clear()
+            self.count = 0
+            self.total = 0.0
+            self.vmin = self.vmax = None
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Upper-bound estimate: the bucket boundary (2**e) at or above
+        the q-th observation."""
+        if not self.count:
+            return None
+        target = q * self.count
+        seen = 0
+        for e in sorted(self.buckets):
+            seen += self.buckets[e]
+            if seen >= target:
+                return 2.0 ** e
+        return 2.0 ** max(self.buckets)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "buckets": {str(e): n for e, n in sorted(self.buckets.items())},
+            "count": self.count,
+            "sum": round(self.total, 6),
+            "min": self.vmin, "max": self.vmax,
+        }
+
+
+class MetricsRegistry:
+    """Named metric handles, one registry per process (``DEFAULT``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, _LabelKey], Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+
+    def counter(self, name: str, **labels: Any) -> Counter:
+        key = (name, _labelkey(labels))
+        with self._lock:
+            c = self._counters.get(key)
+            if c is None:
+                c = self._counters[key] = Counter()
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge()
+        return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+        return h
+
+    def reset(self) -> None:
+        """Zero every metric IN PLACE (handles cached by subsystems —
+        the engine resolves its counters once at init — stay live).
+        Per-run exporters (the chaos harness, the serve loadgen) call
+        this at run start so a second run in the same process does not
+        export the first run's counts under its own trace id."""
+        with self._lock:
+            handles = (list(self._counters.values())
+                       + list(self._gauges.values())
+                       + list(self._hists.values()))
+        for h in handles:
+            h._reset()
+
+    # -- export ---------------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = [
+                {"name": n, "labels": dict(lk), "value": c.value}
+                for (n, lk), c in sorted(self._counters.items())
+            ]
+            gauges = [
+                {"name": n, "value": g.value}
+                for n, g in sorted(self._gauges.items())
+            ]
+            hists = [
+                {"name": n, **h.to_dict()}
+                for n, h in sorted(self._hists.items())
+            ]
+        return {"counters": counters, "gauges": gauges,
+                "histograms": hists}
+
+    def export(self, path: str,
+               trace_id: Optional[str] = None) -> Dict[str, Any]:
+        """Atomic snapshot file (readers never see a torn JSON); the
+        trace id keys it into the run ledger."""
+        snap = {
+            "kind": "metrics-snapshot",
+            "unix": round(time.time(), 3),
+            "trace_id": trace_id,
+            "pid": os.getpid(),
+            "metrics": self.snapshot(),
+        }
+        atomic_write(path, lambda fh: json.dump(snap, fh, indent=1),
+                     mode="w")
+        return snap
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition of the current state."""
+        return prometheus_text(self.snapshot())
+
+
+def prometheus_text(metrics: Dict[str, Any]) -> str:
+    """Render a ``snapshot()``-shaped dict as Prometheus text (also
+    accepts the ``metrics`` block of an exported snapshot file)."""
+    lines = []
+    for c in metrics.get("counters", ()):
+        lines.append(f"# TYPE {c['name']} counter")
+        lab = ",".join(f'{k}="{v}"' for k, v in
+                       sorted(c.get("labels", {}).items()))
+        lines.append(
+            f"{c['name']}{{{lab}}} {c['value']}" if lab
+            else f"{c['name']} {c['value']}"
+        )
+    for g in metrics.get("gauges", ()):
+        lines.append(f"# TYPE {g['name']} gauge")
+        lines.append(f"{g['name']} {g['value']}")
+    for h in metrics.get("histograms", ()):
+        name = h["name"]
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for e in sorted(int(k) for k in h.get("buckets", {})):
+            cum += h["buckets"][str(e)]
+            lines.append(f'{name}_bucket{{le="{2.0 ** e:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{name}_sum {h['sum']}")
+        lines.append(f"{name}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+#: The process's registry.  Subsystems grab handles at init and bump
+#: them unconditionally — a handle costs one int add, and the snapshot
+#: is only exported when a caller asks for it.
+DEFAULT = MetricsRegistry()

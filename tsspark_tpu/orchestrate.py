@@ -57,6 +57,9 @@ from typing import Callable, List, Optional, Tuple
 # Resilience layer: policies/faults/report are stdlib-only at import
 # time and integrity defers numpy, so the parent process stays as light
 # as before (children import the heavy stack themselves).
+# obs.context is stdlib-only too: with no trace bound (TSSPARK_TRACE
+# unset, no start_run) every call below is a single None check.
+from tsspark_tpu.obs import context as obs
 from tsspark_tpu.resilience import faults, integrity
 from tsspark_tpu.resilience.integrity import ChunkIntegrityError
 from tsspark_tpu.resilience.policy import (
@@ -192,11 +195,14 @@ def _prep_path(out_dir: str, lo: int, hi: int) -> str:
     return os.path.join(out_dir, f"prep_{lo:06d}_{hi:06d}.npz")
 
 
-def save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None) -> None:
+def save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None) -> bool:
     """One chunk's FitState -> chunk_<lo>_<hi>.npz.  Dotfile prefix + an
     atomic rename so a half-written file can never match the resume/eval
     glob; a payload CRC32 (resilience.integrity) so silent corruption is
-    caught at load time and quarantined instead of assembled."""
+    caught at load time and quarantined instead of assembled.  Returns
+    whether an armed fault plan corrupted the file post-save (the
+    observability land-span must not count a deliberately-torn save as
+    a healthy recovery signal)."""
     import numpy as np
 
     arrays = dict(
@@ -219,7 +225,7 @@ def save_chunk_atomic(out_dir, lo, hi, state, extra_arrays=None) -> None:
     path = _chunk_path(out_dir, lo, hi)
     stamped = integrity.stamp(arrays)
     atomic_write(path, lambda fh: np.savez(fh, **stamped))
-    faults.corrupt_file("chunk_save", path, lo=lo, hi=hi)
+    return faults.corrupt_file("chunk_save", path, lo=lo, hi=hi)
 
 
 def _state_from_chunk(z):
@@ -431,7 +437,8 @@ def _live_overlapping_lease(out_dir: str, lo: int, hi: int,
 
 
 def claim_lease(out_dir: str, lo: int, hi: int, token: str,
-                ttl_s: float = LEASE_TTL_S) -> bool:
+                ttl_s: float = LEASE_TTL_S,
+                span_id: Optional[str] = None) -> bool:
     """Claim the fit lease on range ``[lo, hi)``.
 
     Returns True when this ``token`` now holds the lease (fresh claim,
@@ -440,13 +447,20 @@ def claim_lease(out_dir: str, lo: int, hi: int, token: str,
     overlapping one (claim grids differ across workers).  The
     fresh-claim path is an atomic ``O_CREAT|O_EXCL``; steals/renewals
     replace the file atomically (utils.atomic), so a concurrent reader
-    sees the old record or the new one, never a torn mix."""
+    sees the old record or the new one, never a torn mix.
+
+    ``span_id``: the claimant's observability claim-span id, carried IN
+    the lease record — the cross-process trace propagation of the chunk
+    protocol.  A thief that steals this lease reads it back and links
+    its own claim span to the stolen one (``stolen_from``), so a
+    reclaimed range's spans parent correctly across the worker death."""
     if _live_overlapping_lease(out_dir, lo, hi, token):
         return False
     path = _lease_path(out_dir, lo, hi)
     payload = json.dumps({
         "token": token, "pid": os.getpid(),
         "expires_unix": round(time.time() + ttl_s, 3),
+        "span": span_id,
     })
     try:
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
@@ -572,6 +586,16 @@ def _chunk_mask(y_c, mask, lo, hi, chunk):
 # fit worker (accelerator child)
 # --------------------------------------------------------------------------
 
+def _metrics_chunk(live: int, fit_s: float) -> None:
+    """Per-chunk metrics (docs/OBSERVABILITY.md naming convention);
+    called only on the traced path — untraced fits skip even the
+    registry lookups."""
+    from tsspark_tpu.obs.metrics import DEFAULT
+
+    DEFAULT.counter("tsspark_fit_chunks_total").inc()
+    DEFAULT.counter("tsspark_fit_series_total").inc(live)
+    DEFAULT.histogram("tsspark_fit_chunk_seconds").observe(fit_s)
+
 def fit_worker(args) -> int:
     """Phase 1: every chunk at a short lockstep depth (phase1 iters), saved
     as it lands.  Phase 2 (once no chunk is missing over the whole range):
@@ -585,7 +609,39 @@ def fit_worker(args) -> int:
     same logic as an in-memory API; both phases' traced-dispatch triples
     come from backends.tpu.phase{1,2}_dynamic_args so the two
     implementations cannot drift.
+
+    Observability: when the spawner propagated a trace (TSSPARK_TRACE),
+    the worker adopts it, writes a crash-safe ``open`` record for its
+    own span FIRST (a SIGKILLed worker's chunk spans then still have a
+    parent in the ledger), records claim/fit/land spans per chunk into
+    the shared ``spans.jsonl``, and exports its metrics snapshot at
+    clean exit.  With no trace bound, all of it is a None check.
     """
+    obs.adopt_env()
+    t_w0 = time.time()
+    wspan = obs.open_span("fit.worker", make_current=True,
+                          lo=args.lo, hi=args.hi, chunk=args.chunk)
+    try:
+        rc = _fit_worker_body(args)
+    except BaseException:
+        obs.close_span(wspan, "fit.worker", t_w0, status="err")
+        raise
+    obs.close_span(wspan, "fit.worker", t_w0, rc=rc)
+    if obs.active():
+        from tsspark_tpu.obs.metrics import DEFAULT
+
+        try:
+            DEFAULT.export(
+                os.path.join(args.out,
+                             f"metrics_fit_{os.getpid()}.json"),
+                trace_id=obs.trace_id(),
+            )
+        except OSError:
+            pass
+    return rc
+
+
+def _fit_worker_body(args) -> int:
     jax = _setup_jax_child()
     import numpy as np
 
@@ -729,6 +785,11 @@ def fit_worker(args) -> int:
     # never double-land it.
     claimed: List[Tuple[int, int]] = []
     lease_token = f"{os.getpid()}.{int(t_worker0 * 1e3)}"
+    # Per-range observability claim spans: the span id travels IN the
+    # lease file, so a thief that steals a dead predecessor's range can
+    # link its claim to the stolen one (cross-process span parentage
+    # through the chunk protocol itself).
+    claim_spans: dict = {}
 
     def next_claim():
         width = tuner.next_size() if tuner is not None else args.chunk
@@ -736,9 +797,22 @@ def fit_worker(args) -> int:
             completed_ranges(args.out) + claimed, args.lo, args.hi, width
         )
         for lo2, hi2 in todo2:
-            if not claim_lease(args.out, lo2, hi2, lease_token):
+            prior = read_lease(args.out, lo2, hi2) if obs.active() \
+                else None
+            claim_sid = obs.new_id() if obs.active() else None
+            if not claim_lease(args.out, lo2, hi2, lease_token,
+                               span_id=claim_sid):
                 continue  # a LIVE sibling owns this range; leave it
             claimed.append((lo2, hi2))
+            if claim_sid is not None:
+                claim_spans[(lo2, hi2)] = claim_sid
+                stolen = (prior.get("span")
+                          if prior and prior.get("token") != lease_token
+                          else None)
+                extra = {"stolen_from": stolen} if stolen else {}
+                obs.record("chunk.claim", time.time(), 0.0,
+                           span_id=claim_sid, lo=lo2, hi=hi2,
+                           width=width, **extra)
             return lo2, hi2, width
         return None
 
@@ -783,9 +857,25 @@ def fit_worker(args) -> int:
                 f"[orchestrate] lease on [{lo}, {hi}) lost; discarding "
                 f"this worker's result (fenced)", file=sys.stderr,
             )
+            obs.event("fenced", lo=lo, hi=hi)
             return
-        save_chunk_atomic(args.out, lo, hi, state)
+        t_save0 = time.time()
+        corrupted = save_chunk_atomic(args.out, lo, hi, state)
         release_lease(args.out, lo, hi, lease_token)
+        if obs.active():
+            # claim -> fit -> land chain, timed off the clocks this
+            # function already owns (the PerfRecorder-shaped telemetry
+            # in times.jsonl and these spans are one measurement).
+            fit_sid = obs.record(
+                "chunk.fit", t_save0 - fit_s, fit_s,
+                parent_id=claim_spans.get((lo, hi)),
+                lo=lo, hi=hi, width=width, live=hi - lo,
+                compile_miss=bool(compiled),
+            )
+            obs.record("chunk.land", t_save0, time.time() - t_save0,
+                       parent_id=fit_sid, lo=lo, hi=hi,
+                       **({"corrupted": True} if corrupted else {}))
+            _metrics_chunk(hi - lo, fit_s)
         try:  # prep payload served its purpose; bound scratch disk
             os.remove(_prep_path(args.out, lo, hi))
         except OSError:
@@ -969,6 +1059,7 @@ def fit_worker(args) -> int:
         # forever when phase1_iters >= max_iters).
         if not missing_ranges(completed_ranges(args.out), args.series):
             atomic_write_text(marker, "ok\n")
+            obs.record("phase2.done", time.time(), 0.0)
         return 0
     done = completed_ranges(args.out)
     if missing_ranges(done, args.series):
@@ -1224,10 +1315,17 @@ def fit_worker(args) -> int:
             state = _state_from_chunk(z)
             sub = jax.tree.map(lambda a: np.asarray(a)[in_chunk], state2)
             patched = patch_state(state, local, sub)
-            save_chunk_atomic(
+            t_patch0 = time.time()
+            corrupted = save_chunk_atomic(
                 args.out, lo, hi, patched,
                 extra_arrays={"phase2": np.asarray(1)},
             )
+            # The patch rewrites the chunk file (new mtime): without
+            # this land record the span ledger and the on-disk recovery
+            # signals would disagree about when the range last landed.
+            obs.record("chunk.land", t_patch0, time.time() - t_patch0,
+                       lo=lo, hi=hi, phase2=True,
+                       **({"corrupted": True} if corrupted else {}))
     with open(os.path.join(args.out, "times.jsonl"), "a") as fh:
         fh.write(json.dumps({
             "phase2_s": round(time.time() - t0, 3),
@@ -1235,6 +1333,9 @@ def fit_worker(args) -> int:
             "phase2_mode": phase2_mode,
         }) + "\n")
     atomic_write_text(marker, "ok\n")
+    obs.record("fit.phase2", t0, time.time() - t0,
+               stragglers=len(straggler_idx), mode=phase2_mode)
+    obs.record("phase2.done", time.time(), 0.0)
     return 0
 
 
@@ -1250,6 +1351,7 @@ def prep_worker(args) -> int:
     wedged accelerator cannot block it): when the runtime recovers, the
     fit worker finds its first chunks pre-packed and goes straight to
     device work instead of paying host prep on the critical path."""
+    obs.adopt_env()  # prep-side fault events join the run's trace
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     _setup_jax_child()
     import numpy as np
@@ -1367,9 +1469,28 @@ def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
 
     ``force_cpu`` pins the child to the CPU backend (prep workers
     always; fit workers after the parent's probe budget declares the
-    accelerator path dead — see run_resilient's probe_budget_s)."""
+    accelerator path dead — see run_resilient's probe_budget_s).
+
+    Observability: each spawn is one ``worker.attempt`` span; its id is
+    injected into the child's environment as the cross-process parent,
+    so the child's ``fit.worker`` span (and everything under it)
+    parents to this attempt in the run ledger."""
+    t_spawn0 = time.time()
+    # Open-first, like fit.worker: the attempt's open record must be on
+    # disk BEFORE the child starts parenting spans to it — a parent
+    # killed mid-wait must not orphan the whole child subtree.
+    attempt_sid = obs.open_span("worker.attempt", mode=mode) \
+        if obs.active() else None
+
+    def finish(rc: int) -> int:
+        if attempt_sid is not None:
+            obs.close_span(attempt_sid, "worker.attempt", t_spawn0,
+                           mode=mode, rc=rc,
+                           status="ok" if rc == 0 else "err")
+        return rc
+
     if faults.inject("worker_spawn"):
-        return -9  # injected spawn failure (same rc as a killed worker)
+        return finish(-9)  # injected spawn failure (same rc as killed)
     if policy is not None:
         per_attempt = policy.attempt_timeout(0)
         if per_attempt is not None:
@@ -1377,9 +1498,10 @@ def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
                        else min(timeout, per_attempt))
     cmd = [sys.executable, "-m", "tsspark_tpu.orchestrate", mode,
            "--data", data_dir, "--out", out_dir] + extra
+    env = _child_env(force_cpu=force_cpu or (mode == "--_prep"))
+    obs.inject_env(env, parent_id=attempt_sid)
     proc = subprocess.Popen(
-        cmd, stdout=log_stream or sys.stderr,
-        env=_child_env(force_cpu=force_cpu or (mode == "--_prep")),
+        cmd, stdout=log_stream or sys.stderr, env=env,
     )
     _CHILDREN.add(proc)
     start = time.time()
@@ -1391,7 +1513,7 @@ def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
     try:
         while True:
             try:
-                return proc.wait(timeout=10.0)
+                return finish(proc.wait(timeout=10.0))
             except subprocess.TimeoutExpired:
                 pass
             now = time.time()
@@ -1424,7 +1546,7 @@ def spawn_worker(mode: str, data_dir: str, out_dir: str, extra: list,
                 )
                 proc.kill()
                 proc.wait()
-                return -9
+                return finish(-9)
     finally:
         _CHILDREN.discard(proc)
 

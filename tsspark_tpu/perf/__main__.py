@@ -1,9 +1,10 @@
 """``python -m tsspark_tpu.perf`` — print a fit's perf telemetry.
 
-Accepts either a BENCH summary JSON (``bench.py``'s one-line output,
-e.g. a committed ``BENCH_*.json`` — reads ``extra.perf``) or an
-orchestrate scratch/out directory (reads ``times.jsonl`` +
-``autotune.json`` directly).  Device-free: never imports JAX.
+Accepts a BENCH summary JSON (``bench.py``'s one-line output, e.g. a
+committed ``BENCH_*.json`` — reads ``extra.perf``), an orchestrate
+scratch/out directory (reads ``times.jsonl`` + ``autotune.json``
+directly), or a ``RUNLEDGER_*.json`` run ledger (tsspark_tpu.obs —
+reads its embedded ``perf`` block).  Device-free: never imports JAX.
 """
 
 from __future__ import annotations
@@ -39,6 +40,14 @@ def _load(target: str) -> dict:
         return summarize_times(times, autotune)
     with open(target) as fh:
         summary = json.load(fh)
+    if summary.get("kind") == "run-ledger":
+        perf = summary.get("perf")
+        if perf is None:
+            raise SystemExit(
+                f"{target}: run ledger carries no perf block (no "
+                "times.jsonl rows were found when it was built)"
+            )
+        return perf
     perf = summary.get("extra", {}).get("perf")
     if perf is None:
         raise SystemExit(

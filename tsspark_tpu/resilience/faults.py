@@ -96,7 +96,11 @@ class FaultPlan:
 
     def fail(self, point: str, *, attempts: int = 1, after: int = 0,
              mode: str = "raise", series: Optional[int] = None,
-             rc: int = 23, delay_s: float = 0.5) -> "FaultPlan":
+             rc: int = 23, delay_s: float = 0.5,
+             tag: Optional[str] = None) -> "FaultPlan":
+        """``tag``: free-form class label stamped onto the observability
+        event a firing emits (the chaos storm tags rules with their
+        fault class so MTTR is readable off the span ledger)."""
         if mode not in _MODES:
             raise ValueError(f"mode {mode!r} not in {_MODES}")
         if attempts < 1 or after < 0:
@@ -105,7 +109,7 @@ class FaultPlan:
             "id": f"r{len(self.rules)}_{point}",
             "point": point, "attempts": int(attempts), "after": int(after),
             "mode": mode, "series": series, "rc": int(rc),
-            "delay_s": float(delay_s),
+            "delay_s": float(delay_s), "tag": tag,
         })
         return self
 
@@ -177,6 +181,23 @@ def _armed_call(rule: dict, state_dir: str,
     return rule["after"] <= n < rule["after"] + rule["attempts"]
 
 
+def _obs_fault(rule: dict, point: str,
+               lo: Optional[int], hi: Optional[int]) -> None:
+    """Span-ledger annotation for one firing: the moment a fault was
+    injected becomes readable off the trace (MTTR from spans), not just
+    off the claim files' mtimes.  Best-effort; never breaks the site."""
+    try:
+        from tsspark_tpu.obs import context as obs
+
+        attrs = {"point": point, "rule": rule["id"],
+                 "mode": rule["mode"], "tag": rule.get("tag")}
+        if lo is not None:
+            attrs["lo"], attrs["hi"] = lo, hi
+        obs.event("fault", **attrs)
+    except Exception:
+        pass
+
+
 def inject(point: str, *, lo: Optional[int] = None,
            hi: Optional[int] = None) -> bool:
     """Fault injection point.  No-op (False) unless a plan arms ``point``.
@@ -195,6 +216,7 @@ def inject(point: str, *, lo: Optional[int] = None,
             continue
         if not _armed_call(rule, plan.state_dir, lo, hi):
             continue
+        _obs_fault(rule, point, lo, hi)
         if rule["mode"] == "exit":
             os._exit(rule["rc"])
         if rule["mode"] == "raise":
@@ -224,6 +246,7 @@ def corrupt_file(point: str, path: str, *, lo: Optional[int] = None,
             continue
         if not _armed_call(rule, plan.state_dir, lo, hi):
             continue
+        _obs_fault(rule, point, lo, hi)
         try:
             size = os.path.getsize(path)
             with open(path, "r+b") as fh:

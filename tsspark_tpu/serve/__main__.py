@@ -72,6 +72,8 @@ def _loadgen(args) -> int:
     import numpy as np
 
     from tsspark_tpu.models.prophet import predict as predict_mod
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
     from tsspark_tpu.perf import CompileWatch, PerfRecorder
     from tsspark_tpu.resilience import RetryPolicy
     from tsspark_tpu.serve.cache import ForecastCache
@@ -82,6 +84,12 @@ def _loadgen(args) -> int:
     from tsspark_tpu.utils.atomic import atomic_write
 
     t_start = time.perf_counter()
+    # One trace per loadgen run: engine request/dispatch spans land in
+    # the scratch's spans.jsonl, and the SERVE report is stamped with
+    # the trace id so the run ledger joins the two.
+    scratch_root = os.path.join(args.dir or ".", "serve_scratch")
+    obs.start_run(os.path.join(scratch_root, "spans.jsonl"))
+    METRICS.reset()  # this run's snapshot describes this run only
     if args.registry and os.path.exists(
         os.path.join(args.registry, "manifest.json")
     ):
@@ -140,9 +148,12 @@ def _loadgen(args) -> int:
     wall_s = time.perf_counter() - t0
 
     stats = engine.stats.snapshot()
+    METRICS.export(os.path.join(scratch_root, "metrics_loadgen.json"),
+                   trace_id=obs.trace_id())
     report = {
         "kind": "serve-loadgen",
         "unix": round(time.time(), 3),
+        "trace_id": obs.trace_id(),
         "n_requests": n,
         "n_series": n_series,
         "mix": {
@@ -176,10 +187,15 @@ def _loadgen(args) -> int:
 
 
 def _daemon(args) -> int:
+    from tsspark_tpu.obs import context as obs
     from tsspark_tpu.serve.engine import PredictionEngine
     from tsspark_tpu.serve.registry import ParamRegistry
 
     registry = ParamRegistry.open(args.registry)
+    # Daemon spans live next to the registry it serves; a request line
+    # may carry a ``trace`` envelope ({"trace_id", "parent_span_id"})
+    # and its engine spans then join the CALLER's trace.
+    obs.start_run(os.path.join(args.registry, "spans.jsonl"))
     engine = PredictionEngine(
         registry, max_queue=args.max_queue, max_batch=args.max_batch,
     )
@@ -195,8 +211,11 @@ def _daemon(args) -> int:
 
 
 def _serve_lines(registry, engine, emit) -> int:
+    import contextlib
+
     import numpy as np
 
+    from tsspark_tpu.obs import context as obs
     from tsspark_tpu.serve.engine import ServeError
     from tsspark_tpu.serve.registry import RegistryError
 
@@ -229,13 +248,18 @@ def _serve_lines(registry, engine, emit) -> int:
                 emit({"ok": True, "id": rid, "active_version": v})
                 continue
             deadline_ms = msg.get("deadline_ms")
-            res = engine.forecast(
-                msg["series_ids"], int(msg["horizon"]),
-                num_samples=int(msg.get("num_samples", 0)),
-                seed=int(msg.get("seed", 0)),
-                deadline_in_s=(None if deadline_ms is None
-                               else float(deadline_ms) / 1e3),
-            )
+            tr = msg.get("trace") or {}
+            ctx = (obs.remote_context(tr.get("trace_id"),
+                                      tr.get("parent_span_id"))
+                   if tr else contextlib.nullcontext())
+            with ctx:
+                res = engine.forecast(
+                    msg["series_ids"], int(msg["horizon"]),
+                    num_samples=int(msg.get("num_samples", 0)),
+                    seed=int(msg.get("seed", 0)),
+                    deadline_in_s=(None if deadline_ms is None
+                                   else float(deadline_ms) / 1e3),
+                )
             emit({
                 "ok": True, "id": rid, "version": res.version,
                 "series_ids": list(res.series_ids),

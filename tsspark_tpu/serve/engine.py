@@ -41,6 +41,8 @@ import numpy as np
 
 from tsspark_tpu.backends.registry import ForecastBackend, get_backend
 from tsspark_tpu.config import SolverConfig
+from tsspark_tpu.obs import context as obs
+from tsspark_tpu.obs.metrics import DEFAULT as METRICS
 from tsspark_tpu.parallel.sharding import compacted_width, next_pow2
 from tsspark_tpu.resilience import faults
 from tsspark_tpu.resilience.policy import CircuitBreaker
@@ -161,6 +163,9 @@ class PendingForecast:
     def __init__(self, request: ForecastRequest):
         self.request = request
         self.submitted_s = time.monotonic()
+        # Wall-clock twin of submitted_s: span records join across
+        # processes on wall time; latency math stays on the monotonic.
+        self.submitted_unix = time.time()
         self._event = threading.Event()
         self._result: Optional["ForecastResult"] = None
         self._error: Optional[BaseException] = None
@@ -316,9 +321,35 @@ class PredictionEngine:
         self._pump_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Metric handles resolved once (docs/OBSERVABILITY.md naming):
+        # the hot path pays one int add per outcome, no dict lookups.
+        self._m_req = {
+            r: METRICS.counter("tsspark_serve_requests_total", result=r)
+            for r in ("completed", "shed", "failed", "rejected")
+        }
+        self._m_latency = METRICS.histogram(
+            "tsspark_serve_request_seconds"
+        )
+        self._m_dispatches = METRICS.counter(
+            "tsspark_serve_dispatches_total"
+        )
+        self._m_queue = METRICS.gauge("tsspark_serve_queue_depth")
         # In-process activations invalidate immediately; refresh() also
         # polls the manifest so cross-process flips are picked up.
         registry.subscribe(self._on_activate)
+
+    def _obs_request(self, pend: PendingForecast, status: str,
+                     **attrs) -> None:
+        """One ``serve.request`` span per resolved request: admission
+        (submit) -> completion, the engine-side latency the SERVE_*
+        report percentiles summarize — same clock, same value."""
+        if not obs.active():
+            return
+        dur = time.monotonic() - pend.submitted_s
+        req = pend.request
+        obs.record("serve.request", pend.submitted_unix, dur,
+                   status=status, n_series=len(req.series_ids),
+                   horizon=req.horizon, **attrs)
 
     # -- snapshot lifecycle ----------------------------------------------------
 
@@ -404,6 +435,8 @@ class PredictionEngine:
             self._queue.put_nowait(pend)
         except queue.Full:
             self.stats.rejected += 1
+            self._m_req["rejected"].inc()
+            self._obs_request(pend, "err", reason="overloaded")
             raise EngineOverloaded(
                 f"request queue full ({self._queue.maxsize})"
             )
@@ -449,11 +482,14 @@ class PredictionEngine:
                 except queue.Empty:
                     break
             self.stats.pumps += 1
+            self._m_queue.set(self._queue.qsize())
             try:
                 snap = self.refresh()
             except Exception as e:
                 for pend in batch:
                     pend._fail(e)
+                    self._m_req["failed"].inc()
+                    self._obs_request(pend, "err", reason="refresh")
                 self.stats.failed += len(batch)
                 return len(batch)
             now = time.monotonic()
@@ -464,6 +500,8 @@ class PredictionEngine:
                 if req.deadline_s is not None and now > req.deadline_s:
                     pend._fail(RequestShed(req.deadline_s, now))
                     self.stats.shed += 1
+                    self._m_req["shed"].inc()
+                    self._obs_request(pend, "err", reason="shed")
                     resolved += 1
                     continue
                 hb = max(self.horizon_floor, next_pow2(req.horizon))
@@ -493,11 +531,16 @@ class PredictionEngine:
                 # crash the batch it was coalesced into.
                 pend._fail(ValueError("series_ids must be non-empty"))
                 self.stats.failed += 1
+                self._m_req["failed"].inc()
+                self._obs_request(pend, "err", reason="empty-request")
                 continue
             idx, missing = snap.rows(pend.request.series_ids)
             if missing:
                 pend._fail(UnknownSeries(missing, version))
                 self.stats.failed += 1
+                self._m_req["failed"].inc()
+                self._obs_request(pend, "err", reason="unknown-series",
+                                  version=version)
                 continue
             live.append(pend)
             for sid in pend.request.series_ids:
@@ -516,8 +559,13 @@ class PredictionEngine:
                 fresh = self._dispatch(snap, needed, hb, num_samples,
                                        seed, n_requests=len(live))
             except Exception as e:
+                reason = (e.reason if isinstance(e, ServeError)
+                          else type(e).__name__)
                 for pend in live:
                     pend._fail(e)
+                    self._m_req["failed"].inc()
+                    self._obs_request(pend, "err", reason=reason,
+                                      version=version)
                 self.stats.failed += len(live)
                 return len(pends)
             # Activation-race note: if an activation lands while the
@@ -539,16 +587,25 @@ class PredictionEngine:
                 k: np.stack([rows[s][k] for s in sids])[:, :h]
                 for k in rows[sids[0]] if k != "ds"
             }
+            cached = sum(1 for s in sids if hits.get(s))
             pend._complete(ForecastResult(
                 series_ids=sids,
                 ds=np.stack([rows[s]["ds"] for s in sids])[:, :h],
                 values=values,
                 version=version,
                 latency_s=done_s - pend.submitted_s,
-                from_cache=sum(1 for s in sids if hits.get(s)),
+                from_cache=cached,
             ))
             self.stats.completed += 1
             self.stats.latencies_s.append(done_s - pend.submitted_s)
+            self._m_req["completed"].inc()
+            self._m_latency.observe(done_s - pend.submitted_s)
+            if obs.active():
+                obs.record(
+                    "serve.request", pend.submitted_unix,
+                    done_s - pend.submitted_s, version=version,
+                    n_series=len(sids), horizon=h, cached=cached,
+                )
         return len(pends)
 
     def _dispatch(self, snap: Snapshot, sids: List[str], hb: int,
@@ -591,6 +648,8 @@ class PredictionEngine:
         # escape must resolve the breaker's half-open trial slot, or the
         # breaker wedges with the trial marked in flight forever.
         ok = False
+        t_disp0 = time.time()
+        m_disp0 = time.monotonic()
         try:
             with ctx:
                 if self.retry_policy is not None:
@@ -603,7 +662,14 @@ class PredictionEngine:
             if self.breaker is not None:
                 (self.breaker.record_success if ok
                  else self.breaker.record_failure)()
+            if obs.active():
+                obs.record("serve.dispatch", t_disp0,
+                           time.monotonic() - m_disp0,
+                           status="ok" if ok else "err",
+                           width=width, live=n, horizon=hb,
+                           version=snap.version)
         self.stats.dispatches += 1
+        self._m_dispatches.inc()
         self.stats.occupancy.append((n, width, n_requests))
         result: Dict[str, Dict] = {}
         for i, sid in enumerate(sids):

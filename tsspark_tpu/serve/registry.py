@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from tsspark_tpu.config import NUMERICS_REV, ProphetConfig
 from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.obs import context as obs
 from tsspark_tpu.resilience import integrity
 from tsspark_tpu.utils import checkpoint as ckpt
 from tsspark_tpu.utils.atomic import atomic_write, sweep_stale_temps
@@ -265,6 +266,7 @@ class ParamRegistry:
         first, manifest last); optionally activate it.  Returns the new
         version number.  Concurrent publishers serialize on the
         manifest lock (``_locked``)."""
+        t_pub0 = time.time()
         ids = np.asarray([str(s) for s in series_ids])
         if len(ids) != int(np.asarray(state.theta).shape[0]):
             raise ValueError(
@@ -303,12 +305,16 @@ class ParamRegistry:
                 m["previous_version"] = m["active_version"]
                 m["active_version"] = version
             self._write_manifest(m)
+        obs.record("registry.publish", t_pub0, time.time() - t_pub0,
+                   version=version, n_series=int(len(ids)),
+                   activated=bool(activate))
         if activate:
             self._notify(version)
         return version
 
     def activate(self, version: int) -> None:
         """Flip the active pointer to an already-published version."""
+        t_act0 = time.time()
         with self._locked():
             m = self._read_manifest()
             if str(int(version)) not in m["versions"]:
@@ -322,6 +328,8 @@ class ParamRegistry:
                 m["active_version"] = int(version)
                 self._write_manifest(m)
         if flipped:
+            obs.record("registry.activate", t_act0,
+                       time.time() - t_act0, version=int(version))
             self._notify(int(version))
 
     def rollback(self) -> int:
@@ -353,6 +361,7 @@ class ParamRegistry:
         version (with a loud warning and ``Snapshot.fallback_from``
         set), never take it down.  An explicitly requested version
         always raises."""
+        t_load0 = time.time()
         m = self._read_manifest()
         requested = version
         if version is None:
@@ -361,7 +370,10 @@ class ParamRegistry:
                 raise RegistryError("no-active-version",
                                     "nothing has been activated yet")
         try:
-            return self._load_version(m, int(version))
+            snap = self._load_version(m, int(version))
+            obs.record("registry.load", t_load0, time.time() - t_load0,
+                       version=int(version))
+            return snap
         except RegistryError as e:
             if (requested is not None or not fallback
                     or e.reason != "corrupt-snapshot"):
@@ -377,6 +389,9 @@ class ParamRegistry:
                     f"version {v} — republish or rollback to clear",
                     RuntimeWarning,
                 )
+                obs.record("registry.load", t_load0,
+                           time.time() - t_load0, version=v,
+                           fallback_from=int(version))
                 return dataclasses.replace(snap,
                                            fallback_from=int(version))
             raise

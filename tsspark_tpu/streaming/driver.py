@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from tsspark_tpu import native
 from tsspark_tpu.backends.registry import get_backend
 from tsspark_tpu.config import ProphetConfig, SolverConfig
+from tsspark_tpu.obs import context as obs
+from tsspark_tpu.obs.metrics import DEFAULT as METRICS
 from tsspark_tpu.frame import _days_to_ts, _ds_to_days
 from tsspark_tpu.models.prophet.design import prepare_fit_data
 from tsspark_tpu.models.prophet.init import initial_theta
@@ -201,6 +203,13 @@ class StreamingForecaster:
         self.stats.fit_seconds += dt
         self.stats.last_batch_seconds = dt
         self.stats.batch_seconds.append(dt)
+        if obs.active():
+            obs.record("stream.batch", t0, dt, rows=int(len(batch)),
+                       touched=len(touched), warm=int(found.sum()),
+                       cold=int((~found).sum()))
+            METRICS.counter("tsspark_stream_batches_total").inc()
+            METRICS.counter("tsspark_stream_rows_total").inc(len(batch))
+            METRICS.histogram("tsspark_stream_batch_seconds").observe(dt)
 
     def run(self, source: MicroBatchSource,
             max_batches: Optional[int] = None,

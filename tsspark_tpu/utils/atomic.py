@@ -58,6 +58,26 @@ def atomic_write_text(path: str, text: str) -> None:
     atomic_write(path, lambda fh: fh.write(text), mode="w")
 
 
+def append_line(path: str, line: str) -> None:
+    """Crash-safe append of ONE line to a shared JSONL log.
+
+    The whole line (newline included) goes down in a single
+    ``os.write`` on an ``O_APPEND`` descriptor: POSIX makes each such
+    write land at the then-current end of file, so concurrent writer
+    PROCESSES (the span log is appended by the orchestrate parent, its
+    fit workers, and the serving engine at once) never interleave bytes
+    mid-line.  A writer killed between lines leaves a valid file; a
+    writer killed mid-write can tear at most its own last line, which
+    every reader of these logs already tolerates (same contract as
+    ``times.jsonl``)."""
+    data = (line if line.endswith("\n") else line + "\n").encode()
+    fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
 # A live writer keeps its temp's mtime moving (np.savez streams to the
 # fd); 10 minutes of silence means the writer is dead — far beyond the
 # orchestrator's stall watchdog, which kills a worker after ~90-270 s

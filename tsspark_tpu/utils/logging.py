@@ -34,12 +34,22 @@ class _JsonFormatter(logging.Formatter):
 
 
 class StructuredLogger:
-    """Thin wrapper adding keyword fields to stdlib logging."""
+    """Thin wrapper adding keyword fields to stdlib logging.
+
+    When an observability trace is active (tsspark_tpu.obs), every
+    event is stamped with the current ``trace_id``/``span_id`` — log
+    lines then grep-join against the run's span ledger for free.
+    """
 
     def __init__(self, logger: logging.Logger):
         self._logger = logger
 
     def _log(self, level: int, event: str, **fields: Any) -> None:
+        from tsspark_tpu.obs import context as _obs
+
+        ids = _obs.current_ids()
+        if ids is not None:
+            fields = {**ids, **fields}
         self._logger.log(level, event, extra={"fields": fields})
 
     def debug(self, event: str, **fields: Any) -> None:
@@ -91,17 +101,21 @@ def get_logger(name: str = "tsspark", level: Optional[int] = None
 
 
 class timed:
-    """Context manager: logs wall-clock of a block as a structured event."""
+    """Context manager: logs wall-clock of a block as a structured event.
+
+    Durations come off ``time.monotonic`` — an NTP step or operator
+    clock adjustment mid-block must not produce a negative (or wildly
+    inflated) ``seconds`` field."""
 
     def __init__(self, log: StructuredLogger, event: str, **fields: Any):
         self.log, self.event, self.fields = log, event, fields
 
     def __enter__(self):
-        self.t0 = time.time()
+        self.t0 = time.monotonic()
         return self
 
     def __exit__(self, exc_type, *_):
-        self.fields["seconds"] = round(time.time() - self.t0, 4)
+        self.fields["seconds"] = round(time.monotonic() - self.t0, 4)
         if exc_type is not None:
             self.fields["failed"] = True
         self.log.info(self.event, **self.fields)

@@ -43,11 +43,13 @@ class Timers:
 
     @contextlib.contextmanager
     def section(self, name: str) -> Iterator[None]:
-        t0 = time.time()
+        # Monotonic: a wall-clock adjustment mid-section must not land a
+        # negative (or inflated) duration in the aggregate.
+        t0 = time.monotonic()
         try:
             yield
         finally:
-            self._total[name] += time.time() - t0
+            self._total[name] += time.monotonic() - t0
             self._count[name] += 1
 
     def summary(self) -> Dict[str, Dict[str, float]]:
