@@ -321,8 +321,17 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
     projected = fit_s * args.series / n_done if n_done else 0.0
     from tsspark_tpu.obs import context as obs
 
+    from tsspark_tpu.obs.history import git_rev
+
     extra = {
         "trace_id": obs.trace_id(),
+        # Cross-run identity for the history index (obs.history): the
+        # regression sentinel only baselines rows with a matching
+        # numerics revision, and the git rev names the commit to bisect
+        # when a breach fires.
+        "numerics_rev": BENCH_NUMERICS_REV,
+        "git_rev": git_rev(REPO),
+        "config_fingerprint": _code_fingerprint(),
         "smape_insample_mean": smape,
         "converged_frac": round(float(np.mean(conv)), 4) if conv else 0.0,
         "n_iters_max": n_iters_max,
@@ -714,6 +723,29 @@ def main() -> None:
     # change invalidates them anyway).
     if not args.keep and summary["extra"].get("complete"):
         shutil.rmtree(scratch, ignore_errors=True)
+    # Regression sentinel post-step (docs/OBSERVABILITY.md "Trajectory
+    # & SLOs"): the summary joins RUNHISTORY.jsonl and is judged
+    # against the rolling baseline; a throughput/first-flush/accuracy
+    # breach exits nonzero AFTER the one summary line is out, so the
+    # run that introduced a regression fails loudly while the artifact
+    # contract stays intact.  TSSPARK_SENTINEL=0 opts out; sentinel
+    # machinery failures only warn — they must never mask the summary.
+    if os.environ.get("TSSPARK_SENTINEL", "1") != "0":
+        try:
+            from tsspark_tpu.obs import regress
+
+            verdict = regress.sentinel_report(
+                summary, source=f"bench:{summary['metric']}"
+            )
+            if verdict is not None:
+                print(f"[bench] {regress.summarize(verdict)}",
+                      file=sys.stderr)
+                if not verdict["ok"]:
+                    sys.exit(1)
+        except SystemExit:
+            raise
+        except Exception as e:
+            print(f"[bench] sentinel skipped: {e!r}", file=sys.stderr)
 
 
 if __name__ == "__main__":

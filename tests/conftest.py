@@ -10,6 +10,11 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The regression sentinel's entrypoint post-steps (bench.py, serve
+# --loadgen, chaos CLI) would otherwise append RUNHISTORY.jsonl rows in
+# the pytest cwd and gate test runs on machine-local baselines; the
+# sentinel itself is tested explicitly in tests/test_history.py.
+os.environ["TSSPARK_SENTINEL"] = "0"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

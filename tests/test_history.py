@@ -1,0 +1,448 @@
+"""Cross-run history index + regression sentinel + live watch
+(tsspark_tpu/obs/{history,regress,watch}.py, docs/OBSERVABILITY.md
+"Trajectory & SLOs").
+
+The issue's acceptance, pinned as tests: backfill ingests every
+committed BENCH/EVAL round artifact into a non-empty trajectory; the
+reader tolerates a torn final line and a duplicate ingest (idempotent
+by trace id); the sentinel is green on an unchanged re-run and red
+(nonzero CLI exit) on an injected 3x throughput or p99 regression; the
+watcher records SLO breaches back into the watched run's own trace.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from tsspark_tpu.obs import context, history, regress, watch  # noqa: E402
+from tsspark_tpu.obs.__main__ import main as obs_main  # noqa: E402
+from tsspark_tpu.utils.atomic import append_line  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _unbind_obs_run():
+    yield
+    context.end_run(None)
+
+
+def _bench_report(trace, series_per_s, first_flush_s=5.0,
+                  workload="m5_512x256_fit_wall_clock"):
+    return {
+        "metric": workload, "value": 8.0, "unit": "s",
+        "vs_baseline": 0.1,
+        "extra": {
+            "trace_id": trace, "numerics_rev": 7,
+            "device": "TFRT_CPU_0", "series_per_s": series_per_s,
+            "series_done": 512, "complete": True, "datagen_s": 3.0,
+            "perf": {"first_flush_s": first_flush_s,
+                     "compile_misses": 2},
+        },
+    }
+
+
+def _serve_report(trace, p99, n=200):
+    return {
+        "kind": "serve-loadgen", "unix": 1000.0, "trace_id": trace,
+        "numerics_rev": 7, "n_requests": n, "n_series": 48,
+        "wall_s": 1.0, "requests_per_s": n / 1.0,
+        "engine": {
+            "submitted": n, "completed": n, "shed": 2, "failed": 0,
+            "rejected": 0,
+            "latency_ms": {"p50": 2.0, "p95": 5.0, "p99": p99,
+                           "mean": 2.5, "max": p99},
+            "batch_occupancy": {"mean_fill": 0.8},
+        },
+        "cache": {"hit_rate": 0.4},
+    }
+
+
+# ---------------------------------------------------------------------------
+# history index
+# ---------------------------------------------------------------------------
+
+
+def test_backfill_ingests_committed_artifacts(tmp_path):
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+    summary = history.backfill(REPO, hpath)
+    rows = history.read_history(hpath)
+    by_kind = {}
+    for r in rows:
+        by_kind.setdefault(r["kind"], []).append(r)
+    # The committed trajectory: BENCH_r01-r05 (driver wrappers, incl.
+    # r01's parsed:null crash round) + BENCH_builder_r06, and the five
+    # EVAL parity artifacts.
+    assert len(by_kind.get("bench", [])) >= 6, summary
+    assert len(by_kind.get("eval", [])) >= 5, summary
+    # Round order survives the glob: r06 (the only complete run) last.
+    bench_sources = [r["source"] for r in by_kind["bench"]]
+    assert bench_sources[0] == "BENCH_r01.json"
+    assert bench_sources[5] == "BENCH_builder_r06.json"
+    r06 = by_kind["bench"][5]
+    assert r06["device_class"] == "cpu"
+    assert r06["metrics"]["series_per_s"] == 63.44
+    assert r06["metrics"]["first_flush_s"] == 20.73
+    # Non-empty rendered trajectory (the roadmap's ask).
+    lines = history.trajectory(rows)
+    assert any("bench trajectory" in ln for ln in lines)
+    assert any("series_per_s=63.44" in ln for ln in lines)
+    # Idempotent: a second backfill appends nothing.
+    again = history.backfill(REPO, hpath)
+    assert again["ingested"] == []
+    assert len(history.read_history(hpath)) == len(rows)
+
+
+def test_ingest_idempotent_by_trace_id(tmp_path):
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+    row1, app1 = history.ingest(_bench_report("t-abc", 60.0), hpath)
+    row2, app2 = history.ingest(_bench_report("t-abc", 60.0), hpath)
+    assert app1 and not app2
+    assert row1["row_id"] == row2["row_id"] == "bench:t-abc"
+    assert len(history.read_history(hpath)) == 1
+    # A different trace is a different row.
+    _, app3 = history.ingest(_bench_report("t-def", 61.0), hpath)
+    assert app3 and len(history.read_history(hpath)) == 2
+
+
+def test_history_reader_tolerates_torn_tail_and_junk(tmp_path):
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+    history.ingest(_bench_report("t-1", 60.0), hpath)
+    history.ingest(_serve_report("t-2", 8.0), hpath)
+    # A writer killed mid-append tears its own last line; earlier rows
+    # must survive, and non-row junk lines are skipped.
+    append_line(hpath, json.dumps({"not": "a row"}))
+    with open(hpath, "a") as fh:
+        fh.write('{"kind": "bench", "row_id": "bench:torn", "metr')
+    rows = history.read_history(hpath)
+    assert [r["row_id"] for r in rows] == ["bench:t-1", "serve:t-2"]
+    # Serve normalization: shed rate derived, latency flattened.
+    assert rows[1]["metrics"]["p99_ms"] == 8.0
+    assert rows[1]["metrics"]["shed_rate"] == 0.01
+
+
+# ---------------------------------------------------------------------------
+# regression sentinel
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_green_on_rerun_red_on_3x_drop(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    for i in range(3):
+        v = regress.sentinel_report(_bench_report(f"t{i}", 60.0 + i))
+        assert v is not None and v["ok"], v
+    # Unchanged re-run: green, with a populated baseline.
+    v = regress.sentinel_report(_bench_report("t-rerun", 61.0))
+    assert v["ok"] and v["baseline"]["n"] == 3
+    assert "series_per_s" in [c["metric"] for c in v["checks"]]
+    assert os.path.exists(v["path"])
+    # 3x throughput collapse: red, named in the verdict.
+    v = regress.sentinel_report(_bench_report("t-drop", 20.0))
+    assert not v["ok"] and "series_per_s" in v["breaches"]
+    with open(v["path"]) as fh:
+        on_disk = json.load(fh)
+    assert on_disk["kind"] == "regression-verdict"
+    assert not on_disk["ok"]
+    assert "REGRESSION" in regress.summarize(v)
+
+
+def test_sentinel_baselines_respect_comparability(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    # TPU-class history must not gate a CPU run, and a different
+    # workload (smoke vs full) must not share a baseline either.
+    tpu = _bench_report("t-tpu", 600.0)
+    tpu["extra"]["device"] = "TPU v5 lite"
+    regress.sentinel_report(tpu)
+    smoke = _bench_report("t-smoke", 10.0,
+                          workload="m5_64x64_fit_wall_clock")
+    regress.sentinel_report(smoke)
+    v = regress.sentinel_report(_bench_report("t-cpu", 60.0))
+    assert v["ok"] and v["baseline"]["n"] == 0
+
+
+def test_sentinel_cli_exit_codes_on_p99_regression(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    for i in range(3):
+        path = f"SERVE_{i}.json"
+        with open(path, "w") as fh:
+            json.dump(_serve_report(f"t{i}", 8.0 + 0.1 * i), fh)
+        assert obs_main(["sentinel", path]) == 0
+    with open("SERVE_bad.json", "w") as fh:
+        json.dump(_serve_report("t-bad", 25.0), fh)
+    rc = obs_main(["sentinel", "SERVE_bad.json",
+                   "--out", "REGRESSION_bad.json"])
+    assert rc == 1
+    with open("REGRESSION_bad.json") as fh:
+        verdict = json.load(fh)
+    assert "p99_ms" in verdict["breaches"]
+
+
+def test_slo_budgets_load_from_pyproject():
+    slo = regress.load_slo(REPO)
+    assert slo["window"] == 8
+    assert slo["budgets"]["bench"]["series_per_s"]["direction"] == \
+        "higher"
+    assert "mttr_*" in slo["budgets"]["chaos"]
+
+
+def test_default_slo_stays_in_sync_with_pyproject():
+    # DEFAULT_SLO only covers running outside a checkout; the committed
+    # pyproject table is the source of truth.  Pin them equal so a
+    # budget edit that touches one side but not the other fails HERE
+    # instead of silently judging differently on installed wheels.
+    slo = regress.load_slo(REPO)
+    assert slo["budgets"] == regress.DEFAULT_SLO["budgets"]
+    for key in ("window", "min_history", "mad_k"):
+        assert slo[key] == regress.DEFAULT_SLO[key]
+
+
+def test_failed_runs_emit_no_throughput_metric(tmp_path):
+    # A wedged run's series_per_s=0.0 means "never ran", not "ran at
+    # zero" — admitting it would drag the rolling median to 0 and make
+    # the throughput budget vacuous.  BENCH_r03-r05 are such rows.
+    dead = _bench_report("t-dead", 0.0)
+    dead["extra"]["series_done"] = 0
+    dead["extra"]["complete"] = False
+    row = history.row_from_report(dead)
+    assert "series_per_s" not in row["metrics"]
+    assert row["metrics"]["series_done"] == 0
+    # Against the real committed trajectory: a 12x collapse vs r06's
+    # 63.44 series/s must breach even though r03-r05 "scored" 0.0.
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+    history.backfill(REPO, hpath)
+    slow = {
+        "metric": "m5_30490x1941_fit_wall_clock", "value": 100.0,
+        "unit": "s", "vs_baseline": 0.0,
+        "extra": {"trace_id": "t-slow", "device": "TFRT_CPU_0",
+                  "series_per_s": 5.0, "series_done": 30490,
+                  "complete": True},
+    }
+    v = regress.evaluate(history.row_from_report(slow),
+                         history.read_history(hpath),
+                         slo=regress.load_slo(REPO))
+    assert "series_per_s" in v["breaches"], v["checks"]
+
+
+def test_sentinel_amends_a_row_backfilled_before_judging(tmp_path,
+                                                         monkeypatch):
+    # A regressed artifact that reaches the index unjudged (backfill,
+    # or a TSSPARK_SENTINEL=0 run) must still get its breached flag
+    # when the sentinel later judges it — else the poisoned baseline
+    # normalizes the next identical regression to green.
+    monkeypatch.chdir(tmp_path)
+    for i in range(3):
+        regress.sentinel_report(_serve_report(f"t{i}", 8.0))
+    bad = _serve_report("t-bad", 25.0)
+    _row, appended = history.ingest(bad)  # indexed unflagged
+    assert appended
+    v = regress.sentinel_report(bad)
+    assert not v["ok"]
+    rows = history.read_history()
+    stored = next(r for r in rows if r["row_id"] == "serve:t-bad")
+    assert stored.get("breached") == v["breaches"]
+    # An identical second regression still judges red.
+    v2 = regress.sentinel_report(_serve_report("t-bad2", 25.0))
+    assert not v2["ok"] and "p99_ms" in v2["breaches"]
+
+
+def test_breached_rows_do_not_seed_baselines(tmp_path, monkeypatch):
+    # A persistent regression must stay red run after run: red rows are
+    # ingested (the trajectory is honest) but excluded from baselines,
+    # so the collapse cannot normalize the median that catches it.
+    monkeypatch.chdir(tmp_path)
+    for i in range(3):
+        assert regress.sentinel_report(
+            _bench_report(f"t{i}", 60.0 + i)
+        )["ok"]
+    for i in range(5):
+        v = regress.sentinel_report(_bench_report(f"t-bad{i}", 20.0))
+        assert not v["ok"], f"regressed run {i} judged green: {v}"
+        assert "series_per_s" in v["breaches"]
+    rows = history.read_history()
+    assert sum(1 for r in rows if r.get("breached")) == 5
+    # A recovered run is green again against the healthy baseline.
+    assert regress.sentinel_report(_bench_report("t-fixed", 59.0))["ok"]
+
+
+def test_chaos_mttr_regression_flagged(tmp_path):
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+
+    def storm(trace, mttr):
+        return {"kind": "chaos-storm", "unix": 1.0, "trace_id": trace,
+                "profile": "smoke", "ok": True, "invariants": {},
+                "mttr_s": {"worker-kill": mttr}}
+
+    for i in range(3):
+        history.ingest(storm(f"c{i}", 1.0), hpath)
+    rows = history.read_history(hpath)
+    row = history.row_from_report(storm("c-bad", 9.0))
+    v = regress.evaluate(row, rows, slo=regress.load_slo(REPO))
+    # budget: 2x + 2 s slack off a 1 s median -> 9 s breaches.
+    assert "mttr_worker-kill" in v["breaches"], v["checks"]
+    row_ok = history.row_from_report(storm("c-ok", 1.1))
+    assert regress.evaluate(row_ok, rows,
+                            slo=regress.load_slo(REPO))["ok"]
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace export
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_trace_export(tmp_path):
+    scratch = tmp_path / "run"
+    prev = context.start_run(str(scratch / "spans.jsonl"))
+    tid = context.trace_id()
+    with context.span("stage.orchestrate", seed=0):
+        with context.span("chunk.fit", lo=0, hi=8):
+            pass
+        context.event("fault", tag="worker-kill")
+    context.end_run(prev)
+    # An open span with no later closed span (the wedged-worker shape):
+    # must stay visible, never a zero-width sliver.
+    prev = context.start_run(str(scratch / "spans.jsonl"), trace_id=tid)
+    context.open_span("worker.attempt", attempt=1)
+    context.end_run(prev)
+    out = str(tmp_path / "trace.json")
+    assert obs_main(["report", str(scratch), "--chrome-trace", out]) == 0
+    with open(out) as fh:
+        payload = json.load(fh)
+    evs = payload["traceEvents"]
+    complete = [e for e in evs if e["ph"] == "X"]
+    instants = [e for e in evs if e["ph"] == "i"]
+    assert {e["name"] for e in complete} == {"stage.orchestrate",
+                                             "chunk.fit",
+                                             "worker.attempt"}
+    assert instants[0]["name"] == "fault"
+    fit = next(e for e in complete if e["name"] == "chunk.fit")
+    assert fit["args"]["lo"] == 0 and fit["dur"] >= 0
+    open_ev = next(e for e in complete if e["name"] == "worker.attempt")
+    assert open_ev["args"]["status"] == "open"
+    assert open_ev["dur"] >= 1e3  # >= 1 ms floor, visible in Perfetto
+    assert all(e["ts"] >= 0 for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# live watch
+# ---------------------------------------------------------------------------
+
+
+def _write_span(path, name, t0, dur_s, trace="tw", **attrs):
+    append_line(path, json.dumps({
+        "kind": "span", "trace_id": trace,
+        "span_id": os.urandom(4).hex(), "parent_id": None,
+        "name": name, "t0": t0, "dur_s": dur_s, "status": "ok",
+        "pid": 1, "attrs": attrs,
+    }))
+
+
+def test_watch_once_records_breach_into_the_trace(tmp_path):
+    scratch = tmp_path / "run"
+    scratch.mkdir()
+    spans = str(scratch / "spans.jsonl")
+    # A slow in-flight run: 20 series landed over a 10 s window.
+    _write_span(spans, "stage.orchestrate", 1000.0, 20.0)
+    _write_span(spans, "chunk.land", 1000.0, 1.0, lo=0, hi=10)
+    _write_span(spans, "chunk.land", 1009.0, 1.0, lo=10, hi=20)
+    # The run's workers stamp their device into times.jsonl; the live
+    # baseline must scope to that device class — the TPU rows below
+    # would otherwise distort the median.
+    append_line(str(scratch / "times.jsonl"),
+                json.dumps({"lo": 0, "hi": 10, "fit_s": 1.0,
+                            "device": "TFRT_CPU_0"}))
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+    for i in range(3):
+        history.ingest(_bench_report(f"t{i}", 60.0), hpath)
+    for i in range(3):
+        tpu = _bench_report(f"tpu{i}", 600.0)
+        tpu["extra"]["device"] = "TPU v5 lite"
+        history.ingest(tpu, hpath)
+
+    st = watch.observe_run(str(scratch), history.read_history(hpath),
+                           slo=regress.load_slo(REPO))
+    assert st["series_done"] == 20
+    assert st["series_per_s"] == 2.0
+    assert [c["metric"] for c in st["breaches"]] == ["series_per_s"]
+    assert st["breaches"][0]["median"] == 60.0  # cpu baseline only
+
+    out_lines = []
+    rc = watch.watch(str(scratch), history_path=hpath, once=True,
+                     emit=out_lines.append)
+    assert rc == 1
+    assert any("SLO:BREACH" in ln for ln in out_lines)
+    # The breach landed in the run's OWN trace (joinable by the ledger).
+    recs = context.read_records(spans)
+    breaches = [r for r in recs if r.get("kind") == "event"
+                and r.get("name") == "slo.breach"]
+    assert len(breaches) == 1
+    assert breaches[0]["trace_id"] == "tw"
+    assert breaches[0]["attrs"]["metric"] == "series_per_s"
+    # A healthy run (no baseline misses): clean pass, no event spam.
+    rc2 = watch.watch(str(scratch),
+                      history_path=str(tmp_path / "none.jsonl"),
+                      once=True, emit=lambda s: None)
+    assert rc2 == 0
+    assert len([r for r in context.read_records(spans)
+                if r.get("name") == "slo.breach"]) == 1
+
+
+def test_watch_reads_serve_metrics_snapshot(tmp_path):
+    scratch = tmp_path / "serve"
+    scratch.mkdir()
+    _write_span(str(scratch / "spans.jsonl"), "serve.request",
+                1000.0, 0.002)
+    snap = {
+        "kind": "metrics-snapshot", "unix": 1001.0, "trace_id": "tw",
+        "pid": 1,
+        "metrics": {
+            "counters": [
+                {"name": "tsspark_serve_requests_total",
+                 "labels": {"result": "completed"}, "value": 98},
+                {"name": "tsspark_serve_requests_total",
+                 "labels": {"result": "shed"}, "value": 2},
+            ],
+            "gauges": [
+                {"name": "tsspark_serve_queue_depth", "value": 4.0},
+                {"name": "tsspark_serve_breaker_open", "value": 0.0},
+            ],
+            "histograms": [],
+        },
+    }
+    with open(scratch / "metrics_daemon.json", "w") as fh:
+        json.dump(snap, fh)
+    st = watch.observe_run(str(scratch))
+    assert st["queue_depth"] == 4.0
+    assert st["shed_rate"] == 0.02
+    assert st["breaker"] == "closed"
+    assert st["p99_ms"] == 2.0
+    line = watch.format_line(dict(st, t_offset_s=0.0))
+    assert "queue=4" in line and "breaker=closed" in line
+
+
+# ---------------------------------------------------------------------------
+# serve daemon: metrics command + periodic export
+# ---------------------------------------------------------------------------
+
+
+def test_daemon_metrics_cmd_and_periodic_export(tmp_path):
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+    from tsspark_tpu.serve.__main__ import _serve_lines
+
+    METRICS.counter("tsspark_serve_requests_total",
+                    result="completed").inc(5)
+    emitted = []
+    rc = _serve_lines(
+        object(), object(), emitted.append,
+        lines=['{"cmd": "metrics", "id": "m1"}'],
+        metrics_every=0.0, metrics_dir=str(tmp_path),
+    )
+    assert rc == 0
+    assert emitted and emitted[0]["ok"] and emitted[0]["id"] == "m1"
+    assert "tsspark_serve_requests_total" in emitted[0]["prometheus"]
+    snap_path = tmp_path / "metrics_daemon.json"
+    assert snap_path.exists()
+    with open(snap_path) as fh:
+        assert json.load(fh)["kind"] == "metrics-snapshot"

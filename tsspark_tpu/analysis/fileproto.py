@@ -167,6 +167,32 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "written once at run end, atomic so a watcher never parses a "
         "partial JSON",
     ),
+    ArtifactSpec(
+        "run-history", ("RUNHISTORY",),
+        ("ingest", "append_line"),
+        "the cross-run history index (obs.history): one normalized row "
+        "per BENCH/SERVE/CHAOS/EVAL/RUNLEDGER artifact, appended "
+        "crash-safely through utils.atomic.append_line (idempotent by "
+        "trace id — concurrent entrypoints may self-ingest); readers "
+        "tolerate a torn last line",
+        append_ok=True,
+    ),
+    ArtifactSpec(
+        "regression-verdict", ("REGRESSION_",),
+        ("write_verdict",),
+        "regression-sentinel verdict (obs.regress): the judged checks "
+        "of one history row vs its rolling median/MAD baseline, "
+        "written once per entrypoint run, atomic so a gate watching "
+        "for the verdict never parses a partial JSON",
+    ),
+    ArtifactSpec(
+        "chrome-trace", (),
+        ("_chrome_trace",),
+        "Chrome/Perfetto trace-event export of a run ledger's spans "
+        "(python -m tsspark_tpu.obs report --chrome-trace): a pure "
+        "derived view written once on demand, atomic; the span log "
+        "stays the source of truth",
+    ),
     # Specific marker specs must precede "checkpoint": its generic
     # ".json" marker would otherwise swallow "times.jsonl",
     # "manifest.json" and "SERVE_*.json" (first marker match wins).
@@ -254,6 +280,9 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/obs/context.py",
     "tsspark_tpu/obs/metrics.py",
     "tsspark_tpu/obs/ledger.py",
+    "tsspark_tpu/obs/history.py",
+    "tsspark_tpu/obs/regress.py",
+    "tsspark_tpu/obs/watch.py",
     "tsspark_tpu/obs/__main__.py",
 )
 

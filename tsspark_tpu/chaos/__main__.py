@@ -83,7 +83,23 @@ def main(argv=None) -> int:
     print(f"scorecard -> {out}")
     print(f"run ledger -> {report.get('ledger_path')} "
           f"(python -m tsspark_tpu.obs report)")
-    return 0 if report["ok"] else 1
+    rc = 0 if report["ok"] else 1
+    # Regression sentinel post-step: the scorecard joins
+    # RUNHISTORY.jsonl, and an MTTR regression vs the rolling baseline
+    # fails the storm even when every absolute invariant held
+    # (docs/OBSERVABILITY.md "Trajectory & SLOs").
+    if os.environ.get("TSSPARK_SENTINEL", "1") != "0":
+        try:
+            from tsspark_tpu.obs import regress
+
+            verdict = regress.sentinel_report(report, source=out)
+            if verdict is not None:
+                print(regress.summarize(verdict))
+                if not verdict["ok"]:
+                    rc = rc or 1
+        except Exception as e:
+            print(f"sentinel skipped: {e!r}", file=sys.stderr)
+    return rc
 
 
 if __name__ == "__main__":

@@ -623,10 +623,21 @@ def run_storm(seed: int = 0, profile: str = "full",
             "mttr_delta_s": mttr_delta,
         }
         ok = all(v.get("ok") for v in invariants.values())
+        import jax
+
+        from tsspark_tpu.config import NUMERICS_REV
+        from tsspark_tpu.obs.history import git_rev
+
         report = {
             "kind": "chaos-storm",
             "unix": round(time.time(), 3),
             "trace_id": ledger["trace_id"],
+            # Cross-run identity (obs.history): the regression sentinel
+            # baselines per-fault-class MTTR across matching revisions
+            # and device classes.
+            "numerics_rev": NUMERICS_REV,
+            "git_rev": git_rev(),
+            "device": str(jax.devices()[0]),
             "seed": seed,
             "profile": profile,
             "workload": {

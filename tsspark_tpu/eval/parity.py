@@ -280,7 +280,21 @@ def main():
     args = ap.parse_args()
     import jax
 
+    from tsspark_tpu.config import NUMERICS_REV
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.history import git_rev
+
     result = {
+        # Cross-run identity (obs.history): parity/calibration rows
+        # join RUNHISTORY.jsonl like every other report family, so the
+        # sentinel can gate holdout-delta drift across revisions.  The
+        # trace id adopts an active run's when one is bound (a traced
+        # harness driving parity), else mints a fresh one.
+        "kind": "eval-parity",
+        "unix": round(time.time(), 3),
+        "trace_id": obs.trace_id() or obs.new_id(),
+        "git_rev": git_rev(),
+        "numerics_rev": NUMERICS_REV,
         "platform": str(jax.devices()[0]),
         "scale": args.scale,
         "configs": run_parity(args.scale, configs=args.configs),

@@ -1,6 +1,6 @@
-"""Unified observability: cross-process tracing, metrics, run ledger.
+"""Unified observability: tracing, metrics, ledger, history, SLOs.
 
-Three layers (docs/OBSERVABILITY.md):
+Per-run layers (docs/OBSERVABILITY.md):
 
 * ``obs.context`` — trace/span context on a contextvar, propagated to
   child processes through the spawn environment and appended
@@ -11,7 +11,20 @@ Three layers (docs/OBSERVABILITY.md):
   snapshots, perf telemetry, and stamped reports under one trace id,
   with MTTR, RED, and orphan checks derived from the trace.
 
-``python -m tsspark_tpu.obs report`` renders the end-to-end timeline.
+Cross-run layers (docs/OBSERVABILITY.md, "Trajectory & SLOs"):
+
+* ``obs.history`` — the append-only ``RUNHISTORY.jsonl`` index: every
+  BENCH/SERVE/CHAOS/EVAL/RUNLEDGER artifact normalized into one flat
+  row schema, idempotent by trace id;
+* ``obs.regress`` — the regression sentinel: rolling robust baselines
+  (median/MAD over comparable rows) under ``pyproject
+  [tool.tsspark.slo]`` budgets, ``REGRESSION_*.json`` verdicts, and
+  nonzero exits wired into every artifact-producing entrypoint;
+* ``obs.watch`` — live SLO watch over an in-flight run's scratch.
+
+``python -m tsspark_tpu.obs report`` renders the end-to-end timeline;
+``... history --backfill`` the cross-run trajectory; ``... watch`` the
+live view.
 """
 
 from tsspark_tpu.obs.context import (  # noqa: F401
@@ -31,10 +44,21 @@ from tsspark_tpu.obs.context import (  # noqa: F401
     start_run,
     trace_id,
 )
+from tsspark_tpu.obs.history import (  # noqa: F401
+    HISTORY_FILE,
+    git_rev,
+    ingest,
+    read_history,
+)
 from tsspark_tpu.obs.ledger import (  # noqa: F401
     build_ledger,
     derive_mttr,
     write_ledger,
+)
+from tsspark_tpu.obs.regress import (  # noqa: F401
+    evaluate,
+    load_slo,
+    sentinel_report,
 )
 from tsspark_tpu.obs.metrics import (  # noqa: F401
     DEFAULT as METRICS,

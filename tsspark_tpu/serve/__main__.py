@@ -150,10 +150,23 @@ def _loadgen(args) -> int:
     stats = engine.stats.snapshot()
     METRICS.export(os.path.join(scratch_root, "metrics_loadgen.json"),
                    trace_id=obs.trace_id())
+    import jax
+
+    from tsspark_tpu.config import NUMERICS_REV
+    from tsspark_tpu.obs.history import git_rev
+    from tsspark_tpu.utils import checkpoint as ckpt
+
     report = {
         "kind": "serve-loadgen",
         "unix": round(time.time(), 3),
         "trace_id": obs.trace_id(),
+        # Cross-run identity (obs.history): the sentinel baselines
+        # latency/shed/hit-rate only across matching numerics revs and
+        # device classes — a TPU loadgen must never gate a CPU one.
+        "numerics_rev": NUMERICS_REV,
+        "git_rev": git_rev(),
+        "device": str(jax.devices()[0]),
+        "config_fingerprint": ckpt.config_fingerprint(registry.config),
         "n_requests": n,
         "n_series": n_series,
         "mix": {
@@ -183,6 +196,20 @@ def _loadgen(args) -> int:
         f"{report['cache']['hit_rate']} | shed {stats['shed']} | "
         f"report -> {out}"
     )
+    # Regression sentinel post-step: the report joins RUNHISTORY.jsonl
+    # and a p50/p99/shed/hit-rate breach vs the rolling baseline makes
+    # the loadgen exit nonzero (docs/OBSERVABILITY.md).
+    if os.environ.get("TSSPARK_SENTINEL", "1") != "0":
+        try:
+            from tsspark_tpu.obs import regress
+
+            verdict = regress.sentinel_report(report, source=out)
+            if verdict is not None:
+                print(regress.summarize(verdict))
+                if not verdict["ok"]:
+                    return 1
+        except Exception as e:
+            print(f"sentinel skipped: {e!r}", file=sys.stderr)
     return 0
 
 
@@ -205,24 +232,49 @@ def _daemon(args) -> int:
         sys.stdout.flush()
 
     try:
-        return _serve_lines(registry, engine, emit)
+        return _serve_lines(registry, engine, emit,
+                            metrics_every=args.metrics_every,
+                            metrics_dir=args.registry)
     except BrokenPipeError:
         return 0  # client went away; nothing left to answer
 
 
-def _serve_lines(registry, engine, emit) -> int:
+def _serve_lines(registry, engine, emit, lines=None,
+                 metrics_every=None, metrics_dir=None) -> int:
+    """The daemon's request loop (``lines`` defaults to stdin; tests
+    pass a list).  ``metrics_every``: export an atomic
+    ``metrics_daemon.json`` snapshot next to the registry at most every
+    N seconds (checked per request line — the export rides traffic, so
+    an idle daemon leaves its last snapshot in place), which is what
+    lets ``python -m tsspark_tpu.obs watch <registry>`` observe a live
+    engine without a signal channel."""
     import contextlib
 
     import numpy as np
 
     from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
     from tsspark_tpu.serve.engine import ServeError
     from tsspark_tpu.serve.registry import RegistryError
 
-    for line in sys.stdin:
+    def export_metrics():
+        METRICS.export(
+            os.path.join(metrics_dir or ".", "metrics_daemon.json"),
+            trace_id=obs.trace_id(),
+        )
+
+    last_export = 0.0
+    if metrics_every is not None:
+        export_metrics()  # a watcher sees a snapshot before traffic
+        last_export = time.monotonic()
+    for line in (lines if lines is not None else sys.stdin):
         line = line.strip()
         if not line:
             continue
+        if (metrics_every is not None
+                and time.monotonic() - last_export >= metrics_every):
+            export_metrics()
+            last_export = time.monotonic()
         try:
             msg = json.loads(line)
         except ValueError as e:
@@ -237,6 +289,12 @@ def _serve_lines(registry, engine, emit) -> int:
                       "stats": engine.stats.snapshot(),
                       "cache": engine.cache.stats(),
                       "active_version": registry.active_version()})
+                continue
+            if cmd == "metrics":
+                # Prometheus text snapshot over the request channel —
+                # scrape-style consumers need no side file.
+                emit({"ok": True, "id": rid,
+                      "prometheus": METRICS.to_prometheus()})
                 continue
             if cmd == "activate":
                 registry.activate(int(msg["version"]))
@@ -313,6 +371,12 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queue", type=int, default=4096)
     ap.add_argument("--max-batch", type=int, default=128)
     ap.add_argument("--cache-capacity", type=int, default=8192)
+    ap.add_argument("--metrics-every", type=float, default=None,
+                    metavar="N",
+                    help="daemon: export an atomic metrics_daemon.json "
+                    "snapshot next to the registry at most every N "
+                    "seconds (enables `python -m tsspark_tpu.obs "
+                    "watch <registry>` against a live engine)")
     args = ap.parse_args(argv)
 
     if args.loadgen is not None:

@@ -334,6 +334,9 @@ class PredictionEngine:
             "tsspark_serve_dispatches_total"
         )
         self._m_queue = METRICS.gauge("tsspark_serve_queue_depth")
+        # Live breaker state for the SLO watcher (obs.watch): 0 closed,
+        # 1 open/half-open — updated at every dispatch outcome.
+        self._m_breaker = METRICS.gauge("tsspark_serve_breaker_open")
         # In-process activations invalidate immediately; refresh() also
         # polls the manifest so cross-process flips are picked up.
         registry.subscribe(self._on_activate)
@@ -639,6 +642,7 @@ class PredictionEngine:
         # retries burned); each dispatch counts as ONE breaker outcome
         # even when the retry policy makes several attempts inside it.
         if self.breaker is not None and not self.breaker.allow():
+            self._m_breaker.set(1.0)
             raise BackendUnavailable(
                 self.breaker.name, self.breaker.retry_after_s()
             )
@@ -662,6 +666,10 @@ class PredictionEngine:
             if self.breaker is not None:
                 (self.breaker.record_success if ok
                  else self.breaker.record_failure)()
+                self._m_breaker.set(
+                    0.0 if self.breaker.state == CircuitBreaker.CLOSED
+                    else 1.0
+                )
             if obs.active():
                 obs.record("serve.dispatch", t_disp0,
                            time.monotonic() - m_disp0,
