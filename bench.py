@@ -15,8 +15,9 @@ chunk-halving retries, and crash-resumable two-phase fit all live in
 ``fit_resilient`` / ``Forecaster(..., resilient=True)``); this file is a
 thin caller that adds only the benchmark-specific pieces:
 
-  * the M5-shaped dataset cache (seed-deterministic, keyed by shape +
-    generator fingerprint),
+  * the M5-shaped dataset via the shared columnar data plane
+    (tsspark_tpu.data.plane: warm cache = pure memmap reads; cold cache
+    = background shard ingestion overlapped with the fit, docs/DATA.md),
   * the numerics-scoped resumable scratch key,
   * the CPU eval child (in-sample sMAPE accuracy gate),
   * budget/reserve accounting against the driver's harness timeout, with
@@ -39,7 +40,6 @@ import shutil
 import signal
 import subprocess
 import sys
-import tempfile
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -77,7 +77,8 @@ def _code_fingerprint() -> str:
     ANY commit (even docstring-only) discarded cross-run resume state; now
     only modules on the fit path rotate it: model math (models/), the
     solver (ops/), backend chunking policy (backends/), the config schema,
-    and the data generator."""
+    and the WHOLE data package (datasets + loaders + plane + ingest — a
+    loader/plane change must never resume against stale cached arrays)."""
     import hashlib
 
     h = hashlib.md5()
@@ -87,23 +88,13 @@ def _code_fingerprint() -> str:
         os.path.join(REPO, "tsspark_tpu", "ops", "**", "*.py"),
         os.path.join(REPO, "tsspark_tpu", "backends", "**", "*.py"),
         os.path.join(REPO, "tsspark_tpu", "config.py"),
-        os.path.join(REPO, "tsspark_tpu", "data", "datasets.py"),
+        os.path.join(REPO, "tsspark_tpu", "data", "**", "*.py"),
     ]
     files = sorted(f for p in pats for f in glob.glob(p, recursive=True))
     for f in files:
         with open(f, "rb") as fh:
             h.update(fh.read())
     return h.hexdigest()[:10]
-
-
-def _datagen_fingerprint() -> str:
-    """Hash of the data generator alone — keys the shared datagen cache so
-    a generator change can never serve stale arrays to a new code version."""
-    import hashlib
-
-    with open(os.path.join(REPO, "tsspark_tpu", "data", "datasets.py"),
-              "rb") as fh:
-        return hashlib.md5(fh.read()).hexdigest()[:8]
 
 
 def _model_config():
@@ -142,7 +133,7 @@ def profile_main(args) -> None:
     import numpy as np
 
     from tsspark_tpu.config import SolverConfig
-    from tsspark_tpu.data import datasets
+    from tsspark_tpu.data import m5_rows
     from tsspark_tpu.models.prophet.model import (
         ProphetModel, fit_init_core, fit_segment_core,
     )
@@ -153,7 +144,7 @@ def profile_main(args) -> None:
     model = ProphetModel(cfg, solver)
     b, t_len, seg = 1024, args.days, args.segment or 24
     timers = profiling.Timers()
-    batch = datasets.m5_like(n_series=b, n_days=t_len)
+    batch = m5_rows(0, b, n_days=t_len)
     with timers.section("prepare_host"):
         data, meta = model.prepare(
             np.asarray(batch.ds, np.float32),
@@ -323,6 +314,7 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
 
     from tsspark_tpu.obs.history import git_rev
 
+    wall = time.time() - t_wall0
     extra = {
         "trace_id": obs.trace_id(),
         # Cross-run identity for the history index (obs.history): the
@@ -344,7 +336,8 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
         "phase2_s": round(phase2_s, 2),
         "stragglers": stragglers,
         "datagen_s": round(gen_s, 2),
-        "wall_s": round(time.time() - t_wall0, 1),
+        "datagen_share": round(gen_s / wall, 4) if wall else 0.0,
+        "wall_s": round(wall, 1),
         "device": next(
             (t["device"] for t in reversed(times) if "device" in t), None
         ),
@@ -356,6 +349,21 @@ def _build_summary(args, t_wall0, gen_s, chunk, retries, note=None,
     }
     if note:
         extra["note"] = note
+    # Ingest-overlap accounting (docs/DATA.md): ``datagen_s`` above is
+    # the wall the bench actually BLOCKED on data; the ingest driver's
+    # own wall ran concurrent with the fit, and the difference is the
+    # overlap the plane bought.  Only stamped when THIS run ingested —
+    # a warm-cache run must not report the original cold ingest's wall.
+    if getattr(args, "_ingest", None) is not None:
+        from tsspark_tpu.data.ingest import read_ingest_report
+
+        rep = read_ingest_report(args._data_dir)
+        if rep:
+            extra["ingest_wall_s"] = rep.get("wall_s")
+            extra["ingest_overlap_s"] = round(
+                max(0.0, float(rep.get("wall_s") or 0.0) - gen_s), 2
+            )
+            extra["ingest_processes"] = rep.get("processes")
     # Per-segment perf telemetry (docs/PERF.md): per-chunk width/live/
     # series-per-s/compile-miss rows plus the autotuner's learned state —
     # the block ``python -m tsspark_tpu.perf BENCH_*.json`` prints.
@@ -453,7 +461,8 @@ def main() -> None:
     import numpy as np
 
     from tsspark_tpu.config import SolverConfig
-    from tsspark_tpu.data import datasets
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.data.ingest import IngestDriver
 
     # Persistent, code-fingerprinted scratch: a run killed by the harness
     # timeout (or a wedged tunnel) resumes from its completed chunk files on
@@ -478,7 +487,12 @@ def main() -> None:
     # — but only reap ones untouched for hours: a CONCURRENT bench with a
     # different shape owns a freshly-modified dir, and deleting it would
     # destroy that run's chunk files mid-flight.
-    for d in glob.glob("/tmp/tsbench_run_*"):
+    # /tmp/tsbench_data_* is the RETIRED private datagen cache (replaced
+    # by the shared plane, docs/DATA.md) — nothing writes it anymore, so
+    # leftovers from older code are reaped with the stale scratch dirs.
+    for d in glob.glob("/tmp/tsbench_run_*") + \
+            glob.glob("/tmp/tsbench_data_*") + \
+            glob.glob("/tmp/tsbench_datagen_*"):
         if os.path.abspath(d) == os.path.abspath(scratch):
             continue
         try:
@@ -512,6 +526,8 @@ def main() -> None:
 
     def _on_signal(signum, frame):
         orchestrate.kill_children()  # free the TPU tunnel before exiting
+        if getattr(args, "_ingest", None) is not None:
+            args._ingest.kill()  # landed shards persist; ingest resumes
         for proc in _SIDE.values():
             if proc is not None:
                 try:
@@ -526,44 +542,34 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
 
-    # Generated data is cached across runs/retries keyed by shape (the
-    # generator is seed-deterministic): round-2 burned ~47 s of every
-    # budgeted run regenerating identical arrays.
+    # Data rides the shared columnar plane (tsspark_tpu.data.plane;
+    # docs/DATA.md) — the ad-hoc /tmp npy cache this block used to
+    # maintain is gone.  Warm cache: the manifest hits and the fit
+    # starts on pure memmap reads.  Cold cache: a background ingest
+    # pool produces shards while the fit workers consume already-landed
+    # coverage, so generation OVERLAPS fitting instead of preceding it
+    # (BENCH_builder_r06 spent 74% of its wall generating data first).
     gen0 = time.time()
-    cache = os.path.join(
-        tempfile.gettempdir(),
-        f"tsbench_data_{args.series}x{args.days}_{_datagen_fingerprint()}",
+    spec = plane.DatasetSpec(
+        generator="m5", n_series=args.series, n_timesteps=args.days,
+        seed=2,
     )
-    if not os.path.exists(os.path.join(cache, "ok")):
-        # Private temp dir + atomic rename: concurrent bench processes can
-        # race to publish, but each writes its own dir and the loser keeps
-        # (or falls back to) a complete copy — a half-written cache can
-        # never appear under the "ok"-marked path.
-        tmp_cache = tempfile.mkdtemp(
-            prefix="tsbench_datagen_", dir=tempfile.gettempdir()
-        )
-        batch = datasets.m5_like(n_series=args.series, n_days=args.days)
-        np.save(os.path.join(tmp_cache, "ds.npy"),
-                batch.ds.astype(np.float32))
-        np.save(os.path.join(tmp_cache, "y.npy"),
-                np.nan_to_num(batch.y).astype(np.float32))
-        np.save(os.path.join(tmp_cache, "mask.npy"),
-                batch.mask.astype(np.float32))
-        np.save(os.path.join(tmp_cache, "reg.npy"),
-                batch.regressors.astype(np.float32))
-        del batch
-        with open(os.path.join(tmp_cache, "ok"), "w") as fh:
-            fh.write("ok\n")
-        try:
-            os.rename(tmp_cache, cache)
-        except OSError:
-            # Someone else published first (or a stale dir exists): use
-            # theirs if complete, else fall back to our private copy.
-            if not os.path.exists(os.path.join(cache, "ok")):
-                cache = tmp_cache
-            else:
-                shutil.rmtree(tmp_cache, ignore_errors=True)
-    args._data_dir = cache
+    args._data_dir = plane.dataset_dir(spec)
+    args._ingest = None
+    if not plane.is_complete(args._data_dir):
+        from tsspark_tpu.obs.metrics import DEFAULT as _METRICS
+
+        _METRICS.counter("tsspark_datagen_cache_misses_total").inc()
+        args._ingest = IngestDriver.start(spec)
+        print(f"[bench] cold data cache; ingesting {spec.cache_key()} "
+              f"overlapped with the fit", file=sys.stderr)
+    else:
+        from tsspark_tpu.obs.metrics import DEFAULT as _METRICS
+
+        _METRICS.counter("tsspark_datagen_cache_hits_total").inc()
+    # gen_s is the time the BENCH was blocked on data (the warm path's
+    # manifest check is ~ms); the ingest wall itself lands in extras as
+    # ingest_wall_s, overlapped with fitting.
     state["gen_s"] = gen_s = time.time() - gen0
 
     def _eval_covered() -> bool:
@@ -713,6 +719,19 @@ def main() -> None:
     pp = _SIDE.get("prep")
     if pp is not None and pp.poll() is None:
         pp.kill()
+    ing = getattr(args, "_ingest", None)
+    if ing is not None and ing.alive():
+        # A complete fit implies every consumed shard landed; whatever
+        # the driver still owes (the tail past --series, the manifest)
+        # finishes in seconds — give it a short grace, then kill (the
+        # sentinel-gated cache resumes next run either way).
+        t_block0 = time.time()
+        if ing.wait(timeout=min(30.0,
+                                max(5.0, deadline - time.time() - 10.0))
+                    ) is None:
+            ing.kill()
+        gen_s += time.time() - t_block0
+        state["gen_s"] = gen_s
 
     summary = _build_summary(args, t_wall0, gen_s, state["chunk"],
                              state["retries"], note=note,

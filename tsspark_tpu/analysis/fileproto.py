@@ -97,9 +97,14 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
     ),
     ArtifactSpec(
         "data-spill", (".npy",),
-        ("spill_data",),
-        "written once by the parent before any child spawns; mmap'd "
-        "read-only by children",
+        ("spill_data", "create_columns", "write_shard", "import_batch"),
+        "batch column files, two producers: orchestrate.spill_data "
+        "writes them once (atomic) before any child spawns, and the "
+        "data plane (data/plane.py) preallocates them as memmaps "
+        "filled shard by shard — NOT atomic per write, but no reader "
+        "ever touches column rows before the shard's sentinel "
+        "(plane-shard-ok) has landed, so the sentinel is the unit of "
+        "visibility; mmap'd read-only by children either way",
     ),
     ArtifactSpec(
         "heartbeat", ("heartbeat",),
@@ -196,6 +201,42 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
     # Specific marker specs must precede "checkpoint": its generic
     # ".json" marker would otherwise swallow "times.jsonl",
     # "manifest.json" and "SERVE_*.json" (first marker match wins).
+    # The plane manifest must ALSO precede "registry-manifest": its
+    # filename contains the "manifest.json" fragment.
+    ArtifactSpec(
+        "plane-manifest", ("plane_manifest.json",),
+        ("finalize",),
+        "data-plane completion marker (data/plane.py): written "
+        "atomically LAST, after every shard sentinel it certifies has "
+        "landed — the warm-cache hit test; removed by repair() before "
+        "re-landing a corrupt shard so a bad dataset can never keep "
+        "its hit marker",
+    ),
+    ArtifactSpec(
+        "plane-shard-ok", ("shardok_",),
+        ("write_shard", "import_batch"),
+        "per-shard visibility sentinel (data/plane.py): atomic write "
+        "AFTER the shard's memmap rows are flushed, payload CRCs "
+        "inside; readers trust only sentinel-covered rows, so a torn "
+        "shard is never consumed; concurrent producers write identical "
+        "bytes (block-seeded determinism) and the last rename wins "
+        "whole",
+    ),
+    ArtifactSpec(
+        "plane-spec", ("spec.json",),
+        ("create_columns", "import_batch"),
+        "dataset identity record (data/plane.py): generator/shape/seed/"
+        "shard width/datagen fingerprint, written atomically once at "
+        "dataset creation, read-only thereafter (its presence marks a "
+        "dir as plane-managed for ready_coverage gating)",
+    ),
+    ArtifactSpec(
+        "ingest-report", ("ingest_report.json",),
+        ("run_ingest",),
+        "ingest overlap accounting (data/ingest.py): wall/first-shard/"
+        "last-shard seconds, written atomically once at ingest end; "
+        "pure diagnostics folded into BENCH extras",
+    ),
     ArtifactSpec(
         "registry-manifest", ("manifest.json",),
         ("ParamRegistry._write_manifest",),
@@ -260,6 +301,8 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
 # Modules under the package root whose write sites are in protocol scope.
 PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/orchestrate.py",
+    "tsspark_tpu/data/plane.py",
+    "tsspark_tpu/data/ingest.py",
     "tsspark_tpu/streaming/state.py",
     "tsspark_tpu/streaming/driver.py",
     "tsspark_tpu/streaming/source.py",

@@ -1,0 +1,57 @@
+"""Public data API: generators, loaders, and the columnar data plane.
+
+Call sites import from here instead of deep-importing submodules::
+
+    from tsspark_tpu import data
+    batch = data.m5_like(n_series=512)
+    ddir = data.ensure(data.DatasetSpec("m5", 30490, 1941))
+
+The CSV loaders (pandas-backed) resolve lazily so importing the package
+in a lean child process (an orchestrate fit worker, the ingest pool)
+never pays the pandas import.
+"""
+
+from __future__ import annotations
+
+from tsspark_tpu.data.datasets import (
+    SEED_BLOCK,
+    SeriesBatch,
+    dataset_ids,
+    demo_weekly_rows,
+    m4_hourly_like,
+    m5_like,
+    m5_rows,
+    peyton_manning_like,
+    wiki_logistic_like,
+)
+from tsspark_tpu.data.plane import (
+    DatasetSpec,
+    GENERATORS,
+    dataset_fingerprint,
+    default_root,
+    ensure,
+    generate_rows,
+    import_batch,
+    open_batch,
+    ready_coverage,
+)
+
+__all__ = [
+    "SEED_BLOCK", "SeriesBatch", "dataset_ids", "demo_weekly_rows",
+    "m4_hourly_like", "m5_like", "m5_rows", "peyton_manning_like",
+    "wiki_logistic_like",
+    "DatasetSpec", "GENERATORS", "dataset_fingerprint", "default_root",
+    "ensure", "generate_rows", "import_batch", "open_batch",
+    "ready_coverage",
+    "load_m4", "load_m5",
+]
+
+_LAZY = {"load_m4", "load_m5"}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from tsspark_tpu.data import loaders
+
+        return getattr(loaders, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

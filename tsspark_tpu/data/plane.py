@@ -1,0 +1,678 @@
+"""Columnar on-disk dataset cache: the package's shared data plane.
+
+BENCH_builder_r06 spent 356 s of its 480 s wall generating synthetic
+data — 74% of the benchmark measured datagen, not fitting.  This module
+replaces every ad-hoc in-memory/private datagen path (bench.py's ``/tmp``
+npy cache, serve loadgen's inline demo batch, streaming's hand-rolled
+frames) with ONE cache of memmap column shards:
+
+* **Layout** — one directory per dataset under :func:`default_root`,
+  keyed by (generator, shape, seed, shard width, datagen fingerprint).
+  Inside: ``spec.json`` (identity, written first), ``ds.npy`` (shared
+  calendar, float64), preallocated float32 column files ``y.npy`` /
+  ``mask.npy`` / ``reg.npy`` / ``cap.npy`` in exactly the layout
+  ``orchestrate._load_data`` mmaps — a complete dataset dir IS a valid
+  orchestrate ``--data`` dir — plus one ``shardok_<lo>_<hi>.json``
+  sentinel per landed shard and a final ``plane_manifest.json``.
+
+* **Lifecycle** — column files are preallocated memmaps filled shard by
+  shard; a shard's rows become visible ONLY once its sentinel (written
+  atomically, payload CRCs inside) lands, and the manifest (atomic,
+  written last after sentinel coverage is complete) marks the dataset
+  warm.  Readers never trust bytes a sentinel doesn't cover, so a torn
+  shard can never be consumed; concurrent producers are safe because
+  generation is deterministic — racers write identical bytes and the
+  last identical sentinel wins whole.
+
+* **Determinism** — generation is block-seeded
+  (:data:`~tsspark_tpu.data.datasets.SEED_BLOCK`): rows [lo, hi) of a
+  dataset are bitwise-identical whether produced by one process, a
+  shard pool, or a fit worker self-healing a stalled ingest
+  (``tests/test_plane.py`` pins cache == direct generation).
+
+* **Overlap** — :mod:`tsspark_tpu.data.ingest` produces shards in a
+  background process pool while orchestrate fit workers consume
+  already-landed coverage (:func:`ready_coverage`), so a cold run
+  starts fitting before ingestion finishes and a warm run is pure
+  memmap reads.
+
+Scenario packs (irregular cadence, missing windows, cold start, M5
+store->dept->item hierarchy) are first-class named datasets behind the
+same manifest — see :data:`GENERATORS`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import hashlib
+import json
+import os
+import tempfile
+import time
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tsspark_tpu.data import datasets
+from tsspark_tpu.data.datasets import SeriesBatch
+from tsspark_tpu.utils.atomic import atomic_write
+
+#: Cache-format revision: bump when the on-disk layout (NOT the data)
+#: changes incompatibly; part of every spec record.
+PLANE_VERSION = 1
+
+#: Default I/O shard width — a multiple of every pow-2 claim width the
+#: orchestrator's autotuner dispatches (floor 128, historical cap 1024),
+#: so fit claims always nest inside whole shards.
+DEFAULT_SHARD_ROWS = 1024
+
+#: Column files, in orchestrate._DATA_FIELDS naming (float32 on disk;
+#: ``ds.npy`` rides separately and stays float64).
+COLUMN_FIELDS = ("y", "mask", "reg", "cap")
+
+_SPEC_FILE = "spec.json"
+_MANIFEST_FILE = "plane_manifest.json"
+
+#: name -> row generator ``fn(lo, hi, n_timesteps, seed) -> SeriesBatch``.
+#: Every generator is block-seeded: rows are independent of the total
+#: series count, so datasets extend without regeneration.
+GENERATORS: Dict[str, Callable[..., SeriesBatch]] = {
+    "m5": lambda lo, hi, t, seed: datasets.m5_rows(
+        lo, hi, n_days=t, seed=seed, scenario="base"),
+    "m5_irregular": lambda lo, hi, t, seed: datasets.m5_rows(
+        lo, hi, n_days=t, seed=seed, scenario="irregular"),
+    "m5_missing_windows": lambda lo, hi, t, seed: datasets.m5_rows(
+        lo, hi, n_days=t, seed=seed, scenario="missing_windows"),
+    "m5_cold_start": lambda lo, hi, t, seed: datasets.m5_rows(
+        lo, hi, n_days=t, seed=seed, scenario="cold_start"),
+    "m5_hier": lambda lo, hi, t, seed: datasets.m5_rows(
+        lo, hi, n_days=t, seed=seed, scenario="hier"),
+    "demo_weekly": lambda lo, hi, t, seed: datasets.demo_weekly_rows(
+        lo, hi, n_steps=t, seed=seed),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetSpec:
+    """Identity of one cached dataset (the manifest key)."""
+
+    generator: str
+    n_series: int
+    n_timesteps: int
+    seed: int = 2
+    shard_rows: int = DEFAULT_SHARD_ROWS
+
+    def __post_init__(self):
+        if self.generator not in GENERATORS \
+                and not self.generator.startswith("import:"):
+            raise ValueError(
+                f"unknown generator {self.generator!r}; known: "
+                f"{sorted(GENERATORS)} (or 'import:<name>')"
+            )
+        if self.n_series <= 0 or self.n_timesteps <= 0:
+            raise ValueError("n_series and n_timesteps must be positive")
+        if self.shard_rows <= 0:
+            raise ValueError("shard_rows must be positive")
+
+    def cache_key(self) -> str:
+        return (
+            f"{self.generator}_{self.n_series}x{self.n_timesteps}"
+            f"_s{self.seed}_r{self.shard_rows}_{dataset_fingerprint()}"
+        )
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "DatasetSpec":
+        return cls(**{
+            k: d[k] for k in
+            ("generator", "n_series", "n_timesteps", "seed", "shard_rows")
+        })
+
+
+_FP_CACHE: Dict[str, str] = {}
+
+
+def dataset_fingerprint() -> str:
+    """Hash of the WHOLE data package (datasets + loaders + plane +
+    ingest): a change to any of them rotates every cache key, so a
+    loader/plane change can never serve stale cached arrays (ISSUE 9 —
+    the old bench fingerprint hashed ``datasets.py`` alone)."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    if pkg in _FP_CACHE:
+        return _FP_CACHE[pkg]
+    h = hashlib.md5()
+    h.update(str(PLANE_VERSION).encode())
+    for path in sorted(glob.glob(os.path.join(pkg, "*.py"))):
+        with open(path, "rb") as fh:
+            h.update(fh.read())
+    _FP_CACHE[pkg] = h.hexdigest()[:8]
+    return _FP_CACHE[pkg]
+
+
+def default_root() -> str:
+    """The shared cache root: ``$TSSPARK_DATA_ROOT`` or a stable temp
+    location (all subsystems — bench, serve loadgen, streaming replay —
+    default here, which is what makes the plane SHARED)."""
+    return os.environ.get("TSSPARK_DATA_ROOT") or os.path.join(
+        tempfile.gettempdir(), "tsspark_plane"
+    )
+
+
+def dataset_dir(spec: DatasetSpec, root: Optional[str] = None) -> str:
+    return os.path.join(root or default_root(), spec.cache_key())
+
+
+def shard_ranges(spec: DatasetSpec) -> List[Tuple[int, int]]:
+    return [
+        (lo, min(lo + spec.shard_rows, spec.n_series))
+        for lo in range(0, spec.n_series, spec.shard_rows)
+    ]
+
+
+def generate_rows(spec: DatasetSpec, lo: int, hi: int) -> SeriesBatch:
+    """Canonical in-memory generation of rows [lo, hi) — what the cache
+    must match bitwise (after the float32/nan_to_num disk conversion)."""
+    if spec.generator.startswith("import:"):
+        raise ValueError(
+            "imported datasets have no generator; read the cache"
+        )
+    return GENERATORS[spec.generator](
+        lo, hi, spec.n_timesteps, spec.seed
+    )
+
+
+def series_ids(spec: DatasetSpec, lo: int = 0,
+               hi: Optional[int] = None) -> np.ndarray:
+    return datasets.dataset_ids(
+        spec.generator, lo, spec.n_series if hi is None else hi
+    )
+
+
+# ---------------------------------------------------------------------------
+# disk conversion
+# ---------------------------------------------------------------------------
+
+
+def batch_columns(batch: SeriesBatch) -> Dict[str, np.ndarray]:
+    """SeriesBatch -> the float32 column dict the cache stores (NaN
+    holes become zeros; the mask carries observedness — the exact
+    conversion bench.py's old private cache applied)."""
+    cols = {
+        "y": np.nan_to_num(np.asarray(batch.y)).astype(np.float32),
+        "mask": np.asarray(batch.mask, np.float32),
+    }
+    if batch.regressors is not None:
+        cols["reg"] = np.asarray(batch.regressors, np.float32)
+    if batch.cap is not None:
+        cols["cap"] = np.asarray(batch.cap, np.float32)
+    return cols
+
+
+def _shard_crcs(cols: Dict[str, np.ndarray]) -> Dict[str, int]:
+    return {
+        k: zlib.crc32(np.ascontiguousarray(v).tobytes())
+        for k, v in cols.items()
+    }
+
+
+def _sentinel_path(dset_dir: str, lo: int, hi: int) -> str:
+    return os.path.join(dset_dir, f"shardok_{lo:09d}_{hi:09d}.json")
+
+
+# ---------------------------------------------------------------------------
+# writers
+# ---------------------------------------------------------------------------
+
+
+def _column_shapes(spec: DatasetSpec,
+                   fields: Sequence[str]) -> Dict[str, Tuple[int, ...]]:
+    n, t = spec.n_series, spec.n_timesteps
+    shapes: Dict[str, Tuple[int, ...]] = {}
+    for f in fields:
+        if f == "reg":
+            # Regressor count comes from a 1-row probe at create time
+            # and is recorded in spec.json; see create_columns.
+            continue
+        shapes[f] = (n, t)
+    return shapes
+
+
+def _prealloc_column(path: str, shape: Tuple[int, ...]) -> None:
+    """Preallocate one column file WITHOUT ever clobbering an existing
+    one: the memmap is built under a dot-temp name and published with
+    ``os.link`` (atomic create-if-absent — it FAILS when the path
+    exists, unlike rename).  Two cold producers racing the same spec
+    then cannot truncate rows — or orphan sentinels — the other has
+    already landed; the loser simply adopts the winner's file."""
+    if os.path.exists(path):
+        return
+    d, base = os.path.split(os.path.abspath(path))
+    tmp = os.path.join(d, f".{base}.tmp.{os.getpid()}")
+    mm = np.lib.format.open_memmap(tmp, mode="w+", dtype=np.float32,
+                                   shape=shape)
+    del mm
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        pass  # a racer published first; keep theirs (rows may be landed)
+    finally:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
+def read_spec(dset_dir: str) -> Optional[Dict]:
+    """The dataset's identity record, or None when ``dset_dir`` is not
+    a plane dataset (e.g. a plain ``orchestrate.spill_data`` dir)."""
+    try:
+        with open(os.path.join(dset_dir, _SPEC_FILE)) as fh:
+            d = json.load(fh)
+        return d if isinstance(d, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def create_columns(spec: DatasetSpec, root: Optional[str] = None) -> str:
+    """Create (or adopt) the dataset dir: write ``spec.json`` + the
+    shared calendar atomically and preallocate the column memmaps.
+
+    Idempotent and race-safe: the column bytes are deterministic, so two
+    creators racing the same spec produce identical files; preallocation
+    itself is NOT atomic but no reader ever touches column rows before
+    their shard sentinel exists (the sentinel, not the column file, is
+    the unit of visibility)."""
+    dset_dir = dataset_dir(spec, root)
+    os.makedirs(dset_dir, exist_ok=True)
+    record = read_spec(dset_dir)
+    if record is not None:
+        return dset_dir
+    if spec.generator.startswith("import:"):
+        raise ValueError("import_batch owns imported dataset creation")
+    # Field/regressor discovery probes a TINY grid (fields and reg count
+    # are per-generator constants, independent of T); the real calendar
+    # comes from the closed-form grid so creation never generates a
+    # full seed block on a consumer's blocked path.
+    probe = generate_rows(
+        dataclasses.replace(spec, n_timesteps=min(spec.n_timesteps, 8)),
+        0, 1,
+    )
+    cols = batch_columns(probe)
+    fields = sorted(cols)
+    atomic_write(
+        os.path.join(dset_dir, "ds.npy"),
+        lambda fh: np.save(fh, datasets.dataset_calendar(
+            spec.generator, spec.n_timesteps)),
+    )
+    for f in fields:
+        shape = ((spec.n_series, spec.n_timesteps)
+                 + cols[f].shape[2:])
+        _prealloc_column(os.path.join(dset_dir, f"{f}.npy"), shape)
+    record = dict(spec.to_dict(), fields=fields,
+                  fingerprint=dataset_fingerprint(),
+                  plane_version=PLANE_VERSION,
+                  reg_names=list(probe.regressor_names))
+    atomic_write(
+        os.path.join(dset_dir, _SPEC_FILE),
+        lambda fh: json.dump(record, fh, indent=1), mode="w",
+    )
+    return dset_dir
+
+
+def write_shard(spec: DatasetSpec, shard_index: int,
+                root: Optional[str] = None) -> Tuple[int, int]:
+    """Generate and land one shard: fill the column memmap rows, flush,
+    then publish the sentinel (atomic, CRCs inside) that makes the rows
+    visible.  Emits a ``datagen.shard`` span + shard counters when a
+    trace is bound.  Returns the (lo, hi) landed."""
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+    t0 = time.time()
+    dset_dir = create_columns(spec, root)
+    lo, hi = shard_ranges(spec)[shard_index]
+    batch = generate_rows(spec, lo, hi)
+    cols = batch_columns(batch)
+    for f, rows in cols.items():
+        mm = np.lib.format.open_memmap(
+            os.path.join(dset_dir, f"{f}.npy"), mode="r+"
+        )
+        mm[lo:hi] = rows
+        mm.flush()
+        del mm
+    sentinel = {
+        "lo": lo, "hi": hi, "unix": round(time.time(), 3),
+        "crc": _shard_crcs(cols), "pid": os.getpid(),
+    }
+    atomic_write(
+        _sentinel_path(dset_dir, lo, hi),
+        lambda fh: json.dump(sentinel, fh), mode="w",
+    )
+    dur = time.time() - t0
+    if obs.active():
+        obs.record("datagen.shard", t0, dur, lo=lo, hi=hi,
+                   generator=spec.generator, rows=hi - lo)
+        METRICS.counter("tsspark_datagen_shards_total").inc()
+        METRICS.counter("tsspark_datagen_rows_total").inc(hi - lo)
+        METRICS.histogram("tsspark_datagen_shard_seconds").observe(dur)
+    return lo, hi
+
+
+def finalize(spec: DatasetSpec, root: Optional[str] = None) -> str:
+    """Write the manifest once sentinel coverage is complete (atomic,
+    LAST — the manifest is the warm-cache hit marker, so it must never
+    exist before every shard it certifies)."""
+    dset_dir = dataset_dir(spec, root)
+    missing = missing_shards(spec, root)
+    if missing:
+        raise RuntimeError(
+            f"cannot finalize {dset_dir}: shards {missing} not landed"
+        )
+    record = dict(read_spec(dset_dir) or spec.to_dict(),
+                  complete=True, unix=round(time.time(), 3),
+                  shards=[list(r) for r in shard_ranges(spec)])
+    atomic_write(
+        os.path.join(dset_dir, _MANIFEST_FILE),
+        lambda fh: json.dump(record, fh, indent=1), mode="w",
+    )
+    return dset_dir
+
+
+def import_batch(batch: SeriesBatch, name: str,
+                 root: Optional[str] = None,
+                 shard_rows: int = DEFAULT_SHARD_ROWS) -> str:
+    """Bring an externally-loaded batch (e.g. the real M5 CSVs via
+    ``data.loaders``) under the same manifest: columns + sentinels +
+    manifest, keyed ``import:<name>`` with a content hash so a changed
+    file set never aliases a stale cache."""
+    cols = batch_columns(batch)
+    content = hashlib.md5()
+    for f in sorted(cols):
+        content.update(np.ascontiguousarray(cols[f]).tobytes())
+    n, t = cols["y"].shape
+    spec = DatasetSpec(
+        generator=f"import:{name}_{content.hexdigest()[:8]}",
+        n_series=n, n_timesteps=t, seed=0, shard_rows=shard_rows,
+    )
+    dset_dir = dataset_dir(spec, root)
+    if is_complete(dset_dir):
+        return dset_dir
+    os.makedirs(dset_dir, exist_ok=True)
+    atomic_write(
+        os.path.join(dset_dir, "ds.npy"),
+        lambda fh: np.save(fh, np.asarray(batch.ds, np.float64)),
+    )
+    fields = sorted(cols)
+    for f in fields:
+        path = os.path.join(dset_dir, f"{f}.npy")
+        _prealloc_column(path, cols[f].shape)
+        mm = np.lib.format.open_memmap(path, mode="r+")
+        mm[:] = cols[f]
+        mm.flush()
+        del mm
+    record = dict(spec.to_dict(), fields=fields,
+                  fingerprint=dataset_fingerprint(),
+                  plane_version=PLANE_VERSION,
+                  reg_names=list(batch.regressor_names),
+                  series_ids=[str(s) for s in batch.series_ids])
+    atomic_write(
+        os.path.join(dset_dir, _SPEC_FILE),
+        lambda fh: json.dump(record, fh, indent=1), mode="w",
+    )
+    for lo, hi in shard_ranges(spec):
+        sentinel = {
+            "lo": lo, "hi": hi, "unix": round(time.time(), 3),
+            "crc": _shard_crcs({f: cols[f][lo:hi] for f in fields}),
+            "pid": os.getpid(),
+        }
+        atomic_write(
+            _sentinel_path(dset_dir, lo, hi),
+            lambda fh, s=sentinel: json.dump(s, fh), mode="w",
+        )
+    return finalize(spec, root)
+
+
+# ---------------------------------------------------------------------------
+# readers / coverage
+# ---------------------------------------------------------------------------
+
+
+def is_complete(dset_dir: str) -> bool:
+    """Warm-cache hit test: a readable manifest marked complete."""
+    try:
+        with open(os.path.join(dset_dir, _MANIFEST_FILE)) as fh:
+            return bool(json.load(fh).get("complete"))
+    except (OSError, ValueError):
+        return False
+
+
+def landed_ranges(dset_dir: str) -> List[Tuple[int, int]]:
+    """Merged row coverage of all landed shard sentinels (a torn
+    sentinel — its writer died inside atomic_write, which cannot happen,
+    but a hand-corrupted one can — reads as absent)."""
+    spans = []
+    for p in glob.glob(os.path.join(dset_dir, "shardok_*.json")):
+        stem = os.path.basename(p)[len("shardok_"):-len(".json")]
+        try:
+            lo, hi = (int(x) for x in stem.split("_"))
+        except ValueError:
+            continue
+        spans.append((lo, hi))
+    merged: List[Tuple[int, int]] = []
+    for lo, hi in sorted(spans):
+        if merged and lo <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], hi))
+        else:
+            merged.append((lo, hi))
+    return merged
+
+
+def covers(ranges: Sequence[Tuple[int, int]], lo: int, hi: int) -> bool:
+    """True when [lo, hi) lies inside the merged coverage."""
+    for r_lo, r_hi in ranges:
+        if r_lo <= lo and hi <= r_hi:
+            return True
+    return False
+
+
+def ready_coverage(data_dir: str,
+                   n_series: Optional[int] = None
+                   ) -> Optional[List[Tuple[int, int]]]:
+    """The row ranges a consumer may read RIGHT NOW, or None when no
+    gating applies (a plain spill dir, or a complete dataset): the fit
+    worker's claim filter during overlapped ingestion."""
+    if read_spec(data_dir) is None:
+        return None  # not a plane dataset: everything is ready
+    if is_complete(data_dir):
+        return None
+    ranges = landed_ranges(data_dir)
+    if n_series is not None:
+        ranges = [(lo, min(hi, n_series)) for lo, hi in ranges
+                  if lo < n_series]
+    return ranges
+
+
+def ingest_pending(data_dir: str, n_series: Optional[int] = None) -> bool:
+    """True while a plane dataset's sentinel coverage is still
+    incomplete (the consumer should wait — or self-produce — rather
+    than give up)."""
+    spec_rec = read_spec(data_dir)
+    if spec_rec is None or is_complete(data_dir):
+        return False
+    total = spec_rec.get("n_series", 0)
+    if n_series is not None:
+        total = min(total, n_series)
+    merged = landed_ranges(data_dir)
+    covered = sum(min(hi, total) - lo for lo, hi in merged if lo < total)
+    return covered < total
+
+
+def missing_shards(spec: DatasetSpec,
+                   root: Optional[str] = None) -> List[int]:
+    dset_dir = dataset_dir(spec, root)
+    landed = landed_ranges(dset_dir)
+    return [
+        i for i, (lo, hi) in enumerate(shard_ranges(spec))
+        if not covers(landed, lo, hi)
+    ]
+
+
+def produce_next_missing(data_dir: str) -> bool:
+    """Self-healing consumer path: generate + land the first missing
+    shard inline (deterministic — identical bytes to whatever the dead
+    ingest driver would have written).  Returns False when nothing is
+    missing or the dir is not a generated plane dataset."""
+    rec = read_spec(data_dir)
+    if rec is None or str(rec.get("generator", "")).startswith("import:"):
+        return False
+    spec = DatasetSpec.from_dict(rec)
+    root = os.path.dirname(os.path.abspath(data_dir))
+    if os.path.abspath(dataset_dir(spec, root)) \
+            != os.path.abspath(data_dir):
+        # The dir was keyed under a different fingerprint (source edited
+        # since creation): self-producing would land shards in a NEW dir
+        # this consumer never reads — decline instead.
+        return False
+    missing = missing_shards(spec, root=root)
+    if not missing:
+        return False
+    write_shard(spec, missing[0], root=root)
+    return True
+
+
+def verify_shard(dset_dir: str, lo: int, hi: int) -> bool:
+    """Deep integrity check of one landed shard: recompute the column
+    CRCs over the memmap rows and compare with the sentinel's.  False
+    means the shard is torn/corrupt (reject it; :func:`repair` re-lands
+    it)."""
+    try:
+        with open(_sentinel_path(dset_dir, lo, hi)) as fh:
+            sentinel = json.load(fh)
+    except (OSError, ValueError):
+        return False
+    crcs = sentinel.get("crc") or {}
+    for f, want in crcs.items():
+        path = os.path.join(dset_dir, f"{f}.npy")
+        try:
+            mm = np.load(path, mmap_mode="r")
+        except (OSError, ValueError):
+            return False
+        got = zlib.crc32(np.ascontiguousarray(mm[lo:hi]).tobytes())
+        del mm
+        if got != int(want):
+            return False
+    return True
+
+
+def repair(spec: DatasetSpec, root: Optional[str] = None,
+           deep: bool = True) -> List[Tuple[int, int]]:
+    """Re-land every missing or (with ``deep``) CRC-failing shard and
+    drop a stale manifest first so a corrupt dataset can never keep its
+    warm-hit marker.  Returns the ranges rewritten."""
+    dset_dir = dataset_dir(spec, root)
+    bad: List[Tuple[int, int]] = []
+    ranges = shard_ranges(spec)
+    for i, (lo, hi) in enumerate(ranges):
+        landed = covers(landed_ranges(dset_dir), lo, hi)
+        if landed and (not deep or verify_shard(dset_dir, lo, hi)):
+            continue
+        bad.append((lo, hi))
+        try:
+            os.remove(os.path.join(dset_dir, _MANIFEST_FILE))
+        except OSError:
+            pass
+        write_shard(spec, i, root)
+    if bad and not missing_shards(spec, root):
+        finalize(spec, root)
+    return bad
+
+
+def open_batch(dset_dir: str, mmap: bool = True) -> SeriesBatch:
+    """Read a COMPLETE dataset as a SeriesBatch of memmap columns (the
+    warm path: zero generation, zero copies until a consumer slices)."""
+    if not is_complete(dset_dir):
+        raise FileNotFoundError(
+            f"{dset_dir} has no complete plane manifest (cold cache? "
+            "run ensure()/ingest first)"
+        )
+    rec = read_spec(dset_dir) or {}
+    mode = "r" if mmap else None
+    load = lambda f: np.load(os.path.join(dset_dir, f"{f}.npy"),
+                             mmap_mode=mode)
+    fields = rec.get("fields") or ["mask", "y"]
+    ids = rec.get("series_ids")
+    if ids is None:
+        ids = datasets.dataset_ids(
+            rec.get("generator", "m5"), 0, int(rec.get("n_series", 0))
+        )
+    else:
+        ids = np.asarray(ids)
+    return SeriesBatch(
+        ds=np.load(os.path.join(dset_dir, "ds.npy")),
+        y=load("y"), mask=load("mask"), series_ids=ids,
+        regressors=load("reg") if "reg" in fields else None,
+        cap=load("cap") if "cap" in fields else None,
+        regressor_names=tuple(rec.get("reg_names") or ()),
+    )
+
+
+#: A dataset untouched this long is reaped by the cold-path sweep: the
+#: datagen fingerprint is part of every key, so each data-package edit
+#: strands the previous keys' full-size dirs forever otherwise.
+STALE_DATASET_S = 7 * 24 * 3600.0
+
+
+def sweep_stale_datasets(root: Optional[str] = None,
+                         max_age_s: float = STALE_DATASET_S) -> int:
+    """Remove dataset dirs whose NEWEST file mtime is older than
+    ``max_age_s`` (same age-gated pattern as bench's scratch reaper: a
+    dir any producer or landing shard touched recently is live).  Runs
+    on the cold ingest path only — warm hits never pay the scan.
+    Unlinking under a concurrent reader is safe: its mmap keeps the
+    bytes until unmapped.  Returns the count removed."""
+    import shutil
+
+    root = root or default_root()
+    removed = 0
+    try:
+        entries = [os.path.join(root, n) for n in os.listdir(root)]
+    except OSError:
+        return 0
+    now = time.time()
+    for d in entries:
+        if not os.path.isdir(d):
+            continue
+        try:
+            newest = max(
+                (os.path.getmtime(p) for p in
+                 glob.glob(os.path.join(d, "**"), recursive=True)),
+                default=os.path.getmtime(d),
+            )
+        except OSError:
+            continue
+        if now - newest > max_age_s:
+            shutil.rmtree(d, ignore_errors=True)
+            removed += 1
+    return removed
+
+
+def ensure(spec: DatasetSpec, root: Optional[str] = None,
+           processes: int = 0) -> str:
+    """The front door: return the dataset dir, ingesting first when the
+    cache misses (``processes`` > 1 fans shard generation out to a
+    process pool via :mod:`tsspark_tpu.data.ingest`).  Emits cache
+    hit/miss counters into the obs registry."""
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+    dset_dir = dataset_dir(spec, root)
+    if is_complete(dset_dir):
+        METRICS.counter("tsspark_datagen_cache_hits_total").inc()
+        return dset_dir
+    METRICS.counter("tsspark_datagen_cache_misses_total").inc()
+    from tsspark_tpu.data import ingest
+
+    return ingest.run_ingest(spec, root=root, processes=processes)

@@ -26,7 +26,7 @@ from tsspark_tpu.config import (
     SeasonalityConfig,
     SolverConfig,
 )
-from tsspark_tpu.data import datasets
+from tsspark_tpu import data as datasets
 from tsspark_tpu.eval import metrics
 from tsspark_tpu.streaming.driver import StreamingForecaster
 from tsspark_tpu.streaming.source import InMemorySource

@@ -127,7 +127,8 @@ def _bench_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     extra = parsed.get("extra") or {}
     perf = extra.get("perf") or {}
     _put(m, "fit_wall_s", parsed.get("value"))
-    for k in ("series_done", "datagen_s", "wall_s",
+    for k in ("series_done", "datagen_s", "datagen_share",
+              "ingest_wall_s", "ingest_overlap_s", "wall_s",
               "smape_insample_mean", "converged_frac", "phase2_s",
               "worker_retries", "complete"):
         _put(m, k, extra.get(k))
@@ -426,7 +427,8 @@ def backfill(root: str = ".",
 #: Headline metrics per family, in display order (missing ones elided).
 _TRAJECTORY_COLUMNS = {
     "bench": ("series_per_s", "first_flush_s", "datagen_s",
-              "smape_insample_mean", "series_done", "complete", "rc"),
+              "datagen_share", "smape_insample_mean", "series_done",
+              "complete", "rc"),
     "serve": ("requests_per_s", "p50_ms", "p99_ms", "shed_rate",
               "hit_rate"),
     "chaos": ("ok", "invariant_fails"),

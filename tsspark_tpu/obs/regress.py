@@ -59,6 +59,9 @@ DEFAULT_SLO: Dict[str, Any] = {
                                "max_rise_abs": 8},
             "datagen_s": {"direction": "lower", "max_rise_frac": 1.0,
                           "slack_abs": 10.0},
+            "datagen_share": {"direction": "lower",
+                              "max_rise_abs": 0.10,
+                              "slack_abs": 0.02},
             "smape_insample_mean": {"direction": "lower",
                                     "max_rise_frac": 0.05},
         },

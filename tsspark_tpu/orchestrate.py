@@ -294,13 +294,21 @@ def publish_fit_state(registry, out_dir: str, series_ids,
     return registry.publish(state, ids, step=step, activate=activate)
 
 
-def save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
+def save_prep_atomic(out_dir, lo, hi, b_real, packed, meta,
+                     u8_cols=()) -> None:
     """Persist one chunk's packed device payload (host numpy) so a CPU
     prep worker can build it while the accelerator is wedged and the fit
-    worker can later skip its own prep."""
+    worker can later skip its own prep.
+
+    ``u8_cols``: the regressor indicator-column split the payload was
+    packed under — a STATIC argument of the compiled fit program, so it
+    rides in the file and ``load_prep`` rejects a mismatch (during
+    overlapped ingestion the prep and fit workers may decide the split
+    from different landed coverage)."""
     import numpy as np
 
-    arrays = {"b_real": np.asarray(b_real)}
+    arrays = {"b_real": np.asarray(b_real),
+              "u8_cols": np.asarray(tuple(u8_cols), np.int32)}
     for k, v in packed._asdict().items():
         arrays[f"packed_{k}"] = np.asarray(v)
     for k, v in meta._asdict().items():
@@ -311,13 +319,19 @@ def save_prep_atomic(out_dir, lo, hi, b_real, packed, meta) -> None:
     faults.corrupt_file("prep_save", path, lo=lo, hi=hi)
 
 
-def load_prep(out_dir, lo, hi, chunk=None):
+def load_prep(out_dir, lo, hi, chunk=None, u8_cols=None):
     """(b_real, PackedFitData, ScalingMeta) or None if absent/corrupt.
 
     ``chunk``: reject payloads whose padded batch width differs — a tail
     range keeps its (lo, hi) name across a chunk-halving retry, and
     serving the old wider payload would re-dispatch exactly the program
-    size that just crashed the worker."""
+    size that just crashed the worker.
+
+    ``u8_cols``: reject payloads packed under a DIFFERENT regressor
+    indicator split — the split is a static argument of the compiled
+    program, and feeding a payload packed under another one would
+    mis-reassemble X_reg (files without the recorded split count as a
+    mismatch; prep files are pure cache, so the worker re-preps)."""
     import numpy as np
 
     from tsspark_tpu.models.prophet.design import PackedFitData, ScalingMeta
@@ -333,6 +347,11 @@ def load_prep(out_dir, lo, hi, chunk=None):
             z.close()
             os.remove(path)
             return None
+        if u8_cols is not None:
+            if "u8_cols" not in z.files:
+                return None
+            if tuple(int(j) for j in z["u8_cols"]) != tuple(u8_cols):
+                return None
         packed = PackedFitData(**{
             k: z[f"packed_{k}"] for k in PackedFitData._fields
         })
@@ -672,6 +691,15 @@ def _fit_worker_body(args) -> int:
     ds, d = _load_data(args.data)
     y, mask, reg = d["y"], d["mask"], d["reg"]
     cap, floor = d["cap"], d["floor"]
+    # Overlapped ingestion (docs/DATA.md): when --data is a plane
+    # dataset still being produced, claims — and every other read of
+    # the column memmaps — are gated on the shard coverage that has
+    # LANDED, so the fit starts on the first shards while the ingest
+    # pool writes the rest.  Plain spill dirs and complete datasets
+    # gate nothing (ready_coverage returns None).
+    from tsspark_tpu.data import plane as data_plane
+
+    ingest_stall_s = float(os.environ.get("TSSPARK_INGEST_STALL_S", "30"))
 
     # Liveness for the parent's stall watchdog: every completed solver
     # dispatch touches this file, so long legitimate work (a fresh compile,
@@ -742,8 +770,37 @@ def _fit_worker_body(args) -> int:
     # Indicator-column split for the packed path, decided ONCE on the full
     # dataset: per-chunk auto-detection would let a chunk whose continuous
     # column is coincidentally all-0/1 flip the static argument and
-    # silently recompile mid-run.
-    u8_cols = _indicator_reg_cols(reg) if reg is not None else ()
+    # silently recompile mid-run.  During overlapped ingestion the
+    # decision uses the LANDED rows only (waiting for the first shard
+    # when none has): unlanded memmap rows are preallocation zeros, and
+    # deciding on them would mark every column an indicator — then blow
+    # up the moment a real continuous row lands.
+    if reg is None:
+        u8_cols = ()
+    else:
+        _ready0 = data_plane.ready_coverage(args.data, args.series)
+        if _ready0 is None:
+            u8_cols = _indicator_reg_cols(reg)
+        else:
+            _waited0 = 0.0
+            while not _ready0:
+                heartbeat()
+                time.sleep(0.5)
+                _waited0 += 0.5
+                if _waited0 >= ingest_stall_s:
+                    _waited0 = 0.0
+                    if not data_plane.produce_next_missing(args.data):
+                        # Nothing landed and nothing self-producible (a
+                        # crashed import, a fingerprint-rotated dir):
+                        # stop waiting — next_claim hits the same wall,
+                        # returns None, and the worker exits instead of
+                        # heartbeating the watchdog calm forever.
+                        break
+                _ready0 = data_plane.ready_coverage(args.data, args.series)
+            u8_cols = (
+                _indicator_reg_cols(reg[_ready0[0][0]:_ready0[0][1]])
+                if _ready0 else ()
+            )
 
     def prep(lo: int, hi: int, width: int):
         if not segmented:
@@ -752,8 +809,10 @@ def _fit_worker_body(args) -> int:
             # are identical); corrupt/absent files fall through to local
             # prep.  Width-mismatched payloads (the prep worker packs at
             # the requested cap, the tuner may dispatch smaller) are
-            # rejected by load_prep and re-prepped locally.
-            cached = load_prep(args.out, lo, hi, chunk=width)
+            # rejected by load_prep and re-prepped locally, as are
+            # payloads packed under a different u8 indicator split.
+            cached = load_prep(args.out, lo, hi, chunk=width,
+                               u8_cols=u8_cols)
             if cached is not None:
                 return lo, hi, width, cached[0], cached[1], cached[2]
         b_real = hi - lo
@@ -791,30 +850,54 @@ def _fit_worker_body(args) -> int:
     # through the chunk protocol itself).
     claim_spans: dict = {}
 
-    def next_claim():
-        width = tuner.next_size() if tuner is not None else args.chunk
-        todo2 = plan_chunks(
-            completed_ranges(args.out) + claimed, args.lo, args.hi, width
-        )
-        for lo2, hi2 in todo2:
-            prior = read_lease(args.out, lo2, hi2) if obs.active() \
-                else None
-            claim_sid = obs.new_id() if obs.active() else None
-            if not claim_lease(args.out, lo2, hi2, lease_token,
-                               span_id=claim_sid):
-                continue  # a LIVE sibling owns this range; leave it
-            claimed.append((lo2, hi2))
-            if claim_sid is not None:
-                claim_spans[(lo2, hi2)] = claim_sid
-                stolen = (prior.get("span")
-                          if prior and prior.get("token") != lease_token
-                          else None)
-                extra = {"stolen_from": stolen} if stolen else {}
-                obs.record("chunk.claim", time.time(), 0.0,
-                           span_id=claim_sid, lo=lo2, hi=hi2,
-                           width=width, **extra)
-            return lo2, hi2, width
-        return None
+    def next_claim(block: bool = True):
+        waited = 0.0
+        while True:
+            width = tuner.next_size() if tuner is not None else args.chunk
+            ready = data_plane.ready_coverage(args.data, args.series)
+            todo2 = plan_chunks(
+                completed_ranges(args.out) + claimed, args.lo, args.hi,
+                width,
+            )
+            if ready is not None:
+                todo2 = [(l2, h2) for l2, h2 in todo2
+                         if data_plane.covers(ready, l2, h2)]
+            for lo2, hi2 in todo2:
+                prior = read_lease(args.out, lo2, hi2) if obs.active() \
+                    else None
+                claim_sid = obs.new_id() if obs.active() else None
+                if not claim_lease(args.out, lo2, hi2, lease_token,
+                                   span_id=claim_sid):
+                    continue  # a LIVE sibling owns this range; leave it
+                claimed.append((lo2, hi2))
+                if claim_sid is not None:
+                    claim_spans[(lo2, hi2)] = claim_sid
+                    stolen = (prior.get("span")
+                              if prior and prior.get("token") != lease_token
+                              else None)
+                    extra = {"stolen_from": stolen} if stolen else {}
+                    obs.record("chunk.claim", time.time(), 0.0,
+                               span_id=claim_sid, lo=lo2, hi=hi2,
+                               width=width, **extra)
+                return lo2, hi2, width
+            if ready is None or not data_plane.ingest_pending(
+                args.data, args.series
+            ):
+                return None  # coverage exhausted for real
+            if not block:
+                return None  # caller has in-flight work; don't stall it
+            # Data still being produced: wait for the next shard to
+            # land (heartbeats keep the parent's stall watchdog calm),
+            # and past the stall allowance SELF-PRODUCE the first
+            # missing shard — generation is deterministic, so a dead
+            # ingest driver never deadlocks the fit.
+            heartbeat()
+            time.sleep(0.5)
+            waited += 0.5
+            if waited >= ingest_stall_s:
+                waited = 0.0
+                if not data_plane.produce_next_missing(args.data):
+                    return None
 
     prefetch_depth = 3
     # Adaptive phase-1 depth: depth is a TRACED value of the one compiled
@@ -935,16 +1018,20 @@ def _fit_worker_body(args) -> int:
         write_futs = []
         pending: deque = deque()
 
-        def submit_next() -> bool:
-            c = next_claim()
+        def submit_next(block: bool = False) -> bool:
+            c = next_claim(block=block)
             if c is None:
                 return False
             lo2, hi2, w2 = c
             pending.append(pool.submit(prep, lo2, hi2, w2))
             return True
 
-        for _ in range(prefetch_depth):
-            if not submit_next():
+        # First claim may BLOCK on the opening shard of an overlapped
+        # ingest (a fresh plane dataset has zero coverage for the first
+        # seconds); once work is in flight, refills never stall it —
+        # the pipeline drains and the outer loop blocks instead.
+        for i in range(prefetch_depth):
+            if not submit_next(block=(i == 0)):
                 break
         n_fitted = 0
         while pending:
@@ -1037,6 +1124,12 @@ def _fit_worker_body(args) -> int:
                     f.result()
                 write_futs.clear()
                 faults.inject("fit_worker_chunk", lo=lo, hi=hi)
+            if not pending:
+                # Pipeline drained with ingestion still landing shards:
+                # NOW a blocking claim is free wall (nothing in flight
+                # to stall) — wait for the next shard instead of dying
+                # and paying a full respawn + compile warmup.
+                submit_next(block=True)
         for f in write_futs:
             f.result()  # surface writer-thread failures before phase 2
 
@@ -1366,7 +1459,25 @@ def prep_worker(args) -> int:
     y, mask, reg = d["y"], d["mask"], d["reg"]
     cap, floor = d["cap"], d["floor"]
     model = ProphetModel(model_config, solver_config)
-    u8_cols = _indicator_reg_cols(reg) if reg is not None else ()
+    # Overlapped ingestion: pre-pack only rows whose plane shards have
+    # landed (prep is pure cache — self-producing data is the fit
+    # worker's prerogative, not the prep child's), and decide the u8
+    # indicator split from LANDED rows only, exactly like the fit
+    # worker: unlanded memmap rows are preallocation zeros and would
+    # mark every column an indicator.  The split rides in each payload
+    # (save_prep_atomic) so a fit worker that decided differently
+    # rejects the file instead of mis-reassembling X_reg.
+    from tsspark_tpu.data import plane as data_plane
+
+    ready = data_plane.ready_coverage(args.data, args.series)
+    if reg is None:
+        u8_cols = ()
+    elif ready is None:
+        u8_cols = _indicator_reg_cols(reg)
+    elif ready:
+        u8_cols = _indicator_reg_cols(reg[ready[0][0]:ready[0][1]])
+    else:
+        return 0  # nothing landed yet; nothing worth pre-packing
     collapse_cap = model_config.growth != "logistic"
 
     # Completed COVERAGE, not exact chunk-file names: after a mid-run
@@ -1395,6 +1506,8 @@ def prep_worker(args) -> int:
         if made >= args.max_ahead:
             break
         hi = min(lo + args.chunk, args.series)
+        if ready is not None and not data_plane.covers(ready, lo, hi):
+            continue
         if _covered(lo, hi) or os.path.exists(_prep_path(args.out, lo, hi)):
             continue
         y_c = rows(y, lo, hi)
@@ -1405,7 +1518,8 @@ def prep_worker(args) -> int:
         )
         packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols,
                                   collapse_cap=collapse_cap)
-        save_prep_atomic(args.out, lo, hi, hi - lo, packed, meta)
+        save_prep_atomic(args.out, lo, hi, hi - lo, packed, meta,
+                         u8_cols=u8_cols)
         made += 1
     return 0
 

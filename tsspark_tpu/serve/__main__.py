@@ -30,8 +30,14 @@ import sys
 import time
 
 
-def _build_demo_registry(root: str, n_series: int, seed: int):
-    """Fit a small synthetic batch and publish it as version 1."""
+def _build_demo_registry(root: str, n_series: int, seed: int,
+                         data_root: str = None):
+    """Fit the shared demo dataset and publish it as version 1.
+
+    The batch comes from the columnar data plane (generator
+    ``demo_weekly``, docs/DATA.md) — the same cache bench.py and the
+    streaming replay source read — so the loadgen has no private
+    datagen path and a repeated loadgen is a pure memmap read."""
     import numpy as np
     import jax.numpy as jnp
 
@@ -39,25 +45,26 @@ def _build_demo_registry(root: str, n_series: int, seed: int):
     from tsspark_tpu.config import (
         ProphetConfig, SeasonalityConfig, SolverConfig,
     )
+    from tsspark_tpu.data import plane
     from tsspark_tpu.serve.registry import ParamRegistry
 
     config = ProphetConfig(
         seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
         n_changepoints=3,
     )
-    rng = np.random.default_rng(seed)
-    t = np.arange(180.0)
-    level = rng.uniform(5.0, 50.0, (n_series, 1))
-    slope = rng.uniform(-0.02, 0.05, (n_series, 1))
-    amp = rng.uniform(0.5, 3.0, (n_series, 1))
-    y = (level + slope * t[None, :]
-         + amp * np.sin(2 * np.pi * t[None, :] / 7.0)
-         + rng.normal(0, 0.2, (n_series, len(t))))
+    spec = plane.DatasetSpec(
+        generator="demo_weekly", n_series=n_series, n_timesteps=180,
+        seed=seed,
+    )
+    batch = plane.open_batch(plane.ensure(spec, root=data_root))
     backend = get_backend("tpu", config, SolverConfig(max_iters=25))
-    state = backend.fit(t, jnp.asarray(y))
-    ids = np.asarray([f"s{i:04d}" for i in range(n_series)])
+    state = backend.fit(
+        jnp.asarray(np.asarray(batch.ds, np.float64)),
+        jnp.asarray(np.asarray(batch.y)),
+    )
     registry = ParamRegistry(root, config)
-    registry.publish(state, ids, step=np.ones(n_series))
+    registry.publish(state, np.asarray(batch.series_ids),
+                     step=np.ones(n_series))
     return registry
 
 
@@ -98,7 +105,8 @@ def _loadgen(args) -> int:
         root = args.registry or os.path.join(
             args.dir or ".", "serve_scratch", "registry"
         )
-        registry = _build_demo_registry(root, args.series, args.seed)
+        registry = _build_demo_registry(root, args.series, args.seed,
+                                        data_root=args.data_root)
     recorder = PerfRecorder(
         watch=CompileWatch((predict_mod.forecast_jit,))
     )
@@ -368,6 +376,10 @@ def main(argv=None) -> int:
     ap.add_argument("--series", type=int, default=48,
                     help="loadgen synthetic series count")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--data-root", default=None,
+                    help="columnar data-plane root the loadgen demo "
+                    "dataset is cached under (default: the shared "
+                    "plane root, tsspark_tpu.data.plane.default_root)")
     ap.add_argument("--max-queue", type=int, default=4096)
     ap.add_argument("--max-batch", type=int, default=128)
     ap.add_argument("--cache-capacity", type=int, default=8192)

@@ -170,3 +170,63 @@ class KafkaSource(MicroBatchSource):
         commit = getattr(self._consumer, "commit", None)
         if commit is not None:
             commit()
+
+
+class PlaneReplaySource(MicroBatchSource):
+    """Replay a columnar data-plane dataset as a micro-batch stream.
+
+    The streaming driver's load/datagen path, wired to the SAME shared
+    cache bench.py and the serve loadgen read (tsspark_tpu.data.plane,
+    docs/DATA.md): each ``poll`` slices the next ``window`` timesteps
+    across (up to ``max_series``) series out of the dataset's memmap
+    columns and emits the observed points as a long frame — no private
+    datagen path, no copy until the slice.
+    """
+
+    def __init__(self, dataset_dir: Optional[str] = None, *,
+                 spec=None, root: Optional[str] = None,
+                 window: int = 32, max_series: Optional[int] = None,
+                 id_col: str = "series_id", ds_col: str = "ds",
+                 y_col: str = "y"):
+        import numpy as np
+
+        from tsspark_tpu.data import plane
+
+        if dataset_dir is None:
+            if spec is None:
+                raise ValueError("pass dataset_dir or spec")
+            dataset_dir = plane.ensure(spec, root=root)
+        self.dataset_dir = dataset_dir
+        self._batch = plane.open_batch(dataset_dir)
+        self._np = np
+        self._window = int(window)
+        self._n = (len(self._batch.series_ids) if max_series is None
+                   else min(int(max_series), len(self._batch.series_ids)))
+        self._cols = (id_col, ds_col, y_col)
+        self._t = 0
+
+    def poll(self) -> Optional[pd.DataFrame]:
+        np = self._np
+        t_len = self._batch.y.shape[1]
+        if self._t >= t_len:
+            return None
+        lo, hi = self._t, min(self._t + self._window, t_len)
+        self._t = hi
+        y = np.asarray(self._batch.y[:self._n, lo:hi], np.float64)
+        mask = np.asarray(self._batch.mask[:self._n, lo:hi]) > 0
+        ds = np.asarray(self._batch.ds[lo:hi], np.float64)
+        sid = np.repeat(np.asarray(self._batch.series_ids[:self._n]),
+                        hi - lo)
+        grid = np.tile(ds, self._n)
+        obs_flat = mask.reshape(-1)
+        if not obs_flat.any():
+            # A fully-masked window (e.g. cold-start onset) still
+            # advances the clock; hand back an empty frame contract-
+            # compatibly by polling the next window.
+            return self.poll()
+        id_col, ds_col, y_col = self._cols
+        return pd.DataFrame({
+            id_col: sid[obs_flat],
+            ds_col: grid[obs_flat],
+            y_col: y.reshape(-1)[obs_flat],
+        })
