@@ -1,0 +1,291 @@
+"""Serve replica pool (tsspark_tpu/serve/pool.py, docs/SERVING.md
+"Replica pool & failure domains"): shard routing + bitwise parity
+through replica processes, failover + respawn after SIGKILL, lease
+fencing of a stalled-and-replaced zombie, concurrent activations
+against a live pool, the ahead-of-time materializer, and the tier-1
+pool smoke storm (replica-kill / split-brain-activation / front-crash
+plus the data-plane classes)."""
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu import orchestrate
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.serve import (
+    ForecastCache,
+    ParamRegistry,
+    PredictionEngine,
+    ReplicaPool,
+    shard_of,
+)
+from tsspark_tpu.serve.pool import _send_line
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+)
+SOLVER = SolverConfig(max_iters=25)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    t = np.arange(150.0)
+    y = (10 + 0.02 * t[None, :] + np.sin(2 * np.pi * t[None, :] / 7)
+         + rng.normal(0, 0.1, (6, 150)))
+    backend = get_backend("tpu", CFG, SOLVER)
+    state = backend.fit(t, jnp.asarray(y))
+    return backend, state, [f"s{i}" for i in range(6)]
+
+
+@pytest.fixture(scope="module")
+def pool_env(fitted, tmp_path_factory):
+    """One live 2-replica pool shared by the module's tests (replica
+    spawns are the slow part; tests restore any replica they kill)."""
+    backend, state, ids = fitted
+    root = tmp_path_factory.mktemp("pool_env")
+    registry = ParamRegistry(str(root / "registry"), CFG)
+    registry.publish(state, ids, step=np.ones(len(ids)))
+    pool = ReplicaPool(str(root / "pool"), registry.root, n_replicas=2,
+                       heartbeat_s=0.2, breaker_reset_s=0.3)
+    pool.start()
+    yield backend, state, ids, registry, pool
+    pool.stop()
+
+
+def _respawn(pool, slot, timeout_s=60.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if slot in pool.ensure_alive():
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def test_pool_routes_by_shard_and_matches_direct_predict(pool_env):
+    """Forecasts served through a replica process are bitwise the
+    direct backend.predict (the engine parity pin survives the wire)."""
+    backend, state, ids, registry, pool = pool_env
+    resp = pool.forecast(["s0", "s3"], 7)
+    assert resp["ok"] and resp["replica"] == shard_of("s0", 2)
+    snap = registry.load(resp["version"])
+    idx, _ = snap.rows(["s0", "s3"])
+    sub, step = snap.take(idx)
+    last = np.asarray(sub.meta.ds_start + sub.meta.ds_span, np.float64)
+    grid = last[:, None] + step[:, None] * np.arange(1, 8)
+    direct = backend.predict(sub, grid, num_samples=0)
+    np.testing.assert_array_equal(np.asarray(resp["ds"]), grid)
+    for k, v in direct.items():
+        np.testing.assert_array_equal(
+            np.asarray(resp[k]), np.asarray(v), err_msg=k
+        )
+    # Structured errors cross the wire too.
+    bad = pool.forecast(["ghost"], 7)
+    assert not bad["ok"] and bad["error"]["reason"] == "unknown-series"
+
+
+def test_failover_then_respawn_resumes_same_shard(pool_env):
+    """ISSUE 10 satellite: SIGKILL a replica — requests for its shard
+    keys are served by the sibling with zero failures, and the
+    respawned process resumes the same shard keys at the active
+    version."""
+    backend, state, ids, registry, pool = pool_env
+    victim = shard_of(ids[0], 2)
+    sid = next(s for s in ids if shard_of(s, 2) == victim)
+    pid0 = pool.replicas[victim].pid
+    failovers0 = pool.failovers
+    os.kill(pid0, signal.SIGKILL)
+    resp = pool.forecast([sid], 7)  # in-flight failover, not an error
+    assert resp["ok"] and resp["replica"] != victim
+    assert pool.failovers > failovers0
+    assert _respawn(pool, victim)
+    resp2 = pool.forecast([sid], 7)
+    assert resp2["ok"] and resp2["replica"] == victim
+    assert resp2["version"] == registry.active_version()
+    assert pool.replicas[victim].pid != pid0
+    assert pool.wrong_version == 0
+
+
+def test_concurrent_activates_from_two_publishers(pool_env):
+    """ISSUE 10 satellite: two publishers activate different versions
+    against the live pool concurrently (registry flock + drain
+    interaction) — the pool converges on the registry's final active
+    pointer with zero wrong-version responses."""
+    backend, state, ids, registry, pool = pool_env
+    base = registry.active_version()
+    va = registry.publish(state._replace(theta=state.theta * 1.003),
+                          ids, step=np.ones(len(ids)), activate=False)
+    vb = registry.publish(state._replace(theta=state.theta * 1.007),
+                          ids, step=np.ones(len(ids)), activate=False)
+    errs = []
+
+    def flip(v):
+        try:
+            pool.activate(v, hot_series=ids, horizons=(7,))
+        except Exception as e:  # pragma: no cover - failure detail
+            errs.append(e)
+
+    threads = [threading.Thread(target=flip, args=(v,))
+               for v in (va, vb)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    final = registry.active_version()
+    assert final in (va, vb) and final != base
+    pool.expected_version = final  # a real front re-reads on mismatch
+    for sid in ids:
+        resp = pool.forecast([sid], 7)
+        assert resp["ok"] and resp["version"] == final
+    assert pool.wrong_version == 0
+
+
+def test_activate_flip_serves_from_materialized_cache(pool_env):
+    """The flip lands on a warm cache: the first post-flip request for
+    a materialized series is a cache hit on every replica."""
+    backend, state, ids, registry, pool = pool_env
+    v = registry.publish(state._replace(theta=state.theta * 1.011),
+                         ids, step=np.ones(len(ids)), activate=False)
+    pool.activate(v, hot_series=ids, horizons=(7,))
+    for sid in ids[:4]:
+        resp = pool.forecast([sid], 7)
+        assert resp["ok"] and resp["version"] == v
+        assert resp["from_cache"] == 1, (sid, resp.get("from_cache"))
+
+
+def test_pool_stats_and_metrics_expose_per_replica_shed(pool_env):
+    """ISSUE 10 satellite: per-replica shed counts ride the stats and
+    the Prometheus aggregation (tsspark_pool_replica_shed{replica=k}),
+    and the engine's retry-after gauge is exported."""
+    backend, state, ids, registry, pool = pool_env
+    st = pool.stats()
+    assert set(st["replicas"]) == {"0", "1"}
+    for rep in st["replicas"].values():
+        assert "shed" in rep and "latency_ms" in rep
+    prom = pool.prometheus()
+    assert "tsspark_pool_replica_shed" in prom
+    assert 'replica="0"' in prom and 'replica="1"' in prom
+    assert "tsspark_serve_retry_after_seconds" in prom
+    assert "tsspark_pool_replicas_alive" in prom
+
+
+def test_zombie_replica_is_fenced_after_lease_steal(pool_env):
+    """Split-brain unit: a replica stalls (SIGSTOP), its slot lease
+    expires and is stolen; revived, it must answer the structured
+    ``fenced`` refusal — never data at any version."""
+    backend, state, ids, registry, pool = pool_env
+    slot = 1
+    info = pool.replicas[slot]
+    zpid = info.pid
+    zsock = info.socket_path
+    os.kill(zpid, signal.SIGSTOP)
+    try:
+        # Wait out the lease TTL, then steal the slot like a
+        # replacement replica would (claim succeeds only once stale).
+        deadline = time.time() + 4.0 * pool.lease_ttl_s
+        stolen = False
+        while time.time() < deadline:
+            if orchestrate.claim_lease(pool.pool_dir, slot, slot + 1,
+                                       "test-thief",
+                                       ttl_s=pool.lease_ttl_s):
+                stolen = True
+                break
+            time.sleep(0.1)
+        assert stolen
+    finally:
+        os.kill(zpid, signal.SIGCONT)
+    time.sleep(0.5)  # one heartbeat cycle: the zombie notices
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(15.0)
+    s.connect(zsock)
+    _send_line(s, {"id": "z", "series_ids": [ids[0]], "horizon": 5,
+                   "expect_version": registry.active_version()})
+    buf = b""
+    while b"\n" not in buf:
+        chunk = s.recv(65536)
+        assert chunk, "zombie closed without the structured refusal"
+        buf += chunk
+    s.close()
+    resp = json.loads(buf.split(b"\n", 1)[0])
+    assert not resp["ok"]
+    assert resp["error"]["reason"] == "fenced"
+    # Restore the slot for any later test: drop the thief's lease and
+    # respawn a healthy replica (the zombie exits on its grace timer).
+    orchestrate.release_lease(pool.pool_dir, slot, slot + 1,
+                              "test-thief")
+    try:
+        os.kill(zpid, signal.SIGKILL)
+    except OSError:
+        pass
+    assert _respawn(pool, slot)
+    assert pool.forecast([next(s2 for s2 in ids
+                               if shard_of(s2, 2) == slot)], 5)["ok"]
+
+
+def test_engine_prefetch_and_materialize_warm_flip(tmp_path, fitted):
+    """Engine-level materializer: forecasts computed for a NOT-yet-
+    active version survive its activation (warm-window cache gate) and
+    the activation itself reuses the prefetched snapshot — the first
+    post-flip request dispatches nothing."""
+    backend, state, ids = fitted
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG)
+    reg.publish(state, ids, step=np.ones(len(ids)))
+    eng = PredictionEngine(reg, cache=ForecastCache(capacity=64))
+    assert eng.forecast(["s0"], 7).version == 1
+    v2 = reg.publish(state._replace(theta=state.theta * 1.01), ids,
+                     step=np.ones(len(ids)), activate=False)
+    warmed = eng.materialize(ids, [7], version=v2)
+    assert warmed == len(ids)
+    assert eng.materialize(ids, [7], version=v2) == 0  # idempotent
+    # Not yet active: requests still serve v1.
+    assert eng.forecast(["s1"], 7).version == 1
+    dispatches = eng.stats.dispatches
+    reg.activate(v2)
+    res = eng.forecast(["s0", "s1"], 7)
+    assert res.version == v2 and res.from_cache == 2
+    assert eng.stats.dispatches == dispatches  # flip cost zero compute
+    # ensure_version soft-fails when the registry is elsewhere.
+    assert eng.ensure_version(v2) is True
+    assert eng.ensure_version(999) is False
+
+
+def test_pool_smoke_storm(tmp_path):
+    """Tier-1 pool storm (ISSUE 10): replica-kill, front-crash,
+    split-brain-activation, plane-torn-shard, and ingest-driver-kill
+    ALL GREEN — zero wrong-version responses, zero non-shed failures,
+    exactly one lease owner per slot, bitwise-repaired data plane."""
+    from tsspark_tpu.chaos import compose, run_storm
+
+    classes = set(compose(0, "pool").by_class())
+    assert {"replica-kill", "split-brain-activation", "front-crash",
+            "plane-torn-shard", "ingest-driver-kill"} <= classes
+    # The full acceptance storm schedules the same classes.
+    assert classes <= set(compose(0, "full").by_class())
+
+    report = run_storm(seed=0, profile="pool",
+                       scratch=str(tmp_path / "storm"))
+    assert report["ok"], report["invariants"]
+    inv = report["invariants"]
+    assert inv["pool_failover"]["ok"], inv["pool_failover"]
+    assert inv["pool_failover"]["counters"]["wrong_version"] == 0
+    assert inv["pool_failover"]["counters"]["failed"] == 0
+    assert inv["pool_single_owner"]["ok"], inv["pool_single_owner"]
+    assert inv["pool_front_reattach"]["ok"]
+    assert inv["plane_consistent"]["ok"], inv["plane_consistent"]
+    assert inv["plane_consistent"]["torn_detected"]
+    assert inv["plane_consistent"]["bitwise_vs_generation"]
+    assert inv["recovery_within_budget"]["ok"]
+    assert inv["trace_joined"]["ok"], inv["trace_joined"]
+    for cls in ("replica-kill", "split-brain-activation",
+                "front-crash", "plane-torn-shard",
+                "ingest-driver-kill"):
+        assert report["mttr_s"].get(cls) is not None, cls

@@ -256,10 +256,28 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
     ),
     ArtifactSpec(
         "serve-report", ("SERVE_",),
-        ("_loadgen",),
+        ("_write_report",),
         "serve loadgen latency report, written once at end of run "
         "(the serving analog of a BENCH summary); atomic so a watcher "
         "tailing for the artifact never parses a partial JSON",
+    ),
+    ArtifactSpec(
+        "pool-state", ("pool.json",),
+        ("ReplicaPool._write_state",),
+        "replica-pool front state (serve/pool.py): slot -> socket/pid/"
+        "generation map, replaced atomically on every (re)spawn and "
+        "activation so a successor front (ReplicaPool.attach, the "
+        "front-crash recovery path) never parses a torn index; the "
+        "slot LEASES — not this file — arbitrate process ownership",
+    ),
+    ArtifactSpec(
+        "pool-heartbeat", ("poolhb_",),
+        ("_Replica.run", "_Replica._heartbeat"),
+        "replica liveness mtime (serve/pool.py): created once at "
+        "replica start (append-open), utime-touched per heartbeat; the "
+        "front reads mtime only — same contract as the fit worker "
+        "heartbeat",
+        append_ok=True,
     ),
     ArtifactSpec(
         "timing-log", ("times.jsonl",),
@@ -315,6 +333,8 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/serve/registry.py",
     "tsspark_tpu/serve/engine.py",
     "tsspark_tpu/serve/cache.py",
+    "tsspark_tpu/serve/pool.py",
+    "tsspark_tpu/serve/replica.py",
     "tsspark_tpu/serve/__main__.py",
     "tsspark_tpu/chaos/storm.py",
     "tsspark_tpu/chaos/harness.py",

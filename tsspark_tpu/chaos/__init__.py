@@ -5,11 +5,14 @@ failure domains").
 deterministic fault storm (``storm.compose``) — worker kills, torn
 artifact writes, spawn failures, slow-I/O stalls, wedged accelerator
 probes, registry snapshot corruption, streaming poll faults, serve
-dispatch faults, queue-overload bursts, activation races — and drives
-the whole pipeline through it: orchestrate fit workers -> registry
-publish/activate -> streaming driver -> prediction engine under
-loadgen.  The invariant checkers (``invariants``) then verify the
-properties that make the storm a regression gate rather than a demo:
+dispatch faults, queue-overload bursts, activation races, pool replica
+kills, front crashes, split-brain activations, torn data-plane shards,
+ingest-driver kills — and drives the whole pipeline through it:
+orchestrate fit workers -> registry publish/activate -> streaming
+driver -> prediction engine under loadgen -> serve replica pool ->
+columnar data plane.  The invariant checkers (``invariants``) then
+verify the properties that make the storm a regression gate rather
+than a demo:
 
 * every series lands exactly once (coverage tiles with no gap/overlap,
   and the result is bitwise identical to a fault-free run);
@@ -18,6 +21,11 @@ properties that make the storm a regression gate rather than a demo:
   last good version, never into forecasts);
 * engine-batched forecasts stay bitwise equal to direct
   ``backend.predict`` throughout;
+* the replica pool serves zero wrong-version responses and loses zero
+  non-shed requests through a replica kill, exactly one process owns
+  each slot lease after a steal, and a revived zombie is fenced;
+* the data plane detects torn shards, repairs them bitwise, and a
+  consumer self-produces a dead ingest driver's missing shards;
 * recovery after each injected fault stays under the profile's budget
   (MTTR per fault class, measured off the fault harness's
   cross-process claim files).

@@ -23,10 +23,12 @@ import dataclasses
 import json
 import os
 import shutil
+import signal
+import socket
 import sys
 import tempfile
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -382,6 +384,318 @@ def _run_serve(registry, ids: List[str], state_v1, storm: StormPlan,
 
 
 # ---------------------------------------------------------------------------
+# stage E: replica pool under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_pool(scratch: str, registry, ids: List[str], state_v1,
+              storm: StormPlan,
+              mttr: Dict[str, Optional[float]]) -> Tuple[Dict, Dict]:
+    """Drive the serve replica pool through replica-kill, front-crash,
+    and split-brain-activation at the storm's request indices.  Returns
+    (stage info, invariants)."""
+    import numpy as np
+
+    from tsspark_tpu.serve.pool import (
+        NoReplicaAvailable,
+        ReplicaPool,
+        _send_line,
+        shard_of,
+    )
+
+    prof = storm.profile
+    n = prof.pool_replicas
+    pool_dir = os.path.join(scratch, "pool")
+    pool = ReplicaPool(pool_dir, registry.root, n_replicas=n,
+                       heartbeat_s=0.2, breaker_reset_s=0.3,
+                       spawn_timeout_s=180.0)
+    t0 = time.time()
+    pool.start()
+    counters: Dict[str, object] = {
+        "requests": 0, "completed": 0, "shed": 0, "failed": 0,
+        "fenced_probe_refused": True,
+    }
+    # Front-side totals accumulated ACROSS the front crash (a successor
+    # front starts its own counters; the storm wants storm-wide sums).
+    tot = {"failovers": 0, "respawns": 0, "wrong_version": 0,
+           "fenced_seen": 0}
+
+    def fold_front(p) -> None:
+        tot["failovers"] += p.failovers
+        tot["respawns"] += p.respawns
+        tot["wrong_version"] += p.wrong_version
+        tot["fenced_seen"] += p.fenced_seen
+    kill = storm.direct("replica-kill")
+    crash = storm.direct("front-crash")
+    split = storm.direct("split-brain-activation")
+    t_kill: Optional[float] = None
+    kill_slot: Optional[int] = None
+    kill_probe_sid: Optional[str] = None
+    front_same_pids: Optional[bool] = None
+    split_info: Dict = {}
+
+    def attempt(sids, horizon):
+        counters["requests"] += 1
+        try:
+            resp = pool.forecast(sids, horizon)
+        except NoReplicaAvailable:
+            counters["failed"] += 1
+            return None
+        if resp.get("ok"):
+            counters["completed"] += 1
+            return resp
+        reason = (resp.get("error") or {}).get("reason")
+        if reason == "deadline-exceeded":
+            counters["shed"] += 1
+        else:
+            counters["failed"] += 1
+        return None
+
+    def zombie_probe(sock_path: str, expect: int) -> bool:
+        """Ask the revived zombie directly on its OLD socket: it must
+        refuse with a structured error (or be gone), never serve."""
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(15.0)
+            s.connect(sock_path)
+            _send_line(s, {"id": "zprobe", "series_ids": [ids[0]],
+                           "horizon": 5, "expect_version": expect})
+            buf = b""
+            while b"\n" not in buf:
+                chunk = s.recv(65536)
+                if not chunk:
+                    return True  # closed without serving: safe
+                buf += chunk
+            s.close()
+            resp = json.loads(buf.split(b"\n", 1)[0])
+            return (not resp.get("ok")) and (
+                (resp.get("error") or {}).get("reason")
+                in ("fenced", "version-mismatch")
+            )
+        except OSError:
+            return True  # zombie already exited: equally safe
+
+    def run_split_brain() -> None:
+        zslot = split.series % n
+        zpid = pool.replicas[zslot].pid
+        zsock = pool.replicas[zslot].socket_path
+        obs.event("fault", tag="split-brain-activation", mode="direct",
+                  slot=zslot, pid=zpid)
+        t_split = time.time()
+        os.kill(zpid, signal.SIGSTOP)
+        try:
+            replaced = False
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                if zslot in pool.ensure_alive():
+                    replaced = True
+                    break
+                time.sleep(0.1)
+            v_new = registry.publish(
+                state_v1._replace(
+                    theta=np.asarray(state_v1.theta) * 1.02
+                ),
+                ids, step=np.ones(len(ids)), activate=False,
+            )
+            pool.activate(v_new, hot_series=ids[:8], horizons=(5, 7))
+        finally:
+            try:
+                os.kill(zpid, signal.SIGCONT)
+            except OSError:
+                pass
+        time.sleep(0.3)
+        counters["fenced_probe_refused"] = zombie_probe(
+            zsock, pool.expected_version
+        )
+        # Recovery: the replaced slot serves the NEW version.
+        recovered = None
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            resp = attempt([_owned_sid(zslot)], 5)
+            if (resp is not None and resp.get("replica") == zslot
+                    and resp.get("version") == v_new):
+                recovered = time.time() - t_split
+                break
+            pool.ensure_alive()
+            time.sleep(0.1)
+        mttr["split-brain-activation"] = recovered
+        if recovered is not None:
+            obs.event("recovered", tag="split-brain-activation")
+        split_info.update({
+            "slot": zslot, "zombie_pid": zpid, "replaced": replaced,
+            "activated_version": v_new,
+            "fenced_probe_refused": counters["fenced_probe_refused"],
+        })
+
+    def _owned_sid(slot: int) -> str:
+        for s in ids:
+            if shard_of(s, n) == slot:
+                return s
+        return ids[0]
+
+    for i in range(prof.pool_requests):
+        if kill is not None and i == kill.at_request:
+            kill_slot = kill.series % n
+            kill_probe_sid = _owned_sid(kill_slot)
+            obs.event("fault", tag="replica-kill", mode="direct",
+                      slot=kill_slot)
+            t_kill = time.time()
+            os.kill(pool.replicas[kill_slot].pid, signal.SIGKILL)
+            # The failover acceptance is not vacuous: a request AT the
+            # dead slot's shard, before any respawn, must be served by
+            # the sibling.
+            resp = attempt([kill_probe_sid], 5)
+            counters["failover_exercised"] = (
+                resp is not None and resp.get("replica") != kill_slot
+            )
+        if crash is not None and i == crash.at_request:
+            obs.event("fault", tag="front-crash", mode="direct")
+            t_crash = time.time()
+            before = {k: info.pid for k, info in pool.replicas.items()
+                      if pool._slot_unhealthy(info) is None}
+            fold_front(pool)
+            pool.close_front()
+            pool = ReplicaPool.attach(pool_dir, heartbeat_s=0.2,
+                                      breaker_reset_s=0.3,
+                                      spawn_timeout_s=180.0)
+            front_same_pids = all(
+                pool.replicas[k].pid == pid
+                for k, pid in before.items()
+            )
+            resp = attempt([ids[i % len(ids)]], 5)
+            if resp is not None:
+                mttr["front-crash"] = time.time() - t_crash
+                obs.event("recovered", tag="front-crash")
+        if split is not None and i == split.at_request:
+            run_split_brain()
+        k = 1 + (i % 2)
+        attempt([ids[(i * 5 + j * 3) % len(ids)] for j in range(k)],
+                (5, 7)[i % 2])
+        if (t_kill is not None and "replica-kill" not in mttr):
+            # Recovery: the killed slot itself answers again (sibling
+            # failover alone does not count as the slot recovering).
+            pool.ensure_alive()
+            resp = attempt([kill_probe_sid], 5)
+            if resp is not None and resp.get("replica") == kill_slot:
+                mttr["replica-kill"] = time.time() - t_kill
+                obs.event("recovered", tag="replica-kill")
+
+    pool.ensure_alive()
+    stats = pool.stats()
+    fold_front(pool)
+    counters["wrong_version"] = tot["wrong_version"]
+    replica_pids = {
+        k: (stats["replicas"].get(str(k)) or {}).get("pid")
+        for k in range(n)
+    }
+    invariants = {
+        "pool_failover": inv.pool_request_integrity(counters),
+        "pool_single_owner": inv.pool_single_owner(pool_dir,
+                                                   replica_pids),
+        "pool_front_reattach": {
+            "ok": front_same_pids is not False,
+            "live_replicas_adopted": front_same_pids,
+        },
+    }
+    stage = {
+        "wall_s": round(time.time() - t0, 3),
+        "counters": {k: v for k, v in counters.items()},
+        "failovers": tot["failovers"],
+        "respawns": tot["respawns"],
+        "fenced_seen": tot["fenced_seen"],
+        "split_brain": split_info,
+        "per_replica": stats["replicas"],
+        "expected_version": pool.expected_version,
+    }
+    pool.stop()
+    return stage, invariants
+
+
+# ---------------------------------------------------------------------------
+# stage F: columnar data plane under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_plane(scratch: str, storm: StormPlan,
+               mttr: Dict[str, Optional[float]]) -> Tuple[Dict, Dict]:
+    """Data-plane fault classes: the background ingest driver is killed
+    mid-fill (the consumer self-produces the holes — block-seeded, so
+    bitwise the same bytes), then a landed shard is torn under its
+    sentinel (verify must reject, repair must re-land)."""
+    import numpy as np
+
+    from tsspark_tpu.data import ingest as data_ingest
+    from tsspark_tpu.data import plane
+
+    prof = storm.profile
+    root = os.path.join(scratch, "plane")
+    os.makedirs(root, exist_ok=True)
+    spec = plane.DatasetSpec(
+        generator="demo_weekly", n_series=prof.plane_series,
+        n_timesteps=48, seed=storm.seed,
+        shard_rows=prof.plane_shard_rows,
+    )
+    t0 = time.time()
+
+    # ---- ingest-driver-kill + self-produce-on-stall ------------------
+    driver = data_ingest.IngestDriver.start(spec, root=root, processes=1)
+    dset_dir = driver.dataset_dir
+    obs.event("fault", tag="ingest-driver-kill", mode="direct")
+    t_kill = time.time()
+    driver.kill()
+    driver.wait(10.0)
+    landed_at_kill = plane.landed_ranges(dset_dir)
+    self_produced = 0
+    while plane.ingest_pending(dset_dir):
+        if not plane.produce_next_missing(dset_dir):
+            break
+        self_produced += 1
+    if not plane.is_complete(dset_dir):
+        plane.finalize(spec, root)
+    mttr["ingest-driver-kill"] = time.time() - t_kill
+    obs.event("recovered", tag="ingest-driver-kill")
+
+    # ---- plane-torn-shard: corrupt landed rows under their sentinel --
+    torn = storm.direct("plane-torn-shard")
+    ranges = plane.shard_ranges(spec)
+    lo, hi = ranges[(torn.series or 0) % len(ranges)]
+    obs.event("fault", tag="plane-torn-shard", mode="direct",
+              lo=lo, hi=hi)
+    t_torn = time.time()
+    mm = np.lib.format.open_memmap(os.path.join(dset_dir, "y.npy"),
+                                   mode="r+")
+    mm[lo:hi].view(np.uint32)[...] ^= np.uint32(0x5A5A5A5A)
+    mm.flush()
+    del mm
+    torn_detected = not plane.verify_shard(dset_dir, lo, hi)
+    repaired = plane.repair(spec, root=root)
+    mttr["plane-torn-shard"] = time.time() - t_torn
+    obs.event("recovered", tag="plane-torn-shard")
+
+    plane_inv = inv.plane_consistent(spec, root)
+    plane_inv["torn_detected"] = torn_detected
+    plane_inv["repaired_ranges"] = [list(r) for r in repaired]
+    if not torn_detected:
+        plane_inv["ok"] = False
+        plane_inv.setdefault("errors", []).append(
+            "verify_shard accepted the torn shard"
+        )
+    if [lo, hi] not in plane_inv["repaired_ranges"]:
+        plane_inv["ok"] = False
+        plane_inv.setdefault("errors", []).append(
+            f"repair did not re-land the torn shard [{lo}, {hi})"
+        )
+    stage = {
+        "wall_s": round(time.time() - t0, 3),
+        "n_shards": len(ranges),
+        "landed_at_kill": [list(r) for r in landed_at_kill],
+        "self_produced": self_produced,
+        "torn_shard": [lo, hi],
+    }
+    return stage, {"plane_consistent": plane_inv}
+
+
+# ---------------------------------------------------------------------------
 # the storm
 # ---------------------------------------------------------------------------
 
@@ -432,58 +746,76 @@ def run_storm(seed: int = 0, profile: str = "full",
     mttr: Dict[str, Optional[float]] = {}
     invariants: Dict[str, Dict] = {}
     try:
-        # ---- stage A: orchestrate under storm ------------------------
-        os.environ[faults.ENV_VAR] = plan.to_env()
-        with obs.span("stage.orchestrate", seed=seed, profile=profile):
-            stages["orchestrate"] = _run_orchestrate(
-                scratch, "storm", ds, y, cfg, solver, storm, deadline_s
-            )
-            t_end_orch = time.time()
-        os.environ.pop(faults.ENV_VAR, None)
-        out_dir = stages["orchestrate"]["out_dir"]
+        out_dir: Optional[str] = None
+        if prof.run_orchestrate:
+            # ---- stage A: orchestrate under storm --------------------
+            os.environ[faults.ENV_VAR] = plan.to_env()
+            with obs.span("stage.orchestrate", seed=seed,
+                          profile=profile):
+                stages["orchestrate"] = _run_orchestrate(
+                    scratch, "storm", ds, y, cfg, solver, storm,
+                    deadline_s
+                )
+                t_end_orch = time.time()
+            os.environ.pop(faults.ENV_VAR, None)
+            out_dir = stages["orchestrate"]["out_dir"]
 
-        fired = inv.fault_firing_times(
-            plan.state_dir, rule_cls, plan.rules
-        )
-        orch_classes = {i.cls for i in storm.injections
-                        if i.stage in ("orchestrate",)}
-        mttr.update(inv.orchestrate_mttr(
-            {c: t for c, t in fired.items() if c in orch_classes},
-            out_dir, t_end_orch,
-        ))
-
-        # ---- exactly-once: coverage + bitwise vs fault-free ----------
-        ranges = orchestrate.completed_ranges(out_dir)
-        invariants["series_exactly_once"] = inv.coverage_exactly_once(
-            ranges, prof.series
-        )
-        got_state = orchestrate.load_fit_state(out_dir, prof.series)
-        with obs.span("stage.reference"):
-            stages["reference"] = _run_orchestrate(
-                scratch, "reference", ds, y, cfg, solver, storm,
-                deadline_s
+            fired = inv.fault_firing_times(
+                plan.state_dir, rule_cls, plan.rules
             )
-        ref_state = orchestrate.load_fit_state(
-            stages["reference"]["out_dir"], prof.series
-        )
-        bitwise = inv.states_bitwise_equal(got_state, ref_state)
-        invariants["series_exactly_once"]["bitwise_vs_reference"] = \
-            bitwise
-        invariants["series_exactly_once"]["ok"] &= bitwise["ok"]
-        if not stages["orchestrate"]["complete"]:
-            invariants["series_exactly_once"]["ok"] = False
-            invariants["series_exactly_once"].setdefault(
-                "errors", []
-            ).append("orchestrate run did not complete its coverage")
+            orch_classes = {i.cls for i in storm.injections
+                            if i.stage in ("orchestrate",)}
+            mttr.update(inv.orchestrate_mttr(
+                {c: t for c, t in fired.items() if c in orch_classes},
+                out_dir, t_end_orch,
+            ))
+
+            # ---- exactly-once: coverage + bitwise vs fault-free ------
+            ranges = orchestrate.completed_ranges(out_dir)
+            invariants["series_exactly_once"] = \
+                inv.coverage_exactly_once(ranges, prof.series)
+            got_state = orchestrate.load_fit_state(out_dir, prof.series)
+            with obs.span("stage.reference"):
+                stages["reference"] = _run_orchestrate(
+                    scratch, "reference", ds, y, cfg, solver, storm,
+                    deadline_s
+                )
+            ref_state = orchestrate.load_fit_state(
+                stages["reference"]["out_dir"], prof.series
+            )
+            bitwise = inv.states_bitwise_equal(got_state, ref_state)
+            invariants["series_exactly_once"]["bitwise_vs_reference"] \
+                = bitwise
+            invariants["series_exactly_once"]["ok"] &= bitwise["ok"]
+            if not stages["orchestrate"]["complete"]:
+                invariants["series_exactly_once"]["ok"] = False
+                invariants["series_exactly_once"].setdefault(
+                    "errors", []
+                ).append("orchestrate run did not complete its coverage")
+        else:
+            # Pool-profile fast path: one in-process fit feeds the
+            # registry (the orchestrate fault classes are not armed).
+            import jax.numpy as jnp
+
+            from tsspark_tpu.backends.registry import get_backend
+
+            with obs.span("stage.fit", series=prof.series):
+                backend = get_backend("tpu", cfg, solver)
+                got_state = backend.fit(ds, jnp.asarray(y))
+                stages["fit"] = {"series": prof.series}
 
         # ---- stage B: registry publish + corrupt-active fallback -----
         os.environ[faults.ENV_VAR] = plan.to_env()
         with obs.span("stage.registry"):
             registry = ParamRegistry(os.path.join(scratch, "registry"),
                                      cfg)
-            v1 = orchestrate.publish_fit_state(
-                registry, out_dir, ids, step=np.ones(prof.series)
-            )
+            if out_dir is not None:
+                v1 = orchestrate.publish_fit_state(
+                    registry, out_dir, ids, step=np.ones(prof.series)
+                )
+            else:
+                v1 = registry.publish(got_state, ids,
+                                      step=np.ones(prof.series))
             v2 = registry.publish(
                 got_state._replace(
                     theta=np.asarray(got_state.theta) * 1.01
@@ -513,54 +845,74 @@ def run_storm(seed: int = 0, profile: str = "full",
                               "fallback_served": fb_snap.version}
 
         # ---- stage C: streaming under storm --------------------------
-        with obs.span("stage.streaming"):
-            stages["streaming"] = _run_streaming(registry, cfg, storm,
-                                                 seed)
-        stream_fired = inv.fault_firing_times(
-            plan.state_dir, rule_cls, plan.rules
-        ).get("stream-fault", [])
-        if stream_fired:
-            end = stages["streaming"]["end_time"]
-            mttr["stream-fault"] = max(
-                (end - t for t in stream_fired), default=None
-            )
+        if prof.run_streaming:
+            with obs.span("stage.streaming"):
+                stages["streaming"] = _run_streaming(registry, cfg,
+                                                     storm, seed)
+            stream_fired = inv.fault_firing_times(
+                plan.state_dir, rule_cls, plan.rules
+            ).get("stream-fault", [])
+            if stream_fired:
+                end = stages["streaming"]["end_time"]
+                mttr["stream-fault"] = max(
+                    (end - t for t in stream_fired), default=None
+                )
 
         # ---- stage D: engine loadgen under storm ---------------------
-        with obs.span("stage.serve"):
-            registry.activate(v1)  # loadgen runs over the full batch
-            stages["serve"] = _run_serve(registry, ids, got_state,
-                                         storm, mttr)
+        if prof.loadgen_requests:
+            with obs.span("stage.serve"):
+                registry.activate(v1)  # loadgen runs the full batch
+                stages["serve"] = _run_serve(registry, ids, got_state,
+                                             storm, mttr)
+
+        # ---- stage E: replica pool under storm -----------------------
+        if prof.pool_replicas:
+            with obs.span("stage.pool", replicas=prof.pool_replicas):
+                registry.activate(v1)
+                stages["pool"], pool_inv = _run_pool(
+                    scratch, registry, ids, got_state, storm, mttr
+                )
+            invariants.update(pool_inv)
+
+        # ---- stage F: columnar data plane under storm ----------------
+        if prof.plane_series:
+            with obs.span("stage.data", series=prof.plane_series):
+                stages["data"], plane_inv = _run_plane(scratch, storm,
+                                                       mttr)
+            invariants.update(plane_inv)
 
         # ---- cross-stage invariants ----------------------------------
-        corrupt_injected = sum(
-            1 for i in storm.injections
-            if i.mode == "corrupt" and i.stage == "orchestrate"
-        )
-        invariants["no_torn_reads"] = inv.no_torn_reads(
-            out_dir, corrupt_injected
-        )
-        # The registry side of no-torn-reads: the corrupt snapshot was
-        # never parsed into forecasts (fallback invariant above).
-        invariants["no_torn_reads"]["ok"] &= \
-            invariants["registry_fallback"]["ok"]
+        if out_dir is not None:
+            corrupt_injected = sum(
+                1 for i in storm.injections
+                if i.mode == "corrupt" and i.stage == "orchestrate"
+            )
+            invariants["no_torn_reads"] = inv.no_torn_reads(
+                out_dir, corrupt_injected
+            )
+            # The registry side of no-torn-reads: the corrupt snapshot
+            # was never parsed into forecasts (fallback invariant).
+            invariants["no_torn_reads"]["ok"] &= \
+                invariants["registry_fallback"]["ok"]
 
-        serve = stages["serve"]
-        invariants["engine_direct_parity"] = {
-            "ok": (not serve["parity_failures"]
-                   and serve["counters"]["parity_checks"] > 0),
-            "requests_checked": serve["counters"]["parity_checks"],
-            "failures": serve["parity_failures"],
-        }
-        invariants["cache_version_consistent"] = {
-            "ok": serve["cache_consistent"],
-            "cache_key_versions": serve["cache_key_versions"],
-            "active_version": serve["active_version"],
-        }
-        invariants["breaker_cycled"] = {
-            "ok": serve["breaker_opened"]
-            and serve["breaker"]["state"] == "closed",
-            "breaker": serve["breaker"],
-        }
+        if "serve" in stages:
+            serve = stages["serve"]
+            invariants["engine_direct_parity"] = {
+                "ok": (not serve["parity_failures"]
+                       and serve["counters"]["parity_checks"] > 0),
+                "requests_checked": serve["counters"]["parity_checks"],
+                "failures": serve["parity_failures"],
+            }
+            invariants["cache_version_consistent"] = {
+                "ok": serve["cache_consistent"],
+                "cache_key_versions": serve["cache_key_versions"],
+                "active_version": serve["active_version"],
+            }
+            invariants["breaker_cycled"] = {
+                "ok": serve["breaker_opened"]
+                and serve["breaker"]["state"] == "closed",
+                "breaker": serve["breaker"],
+            }
 
         fired_final = inv.fault_firing_times(
             plan.state_dir, rule_cls, plan.rules
@@ -601,8 +953,13 @@ def run_storm(seed: int = 0, profile: str = "full",
             if v is not None and mttr_spans.get(c) is None
         )
         span_names = set(ledger["red"])
-        stage_names = {"chunk.fit", "registry.publish", "stream.batch",
-                       "serve.request"}
+        stage_names = {"registry.publish"}
+        if prof.run_orchestrate:
+            stage_names.add("chunk.fit")
+        if prof.run_streaming:
+            stage_names.add("stream.batch")
+        if prof.loadgen_requests or prof.pool_replicas:
+            stage_names.add("serve.request")
         invariants["trace_joined"] = {
             # Zero orphan spans, every subsystem on the timeline, every
             # recovered fault class readable off the trace, and
@@ -645,6 +1002,9 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "chunk": prof.chunk, "max_iters": prof.max_iters,
                 "phase1_iters": prof.phase1_iters,
                 "loadgen_requests": prof.loadgen_requests,
+                "pool_replicas": prof.pool_replicas,
+                "pool_requests": prof.pool_requests,
+                "plane_series": prof.plane_series,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),

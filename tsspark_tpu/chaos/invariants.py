@@ -127,6 +127,102 @@ def recovery_within_budget(mttr_s: Dict[str, Optional[float]],
     }
 
 
+def pool_request_integrity(counters: Dict) -> Dict:
+    """The pool-stage acceptance: zero responses served at a version
+    the front did not expect (split-brain / stale-read window), and
+    zero non-shed request failures through the storm's replica kill —
+    every transport failure must have been failed over to a sibling."""
+    errs: List[str] = []
+    if counters.get("wrong_version", 0):
+        errs.append(
+            f"{counters['wrong_version']} response(s) served at an "
+            "unexpected version: the stale-read window is open"
+        )
+    if counters.get("failed", 0):
+        errs.append(
+            f"{counters['failed']} non-shed request failure(s) "
+            "through the storm: a replica death cost requests that "
+            "should have failed over"
+        )
+    if not counters.get("completed", 0):
+        errs.append("no pool request completed (vacuous storm)")
+    if not counters.get("failover_exercised", True):
+        errs.append(
+            "the post-kill probe at the dead slot's shard was not "
+            "served by a sibling: failover never actually ran"
+        )
+    if not counters.get("fenced_probe_refused", True):
+        errs.append(
+            "the revived zombie replica served data instead of the "
+            "structured fenced refusal (split-brain)"
+        )
+    return {"ok": not errs, "counters": dict(counters), "errors": errs}
+
+
+def pool_single_owner(pool_dir: str,
+                      replica_pids: Dict[int, Optional[int]]) -> Dict:
+    """Exactly-one-owner after steals: each slot's lease must exist,
+    belong to the CURRENT replica process (pid match), and that pid
+    must be alive — a zombie's stale token still holding a slot, or a
+    slot with no lease at all, is a routing split-brain."""
+    from tsspark_tpu import orchestrate
+
+    errs: List[str] = []
+    owners: Dict[str, Optional[int]] = {}
+    for slot, pid in sorted(replica_pids.items()):
+        lease = orchestrate.read_lease(pool_dir, slot, slot + 1)
+        lease_pid = None if lease is None else int(lease.get("pid", -1))
+        owners[str(slot)] = lease_pid
+        if lease is None:
+            errs.append(f"slot {slot}: no lease on disk")
+            continue
+        if pid is not None and lease_pid != pid:
+            errs.append(
+                f"slot {slot}: lease owned by pid {lease_pid}, the "
+                f"serving replica is pid {pid} — two processes think "
+                "they own the slot"
+            )
+        try:
+            os.kill(int(lease_pid), 0)
+        except (OSError, TypeError):
+            errs.append(f"slot {slot}: lease owner {lease_pid} is dead")
+    return {"ok": not errs, "lease_owners": owners,
+            "replica_pids": {str(k): v
+                             for k, v in sorted(replica_pids.items())},
+            "errors": errs}
+
+
+def plane_consistent(spec, root: str) -> Dict:
+    """Data-plane end state: every shard sentinel's CRC verifies
+    against the memmap rows, the manifest marks the dataset complete,
+    and the cached columns are BITWISE what direct generation produces
+    — a torn shard that survived repair, or a self-produced shard that
+    diverged from the dead driver's bytes, both break this."""
+    from tsspark_tpu.data import plane
+
+    dset_dir = plane.dataset_dir(spec, root)
+    errs: List[str] = []
+    if not plane.is_complete(dset_dir):
+        errs.append("dataset has no complete manifest")
+    for lo, hi in plane.shard_ranges(spec):
+        if not plane.verify_shard(dset_dir, lo, hi):
+            errs.append(f"shard [{lo}, {hi}) fails its CRC check")
+    bitwise = True
+    if not errs:
+        batch = plane.open_batch(dset_dir)
+        want = plane.batch_columns(
+            plane.generate_rows(spec, 0, spec.n_series)
+        )
+        got = {"y": np.asarray(batch.y), "mask": np.asarray(batch.mask)}
+        for f in ("y", "mask"):
+            if not np.array_equal(got[f], want[f]):
+                bitwise = False
+                errs.append(f"column {f} diverges bitwise from direct "
+                            "generation")
+    return {"ok": not errs, "bitwise_vs_generation": bitwise,
+            "shards": len(plane.shard_ranges(spec)), "errors": errs}
+
+
 def fault_firing_times(state_dir: str, rule_cls: Dict[str, str],
                        rules: List[dict]) -> Dict[str, List[float]]:
     """Per-class wall-clock firing times, read off the fault plan's

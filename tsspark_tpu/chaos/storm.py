@@ -28,6 +28,29 @@ Fault classes (docs/RESILIENCE.md "Chaos harness & failure domains"):
   serve-fault      engine predict dispatches raise until the breaker opens
   queue-overload   a request burst exceeds the engine's bounded queue
   activation-race  a publish+activate lands mid-loadgen, racing the cache
+
+Pool-scale classes (the serve replica pool, profiles with
+``pool_replicas`` > 0):
+
+  replica-kill           SIGKILL one replica mid-loadgen: in-flight and
+                         queued requests fail over to the sibling shard
+                         owner; the slot respawns under backoff
+  front-crash            the pool front dies; a successor re-attaches to
+                         the live replicas without restarting them
+  split-brain-activation a replica stalls (SIGSTOP), its slot lease is
+                         stolen by a replacement, a version activates,
+                         then the zombie revives — lease fencing must
+                         refuse it service, never a stale version
+
+Data-plane classes (PR 9's columnar cache, profiles with
+``plane_series`` > 0):
+
+  plane-torn-shard   a landed shard's memmap rows are byte-flipped under
+                     its sentinel: verify_shard must reject, repair must
+                     re-land bitwise
+  ingest-driver-kill the background ingest driver is SIGKILLed mid-fill:
+                     the consumer self-produces the missing shards
+                     (deterministic block seeding) and completes
 """
 
 from __future__ import annotations
@@ -67,7 +90,12 @@ class Injection:
 
 @dataclasses.dataclass(frozen=True)
 class StormProfile:
-    """Workload + storm sizing for one harness run."""
+    """Workload + storm sizing for one harness run.
+
+    ``run_orchestrate=False`` replaces the chunked-orchestrate stage
+    (and its fault-free reference) with one in-process fit — the pool
+    profile's fast path to a publishable state.  ``pool_replicas`` /
+    ``plane_series`` of 0 disable the pool and data-plane stages."""
 
     name: str
     series: int
@@ -81,6 +109,12 @@ class StormProfile:
     serve_queue: int
     probe_accelerator: bool      # arm wedged-client (real probe loop)
     recovery_budget_s: float
+    run_orchestrate: bool = True
+    run_streaming: bool = True
+    pool_replicas: int = 0
+    pool_requests: int = 0
+    plane_series: int = 0
+    plane_shard_rows: int = 16
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -92,13 +126,28 @@ PROFILES: Dict[str, StormProfile] = {
         loadgen_requests=24, serve_queue=16, probe_accelerator=False,
         recovery_budget_s=90.0,
     ),
+    # Pool + data-plane smoke for tier-1 (<30 s budget): skips the
+    # orchestrate/streaming/serve stages (a direct in-process fit feeds
+    # the registry) and drives ONLY the replica pool and columnar
+    # data-plane fault classes.
+    "pool": StormProfile(
+        name="pool", series=12, days=64, chunk=8, max_iters=20,
+        phase1_iters=0, stream_series=0, stream_batches=0,
+        loadgen_requests=0, serve_queue=16, probe_accelerator=False,
+        recovery_budget_s=60.0, run_orchestrate=False,
+        run_streaming=False, pool_replicas=2, pool_requests=30,
+        plane_series=48, plane_shard_rows=16,
+    ),
     # The acceptance storm (python -m tsspark_tpu.chaos --seed 0):
-    # two-phase orchestrate, probe loop included, longer loadgen.
+    # two-phase orchestrate, probe loop included, longer loadgen, the
+    # replica pool under kill/split-brain/front-crash, and the data
+    # plane under torn-shard/driver-kill.
     "full": StormProfile(
         name="full", series=32, days=96, chunk=8, max_iters=40,
         phase1_iters=6, stream_series=3, stream_batches=3,
         loadgen_requests=160, serve_queue=24, probe_accelerator=True,
-        recovery_budget_s=150.0,
+        recovery_budget_s=150.0, pool_replicas=2, pool_requests=48,
+        plane_series=64, plane_shard_rows=16,
     ),
 }
 
@@ -162,30 +211,34 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
     inj: List[Injection] = []
 
     # -- orchestrate stage (env plan; children inherit it) ------------
-    n_chunks = max(1, prof.series // prof.chunk)
-    inj.append(Injection(
-        cls="worker-kill", stage="orchestrate", point="fit_worker_chunk",
-        mode="exit", after=rng.randrange(0, max(1, n_chunks - 1)),
-        attempts=1, rc=rng.choice((17, 23, 29)),
-    ))
-    inj.append(Injection(
-        cls="torn-artifact", stage="orchestrate", point="chunk_save",
-        mode="corrupt", series=rng.randrange(prof.series), attempts=1,
-    ))
-    inj.append(Injection(
-        cls="spawn-fail", stage="orchestrate", point="worker_spawn",
-        mode="flag", after=0, attempts=1,
-    ))
-    inj.append(Injection(
-        cls="slow-io", stage="orchestrate", point="fit_chunk",
-        mode="sleep", after=rng.randrange(0, n_chunks), attempts=1,
-        delay_s=round(rng.uniform(0.2, 0.6), 3),
-    ))
-    if prof.probe_accelerator:
+    if prof.run_orchestrate:
+        n_chunks = max(1, prof.series // prof.chunk)
         inj.append(Injection(
-            cls="wedged-client", stage="orchestrate", point="device_probe",
-            mode="flag", after=0, attempts=rng.choice((1, 2)),
+            cls="worker-kill", stage="orchestrate",
+            point="fit_worker_chunk",
+            mode="exit", after=rng.randrange(0, max(1, n_chunks - 1)),
+            attempts=1, rc=rng.choice((17, 23, 29)),
         ))
+        inj.append(Injection(
+            cls="torn-artifact", stage="orchestrate", point="chunk_save",
+            mode="corrupt", series=rng.randrange(prof.series),
+            attempts=1,
+        ))
+        inj.append(Injection(
+            cls="spawn-fail", stage="orchestrate", point="worker_spawn",
+            mode="flag", after=0, attempts=1,
+        ))
+        inj.append(Injection(
+            cls="slow-io", stage="orchestrate", point="fit_chunk",
+            mode="sleep", after=rng.randrange(0, n_chunks), attempts=1,
+            delay_s=round(rng.uniform(0.2, 0.6), 3),
+        ))
+        if prof.probe_accelerator:
+            inj.append(Injection(
+                cls="wedged-client", stage="orchestrate",
+                point="device_probe",
+                mode="flag", after=0, attempts=rng.choice((1, 2)),
+            ))
 
     # -- registry stage (corruption via the exempt fault machinery) ---
     inj.append(Injection(
@@ -194,32 +247,71 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
     ))
 
     # -- streaming stage ----------------------------------------------
-    inj.append(Injection(
-        cls="stream-fault", stage="streaming", point="stream_poll",
-        mode="raise", after=rng.randrange(0, 2),
-        attempts=rng.choice((1, 2)),
-    ))
+    if prof.run_streaming:
+        inj.append(Injection(
+            cls="stream-fault", stage="streaming", point="stream_poll",
+            mode="raise", after=rng.randrange(0, 2),
+            attempts=rng.choice((1, 2)),
+        ))
 
     # -- serve stage --------------------------------------------------
-    # serve-fault sizing opens the dispatch breaker deliberately: the
-    # engine retries each dispatch twice (harness policy), the breaker
-    # threshold is 3, so 6 armed raise-slots = exactly 3 failed
-    # dispatches = the breaker opens on the last one, then the storm
-    # watches it recover through half-open.
-    fault_start = rng.randrange(4, 8)
-    inj.append(Injection(
-        cls="serve-fault", stage="serve", point="serve_predict",
-        mode="raise", after=fault_start, attempts=6,
-    ))
-    third = max(4, prof.loadgen_requests // 3)
-    inj.append(Injection(
-        cls="queue-overload", stage="serve", point="submit-burst",
-        mode="direct", at_request=rng.randrange(2, third),
-    ))
-    inj.append(Injection(
-        cls="activation-race", stage="serve", point="publish-activate",
-        mode="direct",
-        at_request=rng.randrange(2 * third, prof.loadgen_requests - 2),
-    ))
+    if prof.loadgen_requests:
+        # serve-fault sizing opens the dispatch breaker deliberately:
+        # the engine retries each dispatch twice (harness policy), the
+        # breaker threshold is 3, so 6 armed raise-slots = exactly 3
+        # failed dispatches = the breaker opens on the last one, then
+        # the storm watches it recover through half-open.
+        fault_start = rng.randrange(4, 8)
+        inj.append(Injection(
+            cls="serve-fault", stage="serve", point="serve_predict",
+            mode="raise", after=fault_start, attempts=6,
+        ))
+        third = max(4, prof.loadgen_requests // 3)
+        inj.append(Injection(
+            cls="queue-overload", stage="serve", point="submit-burst",
+            mode="direct", at_request=rng.randrange(2, third),
+        ))
+        inj.append(Injection(
+            cls="activation-race", stage="serve",
+            point="publish-activate",
+            mode="direct",
+            at_request=rng.randrange(2 * third,
+                                     prof.loadgen_requests - 2),
+        ))
+
+    # -- pool stage (direct injections at request indices; the slot a
+    # -- kill/stall targets rides the ``series`` field) ---------------
+    if prof.pool_replicas:
+        n = prof.pool_requests
+        third = max(3, n // 3)
+        inj.append(Injection(
+            cls="replica-kill", stage="pool", point="replica-proc",
+            mode="direct", at_request=rng.randrange(2, third),
+            series=rng.randrange(prof.pool_replicas),
+        ))
+        inj.append(Injection(
+            cls="front-crash", stage="pool", point="pool-front",
+            mode="direct",
+            at_request=rng.randrange(third, 2 * third),
+        ))
+        inj.append(Injection(
+            cls="split-brain-activation", stage="pool",
+            point="replica-lease", mode="direct",
+            at_request=rng.randrange(2 * third, max(n - 1,
+                                                    2 * third + 1)),
+            series=rng.randrange(prof.pool_replicas),
+        ))
+
+    # -- data-plane stage ---------------------------------------------
+    if prof.plane_series:
+        n_shards = max(1, -(-prof.plane_series // prof.plane_shard_rows))
+        inj.append(Injection(
+            cls="ingest-driver-kill", stage="data",
+            point="ingest-driver", mode="direct",
+        ))
+        inj.append(Injection(
+            cls="plane-torn-shard", stage="data", point="plane-shard",
+            mode="direct", series=rng.randrange(n_shards),
+        ))
 
     return StormPlan(seed=seed, profile=prof, injections=tuple(inj))

@@ -159,12 +159,14 @@ def _serve_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     lat = eng.get("latency_ms") or {}
     occ = eng.get("batch_occupancy") or {}
     cache = rep.get("cache") or {}
+    pool = rep.get("pool") or {}
     m: Dict[str, float] = {}
     for k in ("p50", "p95", "p99", "mean", "max"):
         _put(m, f"{k}_ms", lat.get(k))
     for k in ("requests_per_s", "wall_s"):
         _put(m, k, rep.get(k))
-    for k in ("submitted", "completed", "shed", "failed", "rejected"):
+    for k in ("submitted", "completed", "shed", "failed", "rejected",
+              "fast_failed"):
         _put(m, k, eng.get(k))
     submitted = eng.get("submitted")
     if isinstance(submitted, (int, float)) and submitted:
@@ -172,12 +174,27 @@ def _serve_row(rep: Dict[str, Any]) -> Dict[str, Any]:
              round(float(eng.get("shed", 0)) / submitted, 4))
     _put(m, "hit_rate", cache.get("hit_rate"))
     _put(m, "mean_fill", occ.get("mean_fill"))
+    if pool:
+        # Replica-pool loadgen rows (docs/SERVING.md "Replica pool"):
+        # aggregate throughput, failover count, and the flip-window p99
+        # are the pool's SLO metrics ([tool.tsspark.slo.serve]).
+        _put(m, "agg_requests_per_s", rep.get("requests_per_s"))
+        _put(m, "failovers", pool.get("failovers"))
+        _put(m, "respawns", pool.get("respawns"))
+        _put(m, "wrong_version", pool.get("wrong_version"))
+        _put(m, "flip_p99_ms", (pool.get("flip") or {}).get("p99_ms"))
+        for slot, st in sorted((pool.get("per_replica") or {}).items()):
+            if isinstance(st, dict):
+                _put(m, f"replica{slot}_shed", st.get("shed"))
+    workload = (f"loadgen_{rep.get('n_requests')}"
+                f"x{rep.get('n_series')}")
+    if pool:
+        workload = f"pool{pool.get('replicas')}_{workload}"
     return {
         "kind": "serve",
         "trace_id": rep.get("trace_id"),
         "unix": rep.get("unix"),
-        "workload": (f"loadgen_{rep.get('n_requests')}"
-                     f"x{rep.get('n_series')}"),
+        "workload": workload,
         "device": rep.get("device"),
         "numerics_rev": rep.get("numerics_rev"),
         "config_fingerprint": rep.get("config_fingerprint"),
@@ -430,7 +447,8 @@ _TRAJECTORY_COLUMNS = {
               "datagen_share", "smape_insample_mean", "series_done",
               "complete", "rc"),
     "serve": ("requests_per_s", "p50_ms", "p99_ms", "shed_rate",
-              "hit_rate"),
+              "hit_rate", "agg_requests_per_s", "failovers",
+              "flip_p99_ms"),
     "chaos": ("ok", "invariant_fails"),
     "eval": ("config3_m5.smape_holdout_cpu",
              "config3_m5.delta_holdout_p50",

@@ -140,7 +140,7 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[Tuple[str, _LabelKey], Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
+        self._gauges: Dict[Tuple[str, _LabelKey], Gauge] = {}
         self._hists: Dict[str, Histogram] = {}
 
     def counter(self, name: str, **labels: Any) -> Counter:
@@ -151,11 +151,12 @@ class MetricsRegistry:
                 c = self._counters[key] = Counter()
         return c
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str, **labels: Any) -> Gauge:
+        key = (name, _labelkey(labels))
         with self._lock:
-            g = self._gauges.get(name)
+            g = self._gauges.get(key)
             if g is None:
-                g = self._gauges[name] = Gauge()
+                g = self._gauges[key] = Gauge()
         return g
 
     def histogram(self, name: str) -> Histogram:
@@ -187,8 +188,8 @@ class MetricsRegistry:
                 for (n, lk), c in sorted(self._counters.items())
             ]
             gauges = [
-                {"name": n, "value": g.value}
-                for n, g in sorted(self._gauges.items())
+                {"name": n, "labels": dict(lk), "value": g.value}
+                for (n, lk), g in sorted(self._gauges.items())
             ]
             hists = [
                 {"name": n, **h.to_dict()}
@@ -231,7 +232,12 @@ def prometheus_text(metrics: Dict[str, Any]) -> str:
         )
     for g in metrics.get("gauges", ()):
         lines.append(f"# TYPE {g['name']} gauge")
-        lines.append(f"{g['name']} {g['value']}")
+        lab = ",".join(f'{k}="{v}"' for k, v in
+                       sorted((g.get("labels") or {}).items()))
+        lines.append(
+            f"{g['name']}{{{lab}}} {g['value']}" if lab
+            else f"{g['name']} {g['value']}"
+        )
     for h in metrics.get("histograms", ()):
         name = h["name"]
         lines.append(f"# TYPE {name} histogram")

@@ -74,6 +74,11 @@ DEFAULT_SLO: Dict[str, Any] = {
                                "max_drop_frac": 0.5},
             "shed_rate": {"direction": "lower", "max_rise_abs": 0.05},
             "hit_rate": {"direction": "higher", "max_drop_abs": 0.15},
+            "agg_requests_per_s": {"direction": "higher",
+                                   "max_drop_frac": 0.5},
+            "failovers": {"direction": "lower", "max_rise_abs": 8},
+            "flip_p99_ms": {"direction": "lower", "max_rise_frac": 1.0,
+                            "slack_abs": 50.0},
         },
         "chaos": {
             "ok": {"direction": "higher", "max_drop_abs": 0.5},

@@ -97,7 +97,9 @@ def _metric_lookup(snap: Optional[Dict[str, Any]]) -> Dict[str, float]:
         suffix = "".join(f"/{k}={v}" for k, v in sorted(labels.items()))
         out[f"{c['name']}{suffix}"] = c.get("value", 0)
     for g in metrics.get("gauges", ()):
-        out[g["name"]] = g.get("value", 0.0)
+        labels = g.get("labels") or {}
+        suffix = "".join(f"/{k}={v}" for k, v in sorted(labels.items()))
+        out[f"{g['name']}{suffix}"] = g.get("value", 0.0)
     return out
 
 

@@ -19,6 +19,14 @@ The read path as a first-class subsystem — the fit side's mirror image:
                 request mix and emits a ``SERVE_*.json`` latency report
                 (p50/p95/p99, batch occupancy, cache hit rate).
 
+  pool.py     — engine replica pool: N replica processes (each a full
+                engine over the shared registry) behind one sharding
+                front — lease-fenced replica identity, heartbeat health
+                checks, per-replica circuit breakers, failover to
+                sibling shard owners, respawn under RetryPolicy
+                backoff, and version flips drained one replica at a
+                time behind an ahead-of-time forecast materializer.
+
 Producers publish: ``orchestrate.publish_fit_state`` (chunked fleet
 runs) and ``streaming.ParamStore.publish`` / ``StreamingForecaster.
 publish`` (the micro-batch refit loop).  ``StreamingForecaster`` with
@@ -39,6 +47,14 @@ from tsspark_tpu.serve.engine import (
     ServeError,
     UnknownSeries,
 )
+from tsspark_tpu.serve.pool import (
+    NoReplicaAvailable,
+    PoolError,
+    ReplicaFenced,
+    ReplicaPool,
+    VersionMismatch,
+    shard_of,
+)
 from tsspark_tpu.serve.registry import (
     NUMERICS_REV,
     ParamRegistry,
@@ -55,13 +71,19 @@ __all__ = [
     "ForecastRequest",
     "ForecastResult",
     "NUMERICS_REV",
+    "NoReplicaAvailable",
     "ParamRegistry",
     "PendingForecast",
+    "PoolError",
     "PredictionEngine",
     "RegistryError",
+    "ReplicaFenced",
+    "ReplicaPool",
     "RequestShed",
     "ServeError",
     "Snapshot",
     "UnknownSeries",
+    "VersionMismatch",
+    "shard_of",
     "take_fitstate",
 ]

@@ -15,7 +15,12 @@ Loadgen mode (``--loadgen N``): build a synthetic registry (or reuse
 requests through the engine, and emit a ``SERVE_<unix>.json`` report —
 p50/p95/p99 latency, batch occupancy, cache hit rate, per-dispatch
 telemetry via ``perf.PerfRecorder`` — the serving analog of
-``BENCH_*.json``.
+``BENCH_*.json``.  With ``--pool R`` the same mix is replayed against
+R replica PROCESSES behind the sharding pool front
+(``serve.pool.ReplicaPool``) in pipelined waves with one mid-run
+version flip through the ahead-of-time materializer; the report gains
+a ``pool`` section (aggregate req/s, failovers, per-replica shed
+counts, flip-window p99).
 
 Like the analysis gate, the entry point pins JAX to CPU unless told
 otherwise: a serving smoke run must never block on a wedged TPU tunnel.
@@ -75,6 +80,82 @@ def _zipf_weights(n: int):
     return w / w.sum()
 
 
+def _loadgen_setup(args):
+    """Shared loadgen scaffolding: bind the run's trace, reset the
+    metrics registry, and open (or demo-build) the registry.  Returns
+    ``(scratch_root, registry)``."""
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    # One trace per loadgen run: engine request/dispatch spans land in
+    # the scratch's spans.jsonl, and the SERVE report is stamped with
+    # the trace id so the run ledger joins the two.
+    scratch_root = os.path.join(args.dir or ".", "serve_scratch")
+    obs.start_run(os.path.join(scratch_root, "spans.jsonl"))
+    METRICS.reset()  # this run's snapshot describes this run only
+    if args.registry and os.path.exists(
+        os.path.join(args.registry, "manifest.json")
+    ):
+        registry = ParamRegistry.open(args.registry)
+    else:
+        root = args.registry or os.path.join(scratch_root, "registry")
+        registry = _build_demo_registry(root, args.series, args.seed,
+                                        data_root=args.data_root)
+    return scratch_root, registry
+
+
+def _report_identity(registry) -> dict:
+    """The cross-run identity block every SERVE report carries
+    (obs.history): the sentinel baselines latency/shed/hit-rate only
+    across matching numerics revs and device classes — a TPU loadgen
+    must never gate a CPU one."""
+    import jax
+
+    from tsspark_tpu.config import NUMERICS_REV
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.history import git_rev
+    from tsspark_tpu.utils import checkpoint as ckpt
+
+    return {
+        "kind": "serve-loadgen",
+        "unix": round(time.time(), 3),
+        "trace_id": obs.trace_id(),
+        "numerics_rev": NUMERICS_REV,
+        "git_rev": git_rev(),
+        "device": str(jax.devices()[0]),
+        "config_fingerprint": ckpt.config_fingerprint(registry.config),
+    }
+
+
+def _write_report(report, args) -> str:
+    """Persist the SERVE report atomically; returns its path."""
+    from tsspark_tpu.utils.atomic import atomic_write
+
+    out = args.report or f"SERVE_{int(time.time())}.json"
+    atomic_write(out, lambda fh: json.dump(report, fh, indent=1),
+                 mode="w")
+    return out
+
+
+def _sentinel_gate(report, out) -> int:
+    """Regression sentinel post-step: the report joins RUNHISTORY.jsonl
+    and a breach vs the rolling baseline makes the loadgen exit nonzero
+    (docs/OBSERVABILITY.md).  Returns the exit code."""
+    if os.environ.get("TSSPARK_SENTINEL", "1") != "0":
+        try:
+            from tsspark_tpu.obs import regress
+
+            verdict = regress.sentinel_report(report, source=out)
+            if verdict is not None:
+                print(regress.summarize(verdict))
+                if not verdict["ok"]:
+                    return 1
+        except Exception as e:
+            print(f"sentinel skipped: {e!r}", file=sys.stderr)
+    return 0
+
+
 def _loadgen(args) -> int:
     import numpy as np
 
@@ -87,26 +168,9 @@ def _loadgen(args) -> int:
     from tsspark_tpu.serve.engine import (
         EngineOverloaded, ForecastRequest, PredictionEngine,
     )
-    from tsspark_tpu.serve.registry import ParamRegistry
-    from tsspark_tpu.utils.atomic import atomic_write
 
     t_start = time.perf_counter()
-    # One trace per loadgen run: engine request/dispatch spans land in
-    # the scratch's spans.jsonl, and the SERVE report is stamped with
-    # the trace id so the run ledger joins the two.
-    scratch_root = os.path.join(args.dir or ".", "serve_scratch")
-    obs.start_run(os.path.join(scratch_root, "spans.jsonl"))
-    METRICS.reset()  # this run's snapshot describes this run only
-    if args.registry and os.path.exists(
-        os.path.join(args.registry, "manifest.json")
-    ):
-        registry = ParamRegistry.open(args.registry)
-    else:
-        root = args.registry or os.path.join(
-            args.dir or ".", "serve_scratch", "registry"
-        )
-        registry = _build_demo_registry(root, args.series, args.seed,
-                                        data_root=args.data_root)
+    scratch_root, registry = _loadgen_setup(args)
     recorder = PerfRecorder(
         watch=CompileWatch((predict_mod.forecast_jit,))
     )
@@ -158,23 +222,8 @@ def _loadgen(args) -> int:
     stats = engine.stats.snapshot()
     METRICS.export(os.path.join(scratch_root, "metrics_loadgen.json"),
                    trace_id=obs.trace_id())
-    import jax
-
-    from tsspark_tpu.config import NUMERICS_REV
-    from tsspark_tpu.obs.history import git_rev
-    from tsspark_tpu.utils import checkpoint as ckpt
-
     report = {
-        "kind": "serve-loadgen",
-        "unix": round(time.time(), 3),
-        "trace_id": obs.trace_id(),
-        # Cross-run identity (obs.history): the sentinel baselines
-        # latency/shed/hit-rate only across matching numerics revs and
-        # device classes — a TPU loadgen must never gate a CPU one.
-        "numerics_rev": NUMERICS_REV,
-        "git_rev": git_rev(),
-        "device": str(jax.devices()[0]),
-        "config_fingerprint": ckpt.config_fingerprint(registry.config),
+        **_report_identity(registry),
         "n_requests": n,
         "n_series": n_series,
         "mix": {
@@ -193,9 +242,7 @@ def _loadgen(args) -> int:
         "dispatch": recorder.report().to_dict(),
         "active_version": registry.active_version(),
     }
-    out = args.report or f"SERVE_{int(time.time())}.json"
-    atomic_write(out, lambda fh: json.dump(report, fh, indent=1),
-                 mode="w")
+    out = _write_report(report, args)
     lat = stats["latency_ms"]
     print(
         f"serve loadgen: {n} requests in {wall_s:.2f}s "
@@ -204,21 +251,197 @@ def _loadgen(args) -> int:
         f"{report['cache']['hit_rate']} | shed {stats['shed']} | "
         f"report -> {out}"
     )
-    # Regression sentinel post-step: the report joins RUNHISTORY.jsonl
-    # and a p50/p99/shed/hit-rate breach vs the rolling baseline makes
-    # the loadgen exit nonzero (docs/OBSERVABILITY.md).
-    if os.environ.get("TSSPARK_SENTINEL", "1") != "0":
-        try:
-            from tsspark_tpu.obs import regress
+    return _sentinel_gate(report, out)
 
-            verdict = regress.sentinel_report(report, source=out)
-            if verdict is not None:
-                print(regress.summarize(verdict))
-                if not verdict["ok"]:
-                    return 1
-        except Exception as e:
-            print(f"sentinel skipped: {e!r}", file=sys.stderr)
-    return 0
+
+def _pool_loadgen(args) -> int:
+    """Loadgen against a replica pool (``--loadgen N --pool R``): R
+    replica processes behind the sharding front, T client threads
+    replaying the same deterministic Zipf mix in pipelined waves, one
+    mid-run version flip through the ahead-of-time materializer — the
+    SERVE report gains a ``pool`` section (aggregate req/s, failovers,
+    per-replica shed counts, flip-window p99)."""
+    import threading
+
+    import numpy as np
+
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+    from tsspark_tpu.serve.pool import ReplicaPool
+
+    t_start = time.perf_counter()
+    scratch_root, registry = _loadgen_setup(args)
+    snap = registry.load()
+    sids_all = list(snap.series_ids)
+    n_series = len(sids_all)
+    # The mid-run flip target, published ahead of the replay.
+    v_next = registry.publish(
+        snap.state._replace(theta=np.asarray(snap.state.theta) * 1.01),
+        sids_all, step=np.asarray(snap.step), activate=False,
+    )
+
+    weights = _zipf_weights(n_series)
+    horizons = (7, 14, 28)
+    pool = ReplicaPool(
+        os.path.join(scratch_root, "pool"), registry.root,
+        n_replicas=args.pool, max_queue=args.max_queue,
+        max_batch=args.max_batch, cache_capacity=args.cache_capacity,
+    )
+    pool.start()
+    pool.start_watch(0.3)
+    # Ahead-of-time materialization before the clock starts: the pool's
+    # steady state (and a production flip) serves pre-computed hot
+    # forecasts, so the replay measures serving, not each replica
+    # independently cold-filling its deterministic working set.
+    active_v = registry.active_version()
+    for slot in range(args.pool):
+        try:
+            pool._request_slot(slot, {
+                "cmd": "warm", "version": active_v,
+                "series_ids": sids_all[:256], "horizons": list(horizons),
+            }, timeout_s=300.0)
+        except Exception:
+            pass  # cold replicas warm in-run instead
+
+    n = args.loadgen
+    n_threads = args.pool_clients or min(8, 2 * args.pool)
+    wave = max(1, args.max_batch // 2)
+    lock = threading.Lock()
+    completed = [0]
+    outcomes = {"ok": 0, "shed": 0, "rejected": 0, "failed": 0}
+    latencies: list = []   # (t_done_monotonic, latency_s)
+
+    def client(tid: int, share: int) -> None:
+        rng = np.random.default_rng(args.seed * 1009 + tid)
+        sent = 0
+        while sent < share:
+            k = min(wave, share - sent)
+            reqs = []
+            for j in range(k):
+                k_sids = int(rng.integers(1, min(9, n_series + 1)))
+                pick = rng.choice(n_series, size=k_sids, replace=False,
+                                  p=weights)
+                sampled = rng.random() < 0.1
+                reqs.append({
+                    "id": f"t{tid}-{sent + j}",
+                    "series_ids": [sids_all[i] for i in pick],
+                    "horizon": int(rng.choice(horizons)),
+                    "num_samples": 20 if sampled else 0,
+                    "seed": args.seed,
+                    "deadline_ms": (0.0 if rng.random() < 0.02
+                                    else 30_000.0),
+                })
+            t0 = time.monotonic()
+            resp = pool.submit_wave(reqs)
+            t1 = time.monotonic()
+            with lock:
+                for r in resp.values():
+                    if r.get("ok"):
+                        outcomes["ok"] += 1
+                        latencies.append((t1, t1 - t0))
+                    else:
+                        reason = (r.get("error") or {}).get("reason")
+                        if reason == "deadline-exceeded":
+                            outcomes["shed"] += 1
+                        elif reason == "overloaded":
+                            outcomes["rejected"] += 1
+                        else:
+                            outcomes["failed"] += 1
+                completed[0] += len(resp)
+            sent += k
+
+    shares = [n // n_threads + (1 if t < n % n_threads else 0)
+              for t in range(n_threads)]
+    threads = [threading.Thread(target=client, args=(t, shares[t]),
+                                daemon=True)
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    # Mid-run version flip behind the materializer: warm the hottest
+    # series for v_next on every replica, flip, drain one at a time.
+    flip: dict = {}
+    while completed[0] < n // 2 and any(t.is_alive() for t in threads):
+        time.sleep(0.02)
+    hot = [sids_all[i] for i in np.argsort(-weights)[:16]]
+    t_f0 = time.monotonic()
+    pool.activate(v_next, hot_series=hot, horizons=horizons)
+    t_f1 = time.monotonic()
+    flip = {"version": v_next, "t0": t_f0, "t1": t_f1,
+            "wall_s": round(t_f1 - t_f0, 3)}
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+
+    stats = pool.stats()
+    # Flip-window p99: client-observed latency of requests completing
+    # from warm-start to one second past the drain.
+    win = [lat for (done, lat) in latencies
+           if t_f0 <= done <= t_f1 + 1.0]
+    flip["n_in_window"] = len(win)
+    flip["p99_ms"] = (round(float(np.percentile(win, 99)) * 1e3, 3)
+                      if win else None)
+    lat_all = np.asarray([lat for _, lat in latencies], np.float64)
+    pct = (lambda q: round(float(np.percentile(lat_all, q)) * 1e3, 3)) \
+        if lat_all.size else (lambda q: None)
+    METRICS.export(os.path.join(scratch_root, "metrics_loadgen.json"),
+                   trace_id=obs.trace_id())
+    report = {
+        **_report_identity(registry),
+        "n_requests": n,
+        "n_series": n_series,
+        "mix": {
+            "horizons": list(horizons),
+            "sampled_fraction": 0.1,
+            "hopeless_deadline_fraction": 0.02,
+            "series_per_request": [1, 8],
+            "zipf": True,
+            "seed": args.seed,
+            "clients": n_threads,
+            "wave": wave,
+        },
+        "wall_s": round(wall_s, 3),
+        "setup_s": round(t0 - t_start, 3),
+        "requests_per_s": round(n / wall_s, 1) if wall_s > 0 else None,
+        "engine": {
+            "submitted": n,
+            "completed": outcomes["ok"],
+            "shed": outcomes["shed"],
+            "rejected": outcomes["rejected"],
+            "failed": outcomes["failed"],
+            "latency_ms": {"p50": pct(50), "p95": pct(95),
+                           "p99": pct(99),
+                           "mean": (round(float(lat_all.mean()) * 1e3,
+                                          3) if lat_all.size else None),
+                           "max": (round(float(lat_all.max()) * 1e3, 3)
+                                   if lat_all.size else None)},
+        },
+        "pool": {
+            "replicas": args.pool,
+            "clients": n_threads,
+            "failovers": stats["failovers"],
+            "respawns": stats["respawns"],
+            "wrong_version": stats["wrong_version"],
+            "fenced_seen": stats["fenced_seen"],
+            "per_replica": stats["replicas"],
+            "flip": flip,
+        },
+        "active_version": registry.active_version(),
+    }
+    pool.stop()
+    out = _write_report(report, args)
+    lat = report["engine"]["latency_ms"]
+    shed_pr = {k: (v or {}).get("shed")
+               for k, v in stats["replicas"].items()}
+    print(
+        f"pool loadgen: {n} requests x {args.pool} replicas in "
+        f"{wall_s:.2f}s ({report['requests_per_s']}/s aggregate) | "
+        f"client p50={lat['p50']} p99={lat['p99']} ms | flip p99="
+        f"{flip['p99_ms']} ms over {flip['n_in_window']} | failovers "
+        f"{stats['failovers']} | wrong-version {stats['wrong_version']}"
+        f" | shed/replica {shed_pr} | report -> {out}"
+    )
+    return _sentinel_gate(report, out)
 
 
 def _daemon(args) -> int:
@@ -357,6 +580,25 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    # Persistent compile cache, same keying as the chaos CLI: the
+    # loadgen re-jits a small ladder of predict shapes, and pool
+    # replicas inherit this directory (ReplicaPool passes it through
+    # TSSPARK_JAX_CACHE) — without it every replica cold-compiles the
+    # whole bucket ladder on its own.
+    import jax
+
+    from tsspark_tpu.utils.platform import host_cpu_tag
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("TSSPARK_JAX_CACHE") or os.path.join(
+            repo_root, f".jax_cache_{host_cpu_tag()}"
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     ap = argparse.ArgumentParser(
         prog="python -m tsspark_tpu.serve",
@@ -369,6 +611,14 @@ def main(argv=None) -> int:
     ap.add_argument("--loadgen", type=int, default=None, metavar="N",
                     help="replay a synthetic mix of N requests and "
                     "emit a SERVE_*.json report")
+    ap.add_argument("--pool", type=int, default=None, metavar="R",
+                    help="loadgen: drive R replica processes behind "
+                    "the sharding pool front instead of one in-process "
+                    "engine (docs/SERVING.md, 'Replica pool')")
+    ap.add_argument("--pool-clients", type=int, default=None,
+                    metavar="T",
+                    help="pool loadgen: client threads (default: "
+                    "min(8, 2*R))")
     ap.add_argument("--dir", default=None,
                     help="loadgen scratch root (default: cwd)")
     ap.add_argument("--report", default=None,
@@ -392,6 +642,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.loadgen is not None:
+        if args.pool:
+            return _pool_loadgen(args)
         return _loadgen(args)
     if not args.registry:
         ap.error("daemon mode needs --registry (or pass --loadgen N)")

@@ -39,6 +39,12 @@ class ForecastCache:
         # invalidation sweep) can therefore never pin a retired-version
         # entry.  None = no activation seen yet, accept everything.
         self._accept_version: Optional[Hashable] = None
+        # One additional version inserts are accepted for even while a
+        # different version is active: the pre-activation warm window
+        # (``allow_version``) — the pool's ahead-of-time materializer
+        # fills the NEXT version's entries before the flip, and the
+        # version gate must not drop them as stale.
+        self._warm_version: Optional[Hashable] = None
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
@@ -56,13 +62,28 @@ class ForecastCache:
             self.hits += 1
             return val
 
+    def peek(self, key: Hashable) -> Optional[Dict]:
+        """Presence probe without touching the hit/miss counters or the
+        LRU order (the materializer's idempotency check must not skew
+        the serving hit rate)."""
+        with self._lock:
+            return self._data.get(key)
+
+    def allow_version(self, version: Hashable) -> None:
+        """Open the warm window for ``version``: inserts keyed to it
+        are accepted alongside the active version's until the next
+        ``invalidate`` (i.e. until an activation settles the question)."""
+        with self._lock:
+            self._warm_version = version
+
     def put(self, key: Hashable, value: Dict) -> None:
         if self.capacity <= 0:
             return
         with self._lock:
             if (self._accept_version is not None
                     and isinstance(key, tuple) and key
-                    and key[0] != self._accept_version):
+                    and key[0] != self._accept_version
+                    and key[0] != self._warm_version):
                 return  # keyed to a retired version: never pin it
             self._data[key] = value
             self._data.move_to_end(key)
@@ -78,6 +99,7 @@ class ForecastCache:
         inserts for the retired version no-ops (see ``put``)."""
         with self._lock:
             self._accept_version = version
+            self._warm_version = None  # the flip settles the window
             if version is None:
                 dropped = len(self._data)
                 self._data.clear()

@@ -218,6 +218,11 @@ class EngineStats:
     rejected: int = 0
     pumps: int = 0
     dispatches: int = 0
+    # Breaker-open fast failures (BackendUnavailable) + the last
+    # retry-after hint handed to a caller: the per-failure-domain
+    # saturation signal the SERVE report and metrics surface.
+    fast_failed: int = 0
+    last_retry_after_s: Optional[float] = None
     latencies_s: "collections.deque" = dataclasses.field(
         default_factory=lambda: collections.deque(maxlen=_LATENCY_WINDOW)
     )
@@ -242,6 +247,8 @@ class EngineStats:
             "rejected": self.rejected,
             "pumps": self.pumps,
             "dispatches": self.dispatches,
+            "fast_failed": self.fast_failed,
+            "retry_after_s": self.last_retry_after_s,
             "latency_ms": {
                 "p50": pct(50), "p95": pct(95), "p99": pct(99),
                 "mean": (round(float(lat.mean()) * 1e3, 3)
@@ -318,6 +325,11 @@ class PredictionEngine:
         self._snapshot: Optional[Snapshot] = None
         self._manifest_key: Optional[Tuple[int, ...]] = None
         self._active_seen: Optional[int] = None
+        # A snapshot loaded ahead of its activation (``prefetch``): the
+        # next refresh that finds it matching the active pointer swaps
+        # it in without a disk load — the flip-window latency saver the
+        # pool's ahead-of-time materializer rides.
+        self._prefetched: Optional[Snapshot] = None
         self._pump_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -337,6 +349,11 @@ class PredictionEngine:
         # Live breaker state for the SLO watcher (obs.watch): 0 closed,
         # 1 open/half-open — updated at every dispatch outcome.
         self._m_breaker = METRICS.gauge("tsspark_serve_breaker_open")
+        # Seconds until the open dispatch breaker admits a trial (0 when
+        # closed) — the retry-after hint, scrapeable per failure domain.
+        self._m_retry_after = METRICS.gauge(
+            "tsspark_serve_retry_after_seconds"
+        )
         # In-process activations invalidate immediately; refresh() also
         # polls the manifest so cross-process flips are picked up.
         registry.subscribe(self._on_activate)
@@ -385,7 +402,14 @@ class PredictionEngine:
             return snap
         active = self.registry.active_version()
         if snap is None or active != self._active_seen:
-            loaded = self._load_active()
+            pre = self._prefetched
+            if pre is not None and active == pre.version:
+                # The flip was prefetched (pool warm / materialize):
+                # swap it in without touching the disk.
+                loaded: Optional[Snapshot] = pre
+                self._prefetched = None
+            else:
+                loaded = self._load_active()
             if loaded is None:
                 # Registry breaker open: serve the held snapshot but do
                 # NOT advance the seen markers — the flip has not been
@@ -429,6 +453,88 @@ class PredictionEngine:
         if br is not None:
             br.record_success()
         return snap
+
+    # -- version discipline (pool support) -------------------------------------
+
+    def served_version(self) -> Optional[int]:
+        """The version the engine is currently serving (None before the
+        first refresh)."""
+        snap = self._snapshot
+        return None if snap is None else snap.version
+
+    def prefetch(self, version: int) -> Snapshot:
+        """Load ``version`` ahead of its activation and stash it: the
+        refresh that later finds the active pointer at this version
+        swaps it in with zero disk I/O.  Explicit version — no
+        fallback substitution."""
+        snap = self.registry.load(int(version), fallback=False)
+        self._prefetched = snap
+        return snap
+
+    def ensure_version(self, version: int) -> bool:
+        """Force the engine onto ``version`` if the registry's active
+        pointer agrees: drops the cached staleness markers and reloads
+        when the served version differs.  Returns True when the engine
+        now serves exactly ``version`` (False when the registry's
+        active pointer is elsewhere — the caller decides whether that
+        is a mismatch error).  Serialized against the pump."""
+        version = int(version)
+        with self._pump_lock:
+            try:
+                snap = self.refresh()
+            except Exception:
+                snap = None
+            if snap is not None and snap.version == version:
+                return True
+            self._snapshot = None
+            self._manifest_key = None
+            self._active_seen = None
+            try:
+                snap = self.refresh()
+            except Exception:
+                return False
+            return snap is not None and snap.version == version
+
+    def materialize(self, series_ids: Sequence, horizons: Sequence[int],
+                    version: Optional[int] = None, num_samples: int = 0,
+                    seed: int = 0, max_width: int = 256) -> int:
+        """Ahead-of-time forecast materialization: compute forecasts
+        for ``series_ids`` x ``horizons`` into the version-keyed cache
+        — against ``version`` (prefetching its snapshot) or the active
+        one.  Used by the pool's activate path so a version flip lands
+        on a warm cache; idempotent (already-cached rows are skipped).
+        Returns the number of series-rows computed."""
+        if version is None:
+            with self._pump_lock:
+                snap = self.refresh()
+        else:
+            pre = self._prefetched
+            snap = (pre if pre is not None
+                    and pre.version == int(version)
+                    else self.prefetch(version))
+        self.cache.allow_version(snap.version)
+        ids = list(dict.fromkeys(str(s) for s in series_ids))
+        _, missing = snap.rows(ids)
+        absent = set(missing)
+        ids = [s for s in ids if s not in absent]
+        warmed = 0
+        for h in horizons:
+            hb = max(self.horizon_floor, next_pow2(int(h)))
+            todo = [
+                s for s in ids
+                if self.cache.peek((snap.version, s, hb, num_samples,
+                                    seed)) is None
+            ]
+            for i in range(0, len(todo), int(max_width)):
+                part = todo[i:i + int(max_width)]
+                fresh = self._dispatch(snap, part, hb, num_samples,
+                                       seed, n_requests=0)
+                for sid, row in fresh.items():
+                    self.cache.put(
+                        (snap.version, sid, hb, num_samples, seed), row
+                    )
+                    warmed += 1
+        return warmed
 
     # -- request intake --------------------------------------------------------
 
@@ -512,8 +618,22 @@ class PredictionEngine:
                     (hb, req.num_samples, req.seed), []
                 ).append(pend)
             for (hb, n_s, seed), pends in groups.items():
-                resolved += self._dispatch_group(snap, hb, n_s, seed,
-                                                 pends)
+                try:
+                    resolved += self._dispatch_group(snap, hb, n_s,
+                                                     seed, pends)
+                except Exception as e:
+                    # A group whose dispatch escapes (engine bug, OOM)
+                    # must fail ITS OWN pends — abandoning them would
+                    # leave submitters blocked to their timeouts, and
+                    # the remaining groups of this pump unserved.
+                    for pend in pends:
+                        if not pend.done():
+                            pend._fail(e)
+                            self.stats.failed += 1
+                            self._m_req["failed"].inc()
+                            self._obs_request(pend, "err",
+                                              reason="pump-escape")
+                            resolved += 1
             return resolved
 
     def _dispatch_group(self, snap: Snapshot, hb: int, num_samples: int,
@@ -642,10 +762,12 @@ class PredictionEngine:
         # retries burned); each dispatch counts as ONE breaker outcome
         # even when the retry policy makes several attempts inside it.
         if self.breaker is not None and not self.breaker.allow():
+            retry_after = self.breaker.retry_after_s()
+            self.stats.fast_failed += 1
+            self.stats.last_retry_after_s = round(retry_after, 3)
             self._m_breaker.set(1.0)
-            raise BackendUnavailable(
-                self.breaker.name, self.breaker.retry_after_s()
-            )
+            self._m_retry_after.set(retry_after)
+            raise BackendUnavailable(self.breaker.name, retry_after)
         ctx = (self.recorder.dispatch(width, live=n, kind="predict")
                if self.recorder is not None else contextlib.nullcontext())
         # ok-flag + finally (not except Exception): even a BaseException
@@ -666,9 +788,10 @@ class PredictionEngine:
             if self.breaker is not None:
                 (self.breaker.record_success if ok
                  else self.breaker.record_failure)()
-                self._m_breaker.set(
-                    0.0 if self.breaker.state == CircuitBreaker.CLOSED
-                    else 1.0
+                closed = self.breaker.state == CircuitBreaker.CLOSED
+                self._m_breaker.set(0.0 if closed else 1.0)
+                self._m_retry_after.set(
+                    0.0 if closed else self.breaker.retry_after_s()
                 )
             if obs.active():
                 obs.record("serve.dispatch", t_disp0,
@@ -695,6 +818,8 @@ class PredictionEngine:
         self._stop.clear()
 
         def loop():
+            import traceback
+
             while not self._stop.is_set():
                 try:
                     self.pump(block_s=poll_s)
@@ -702,6 +827,10 @@ class PredictionEngine:
                     # pump() resolves per-request failures itself; an
                     # escape here is a bug, but it must not kill the
                     # worker and leave every later submit hanging.
+                    # Loud on stderr: a silent swallow here cost a
+                    # debugging session (requests timing out with no
+                    # trace of why).
+                    traceback.print_exc()
                     time.sleep(poll_s)
 
         self._thread = threading.Thread(

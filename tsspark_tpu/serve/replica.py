@@ -1,0 +1,65 @@
+"""``python -m tsspark_tpu.serve.replica`` — one pool replica process.
+
+Spawned by ``serve.pool.ReplicaPool`` (not an operator entry point):
+claims its slot lease, attaches a full ``PredictionEngine`` over the
+shared registry, and serves the JSONL envelope on its unix socket until
+killed, told to quit, or fenced out of its lease.  Lives outside
+``serve/__init__`` imports so runpy executes it without the
+found-in-sys.modules double-import warning.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    # Same device pinning as `python -m tsspark_tpu.serve`: a replica
+    # must never block on a wedged accelerator tunnel.
+    if os.environ.get("TSSPARK_SERVE_DEVICE", "cpu") == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.environ.get("TSSPARK_JAX_CACHE")
+    if cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5
+        )
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tsspark_tpu.serve.replica",
+        description="serve replica-pool worker (docs/SERVING.md, "
+                    "'Replica pool & failure domains')",
+    )
+    ap.add_argument("--pool-dir", required=True)
+    ap.add_argument("--slot", type=int, required=True)
+    ap.add_argument("--registry", required=True)
+    ap.add_argument("--socket", required=True)
+    ap.add_argument("--gen", type=int, default=1)
+    ap.add_argument("--heartbeat-s", type=float, default=0.25)
+    ap.add_argument("--lease-ttl-s", type=float, default=1.5)
+    ap.add_argument("--max-queue", type=int, default=4096)
+    ap.add_argument("--max-batch", type=int, default=128)
+    ap.add_argument("--cache-capacity", type=int, default=8192)
+    args = ap.parse_args(argv)
+
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.serve.pool import run_replica
+
+    obs.adopt_env()
+    return run_replica(
+        args.pool_dir, args.slot, args.registry, args.socket,
+        gen=args.gen, heartbeat_s=args.heartbeat_s,
+        lease_ttl_s=args.lease_ttl_s, max_queue=args.max_queue,
+        max_batch=args.max_batch, cache_capacity=args.cache_capacity,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
