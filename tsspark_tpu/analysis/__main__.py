@@ -20,11 +20,9 @@ import sys
 def main(argv=None) -> int:
     # Must precede any jax import anywhere in the process.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+    from tsspark_tpu.resident import force_virtual_host_mesh
+
+    force_virtual_host_mesh()
 
     ap = argparse.ArgumentParser(
         prog="python -m tsspark_tpu.analysis",

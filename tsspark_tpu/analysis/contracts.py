@@ -501,6 +501,42 @@ def _c_sharded(case: ShapeCase, out) -> List[str]:
     return _check_result(case, cfg, out)
 
 
+def _k_resident(case: ShapeCase):
+    """The mesh-resident fit program (tsspark_tpu.resident): traced with
+    the phase-control triple the resident waves actually pass, so the
+    one-program-for-both-phases contract is checked abstractly on every
+    mesh layout of the matrix (the contract does not need real sharded
+    placement — eval_shape proves shapes/dtypes for the traced body,
+    which is pinned to fit_core_packed's)."""
+    import jax
+    import numpy as np
+
+    from tsspark_tpu.parallel.sharding import fit_resident_core
+
+    cfg, solver = _configs(case)
+    if _mesh_for(case) is None:
+        return None
+    theta0 = _sds((case.b, cfg.num_params))
+    return jax.eval_shape(
+        lambda p, th: fit_resident_core(
+            p, th, cfg, solver, (),
+            max_iters_dynamic=np.int32(6),
+            gn_precond_dynamic=np.bool_(False),
+            use_theta0_dynamic=np.bool_(False),
+        ),
+        _packed_data(case, cfg), theta0,
+    )
+
+
+def _c_resident(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    theta, stats = out
+    return (
+        _expect(theta, (case.b, cfg.num_params), "float32", "theta")
+        + _expect(stats, (5, case.b), "float32", "stats")
+    )
+
+
 def default_kernels() -> Tuple[KernelContract, ...]:
     return (
         KernelContract("model.fit_core", _k_fit_core, _c_fit_core),
@@ -523,6 +559,8 @@ def default_kernels() -> Tuple[KernelContract, ...]:
                        wants_mesh=True),
         KernelContract("sharding.fit_sharded_packed", _k_sharded_packed,
                        _c_sharded, wants_mesh=True),
+        KernelContract("sharding.fit_resident_core", _k_resident,
+                       _c_resident, wants_mesh=True),
     )
 
 

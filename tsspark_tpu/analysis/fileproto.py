@@ -108,16 +108,28 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
     ),
     ArtifactSpec(
         "heartbeat", ("heartbeat",),
-        ("_fit_worker_body.heartbeat",),
-        "liveness mtime touched by the fit worker per dispatch; read "
-        "(mtime only) by the parent watchdog",
+        ("_fit_worker_body.heartbeat", "_resident_body.heartbeat"),
+        "liveness mtime touched by the fit worker (and the mesh-resident "
+        "program) per dispatch; read (mtime only) by the parent watchdog",
     ),
     ArtifactSpec(
         "phase2-sentinel", ("phase2_done",),
-        ("_fit_worker_body", "_cpu_fill"),
+        ("_fit_worker_body", "_cpu_fill", "_resident_body"),
         "created exactly once when straggler coverage completes (or the "
         "run degrades to CPU); presence gates the parent's done check; "
-        "removed only by the integrity re-queue path",
+        "removed only by the integrity re-queue path; the mesh-resident "
+        "path writes the same marker so the two paths' scratch dirs are "
+        "interchangeable",
+    ),
+    ArtifactSpec(
+        "resident-state", ("resident.json",),
+        ("_write_resident_state",),
+        "mesh-resident flush progress (tsspark_tpu.resident): wave "
+        "index, landed coverage, mesh shape — replaced atomically after "
+        "every on-device -> checkpoint flush, so an operator (or the "
+        "chaos harness proving the mesh path actually ran) never parses "
+        "a torn record; the chunk files, not this artifact, carry the "
+        "results",
     ),
     ArtifactSpec(
         "run-fingerprint", ("run_fingerprint",),
@@ -281,9 +293,10 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
     ),
     ArtifactSpec(
         "timing-log", ("times.jsonl",),
-        ("fit_worker", "fit_worker.save_and_log"),
+        ("fit_worker", "fit_worker.save_and_log", "_times_row"),
         "append-only per-chunk diagnostics (doubles as the perf "
-        "telemetry rows bench.py summarizes — docs/PERF.md)",
+        "telemetry rows bench.py summarizes — docs/PERF.md); the "
+        "mesh-resident path appends the same rows via _times_row",
         append_ok=True,
     ),
     ArtifactSpec(
@@ -319,6 +332,7 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
 # Modules under the package root whose write sites are in protocol scope.
 PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/orchestrate.py",
+    "tsspark_tpu/resident.py",
     "tsspark_tpu/data/plane.py",
     "tsspark_tpu/data/ingest.py",
     "tsspark_tpu/streaming/state.py",

@@ -1011,6 +1011,24 @@ def phase2_dynamic_args(solver_config, packed: bool = False) -> dict:
     return d
 
 
+def tune_phase1_depth(depth: int, frac_unconv: float,
+                      max_iters: int) -> int:
+    """THE adaptive phase-1 depth policy, applied once after chunk 0:
+    deepen only on a PATHOLOGICAL first chunk (a quarter still
+    progressing — measured on the M5 shape the unconverged set is
+    depth-flat, it is the ill-conditioned tail that needs phase 2's GN
+    metric, not more plain lockstep), shallow out when virtually
+    everything converges early.  One definition shared by the chunk-file
+    fit worker (``orchestrate``) and the mesh-resident path
+    (``tsspark_tpu.resident``) so the two paths' depth decisions — and
+    therefore their per-series results — cannot drift."""
+    if frac_unconv > 0.25:
+        return min(int(depth) * 2, int(max_iters))
+    if frac_unconv < 0.005 and int(depth) > 8:
+        return max(8, int(depth) * 2 // 3)
+    return int(depth)
+
+
 def difficulty_order(grad_norm: np.ndarray) -> np.ndarray:
     """Argsort for compacting stragglers, hardest first.
 

@@ -20,6 +20,14 @@ import sys
 
 def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The mesh-resident fault class needs a multi-device mesh; on a
+    # CPU-pinned storm that means the virtual device mesh the test
+    # harness and the multichip dry-run force.  Must land in os.environ
+    # BEFORE jax initializes — and it is inherited by the storm's child
+    # workers, so the resident child sees the same 8 virtual devices.
+    from tsspark_tpu.resident import force_virtual_host_mesh
+
+    force_virtual_host_mesh()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -696,8 +696,114 @@ def _run_plane(scratch: str, storm: StormPlan,
 
 
 # ---------------------------------------------------------------------------
-# the storm
+# stage G: mesh-resident fit program under storm
 # ---------------------------------------------------------------------------
+
+
+def _run_resident_storm(scratch: str, storm: StormPlan,
+                        deadline_s: float) -> Tuple[Dict, Dict]:
+    """The resident-kill class: the mesh-resident fit child (orchestrate
+    ``--_resident``) dies at the armed ``resident_flush`` point mid
+    flush-stream; a successor invocation must resume from the last
+    LANDED checkpoint flush and finish with exactly-once coverage,
+    bitwise equal to a fault-free reference run."""
+    import glob as glob_mod
+
+    from tsspark_tpu import orchestrate, resident
+
+    prof = storm.profile
+    cfg, solver = _config(prof.max_iters)
+    ds, y = _synthetic_batch(storm.seed + 11, prof.resident_series,
+                             prof.days)
+    base = os.path.join(scratch, "resident")
+    data_dir = os.path.join(base, "data")
+    out_dir = os.path.join(base, "out")
+    os.makedirs(out_dir, exist_ok=True)
+    orchestrate.spill_data(data_dir, ds, y)
+    orchestrate.save_run_config(out_dir, cfg, solver)
+    extra = [
+        "--lo", "0", "--hi", str(prof.resident_series),
+        "--chunk", str(prof.resident_chunk),
+        "--series", str(prof.resident_series),
+        "--phase1-iters", str(prof.phase1_iters), "--no-phase1-tune",
+    ]
+    t0 = time.time()
+    rc_first = orchestrate.spawn_worker(
+        "--_resident", data_dir, out_dir, extra,
+        timeout=deadline_s, progress_timeout=300.0,
+    )
+    landed_at_kill = orchestrate.completed_ranges(out_dir)
+    marker = os.path.join(out_dir, "phase2_done")
+    attempts = 1
+    rc = rc_first
+    while (orchestrate.missing_ranges(
+            orchestrate.completed_ranges(out_dir), prof.resident_series)
+           or not os.path.exists(marker)) and attempts < 5:
+        attempts += 1
+        rc = orchestrate.spawn_worker(
+            "--_resident", data_dir, out_dir, extra,
+            timeout=deadline_s, progress_timeout=300.0,
+        )
+    t_end = time.time()
+    complete = rc == 0 and not orchestrate.missing_ranges(
+        orchestrate.completed_ranges(out_dir), prof.resident_series
+    ) and os.path.exists(marker)
+    got = orchestrate.load_fit_state(out_dir, prof.resident_series)
+    # The resident flush-state artifact is the proof the MESH path ran
+    # (a meshless child would have degraded to the chunk workers and
+    # passed vacuously).
+    res_state_path = os.path.join(out_dir, resident.RESIDENT_STATE_FILE)
+    ran_resident = os.path.exists(res_state_path)
+
+    # Fault-free reference, file-protocol path, faults disarmed: bitwise
+    # equality doubles as the chaos-level resident/fileproto parity gate.
+    env_plan = os.environ.pop(faults.ENV_VAR, None)
+    try:
+        ref_out = os.path.join(base, "ref_out")
+        os.makedirs(ref_out, exist_ok=True)
+        orchestrate.save_run_config(ref_out, cfg, solver)
+        ref_state = orchestrate.run_resilient(
+            data_dir=data_dir, out_dir=ref_out,
+            series=prof.resident_series, chunk=prof.resident_chunk,
+            min_chunk=prof.resident_chunk, segment=0,
+            phase1_iters=prof.phase1_iters, no_phase1_tune=True,
+            deadline=time.time() + deadline_s, reserve=lambda: 5.0,
+            progress_timeout=300.0, probe_accelerator=False,
+            retry_policy=_RETRY, probe_policy=_PROBE,
+        )
+        ref = orchestrate.load_fit_state(ref_out, prof.resident_series)
+    finally:
+        if env_plan is not None:
+            os.environ[faults.ENV_VAR] = env_plan
+
+    inv_res = inv.coverage_exactly_once(
+        orchestrate.completed_ranges(out_dir), prof.resident_series
+    )
+    bitwise = inv.states_bitwise_equal(got, ref)
+    inv_res["bitwise_vs_fileproto_reference"] = bitwise
+    inv_res["ok"] &= bitwise["ok"] and complete and ran_resident
+    if not complete:
+        inv_res.setdefault("errors", []).append(
+            "resident run never completed its coverage after resume"
+        )
+    if not ran_resident:
+        inv_res.setdefault("errors", []).append(
+            "no resident flush-state artifact: the mesh path never ran "
+            "(meshless fallback would make this class vacuous)"
+        )
+    stage = {
+        "wall_s": round(t_end - t0, 3),
+        "rc_first": rc_first,
+        "attempts": attempts,
+        "landed_at_kill": [list(r) for r in landed_at_kill],
+        "ran_resident": ran_resident,
+        "complete": complete,
+        "ref_complete": bool(ref_state.get("complete")),
+        "chunks": len(glob_mod.glob(
+            os.path.join(out_dir, "chunk_*.npz")
+        )),
+    }
+    return stage, {"resident_exactly_once": inv_res}
 
 
 def run_storm(seed: int = 0, profile: str = "full",
@@ -881,6 +987,24 @@ def run_storm(seed: int = 0, profile: str = "full",
                                                        mttr)
             invariants.update(plane_inv)
 
+        # ---- stage G: mesh-resident fit program under storm ----------
+        if prof.resident_series:
+            with obs.span("stage.resident",
+                          series=prof.resident_series):
+                stages["resident"], res_inv = _run_resident_storm(
+                    scratch, storm, deadline_s
+                )
+            invariants.update(res_inv)
+            res_fired = inv.fault_firing_times(
+                plan.state_dir, rule_cls, plan.rules
+            ).get("resident-kill", [])
+            if res_fired:
+                mttr.update(inv.orchestrate_mttr(
+                    {"resident-kill": res_fired},
+                    os.path.join(scratch, "resident", "out"),
+                    time.time(),
+                ))
+
         # ---- cross-stage invariants ----------------------------------
         if out_dir is not None:
             corrupt_injected = sum(
@@ -1005,6 +1129,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "pool_replicas": prof.pool_replicas,
                 "pool_requests": prof.pool_requests,
                 "plane_series": prof.plane_series,
+                "resident_series": prof.resident_series,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),

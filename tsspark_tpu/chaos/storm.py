@@ -51,6 +51,16 @@ Data-plane classes (PR 9's columnar cache, profiles with
   ingest-driver-kill the background ingest driver is SIGKILLed mid-fill:
                      the consumer self-produces the missing shards
                      (deterministic block seeding) and completes
+
+Mesh-resident class (the single-program fit path, profiles with
+``resident_series`` > 0):
+
+  resident-kill      the resident sharded program's process dies (exit
+                     fault at the ``resident_flush`` point) mid
+                     flush-stream: a successor run must resume from the
+                     last LANDED checkpoint flush, finish with
+                     exactly-once coverage, and assemble a state
+                     bitwise equal to a fault-free reference
 """
 
 from __future__ import annotations
@@ -115,6 +125,8 @@ class StormProfile:
     pool_requests: int = 0
     plane_series: int = 0
     plane_shard_rows: int = 16
+    resident_series: int = 0
+    resident_chunk: int = 8
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -140,14 +152,16 @@ PROFILES: Dict[str, StormProfile] = {
     ),
     # The acceptance storm (python -m tsspark_tpu.chaos --seed 0):
     # two-phase orchestrate, probe loop included, longer loadgen, the
-    # replica pool under kill/split-brain/front-crash, and the data
-    # plane under torn-shard/driver-kill.
+    # replica pool under kill/split-brain/front-crash, the data plane
+    # under torn-shard/driver-kill, and the mesh-resident fit program
+    # killed mid-flush.
     "full": StormProfile(
         name="full", series=32, days=96, chunk=8, max_iters=40,
         phase1_iters=6, stream_series=3, stream_batches=3,
         loadgen_requests=160, serve_queue=24, probe_accelerator=True,
         recovery_budget_s=150.0, pool_replicas=2, pool_requests=48,
         plane_series=64, plane_shard_rows=16,
+        resident_series=32, resident_chunk=8,
     ),
 }
 
@@ -300,6 +314,16 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
             at_request=rng.randrange(2 * third, max(n - 1,
                                                     2 * third + 1)),
             series=rng.randrange(prof.pool_replicas),
+        ))
+
+    # -- mesh-resident stage (env plan; the resident child inherits) --
+    if prof.resident_series:
+        n_waves = max(1, prof.resident_series // prof.resident_chunk)
+        inj.append(Injection(
+            cls="resident-kill", stage="resident",
+            point="resident_flush", mode="exit",
+            after=rng.randrange(0, max(1, n_waves - 1)), attempts=1,
+            rc=rng.choice((17, 23, 29)),
         ))
 
     # -- data-plane stage ---------------------------------------------

@@ -139,13 +139,28 @@ def _bench_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     # such rows in the committed trajectory).
     if extra.get("series_done"):
         _put(m, "series_per_s", extra.get("series_per_s"))
+        # Path-scoped throughput (the mesh-resident fit's own SLO
+        # metric): only stamped by resident-path runs, so its rolling
+        # baseline is resident-only by construction.
+        _put(m, "resident_series_per_s",
+             extra.get("resident_series_per_s"))
     for k in ("first_flush_s", "compile_misses", "n_chunks"):
         _put(m, k, perf.get(k))
+    # The fit path rides the workload key: resident and chunk-file runs
+    # of the same shape are DIFFERENT workloads to the regression
+    # sentinel — their throughput baselines must never mix.  Only the
+    # NON-default path is suffixed: fileproto rows keep the historical
+    # key, so the default path's entire committed baseline history stays
+    # live instead of being orphaned by a rename.
+    workload = parsed.get("metric")
+    fit_path = extra.get("fit_path")
+    if workload and fit_path and fit_path != "fileproto":
+        workload = f"{workload}+{fit_path}"
     return {
         "kind": "bench",
         "trace_id": extra.get("trace_id"),
         "unix": parsed.get("unix"),
-        "workload": parsed.get("metric"),
+        "workload": workload,
         "device": extra.get("device"),
         "numerics_rev": extra.get("numerics_rev"),
         "config_fingerprint": extra.get("config_fingerprint"),

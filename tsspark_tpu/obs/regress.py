@@ -53,6 +53,8 @@ DEFAULT_SLO: Dict[str, Any] = {
         "bench": {
             "series_per_s": {"direction": "higher",
                              "max_drop_frac": 0.5},
+            "resident_series_per_s": {"direction": "higher",
+                                      "max_drop_frac": 0.5},
             "first_flush_s": {"direction": "lower",
                               "max_rise_frac": 1.5, "slack_abs": 5.0},
             "compile_misses": {"direction": "lower",

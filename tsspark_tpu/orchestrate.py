@@ -287,11 +287,31 @@ def publish_fit_state(registry, out_dir: str, series_ids,
     ``load_fit_state``'s: a torn or incomplete run raises instead of
     publishing a partial version.  Returns the published version.
     """
-    import numpy as np
-
-    ids = np.asarray([str(s) for s in series_ids])
+    ids = normalize_series_ids(series_ids)
     state = load_fit_state(out_dir, len(ids))
     return registry.publish(state, ids, step=step, activate=activate)
+
+
+def normalize_series_ids(series_ids):
+    """Series ids as a numpy unicode array — C-level conversion, no
+    per-series Python pass.  The publish path used to run
+    ``[str(s) for s in ids]`` per publish, a 1M-element Python loop on
+    the registry's critical path (ROADMAP item 2); every publish-side
+    consumer (here, ``serve.registry``) now normalizes through this one
+    helper, and ``tests/test_resident.py`` micro-benches it at scale."""
+    import numpy as np
+
+    ids = np.asarray(series_ids)
+    if ids.ndim == 0:
+        # A sized-less iterable (a generator — or a bare string, which
+        # the old per-element loop also exploded into characters) lands
+        # as a 0-d array; materialize by iteration, exactly like the
+        # old ``[str(s) for s in ids]`` — a public-API input type must
+        # not silently narrow.
+        ids = np.asarray(list(series_ids))
+    if ids.dtype.kind not in ("U", "S"):
+        ids = ids.astype(np.str_)
+    return ids
 
 
 def save_prep_atomic(out_dir, lo, hi, b_real, packed, meta,
@@ -605,6 +625,45 @@ def _chunk_mask(y_c, mask, lo, hi, chunk):
 # fit worker (accelerator child)
 # --------------------------------------------------------------------------
 
+def decide_u8_split(data_dir: str, reg, series: int,
+                    heartbeat=None, stall_s: float = 30.0):
+    """The regressor indicator-column split, decided ONCE per run on
+    LANDED plane coverage only (unlanded memmap rows are preallocation
+    zeros and would mark every column an indicator — then blow up the
+    moment a real continuous row lands).  Blocks for the first shard of
+    an overlapped ingest, self-producing past the stall allowance so a
+    dead driver never deadlocks the decision; ``heartbeat`` keeps an
+    external watchdog calm while waiting.  ONE definition shared by the
+    chunk-file fit worker and the mesh-resident path
+    (``tsspark_tpu.resident``) — the split is a static argument of the
+    compiled fit program, so the two paths deciding differently would
+    break their bitwise-parity contract."""
+    from tsspark_tpu.data import plane as data_plane
+    from tsspark_tpu.models.prophet.design import _indicator_reg_cols
+
+    if reg is None:
+        return ()
+    ready = data_plane.ready_coverage(data_dir, series)
+    if ready is None:
+        return _indicator_reg_cols(reg)
+    waited = 0.0
+    while not ready:
+        if heartbeat is not None:
+            heartbeat()
+        time.sleep(0.5)
+        waited += 0.5
+        if waited >= stall_s:
+            waited = 0.0
+            if not data_plane.produce_next_missing(data_dir):
+                # Nothing landed and nothing self-producible (a crashed
+                # import, a fingerprint-rotated dir): stop waiting — the
+                # claim loop hits the same wall and exits cleanly.
+                break
+        ready = data_plane.ready_coverage(data_dir, series)
+    return (_indicator_reg_cols(reg[ready[0][0]:ready[0][1]])
+            if ready else ())
+
+
 def _metrics_chunk(live: int, fit_s: float) -> None:
     """Per-chunk metrics (docs/OBSERVABILITY.md naming convention);
     called only on the traced path — untraced fits skip even the
@@ -672,7 +731,7 @@ def _fit_worker_body(args) -> int:
         phase2_dynamic_args,
     )
     from tsspark_tpu.models.prophet.design import (
-        ScalingMeta, _indicator_reg_cols, pack_fit_data,
+        ScalingMeta, pack_fit_data,
     )
     from tsspark_tpu.models.prophet.model import (
         FitState, fit_core_packed, fitstate_from_packed,
@@ -770,37 +829,11 @@ def _fit_worker_body(args) -> int:
     # Indicator-column split for the packed path, decided ONCE on the full
     # dataset: per-chunk auto-detection would let a chunk whose continuous
     # column is coincidentally all-0/1 flip the static argument and
-    # silently recompile mid-run.  During overlapped ingestion the
-    # decision uses the LANDED rows only (waiting for the first shard
-    # when none has): unlanded memmap rows are preallocation zeros, and
-    # deciding on them would mark every column an indicator — then blow
-    # up the moment a real continuous row lands.
-    if reg is None:
-        u8_cols = ()
-    else:
-        _ready0 = data_plane.ready_coverage(args.data, args.series)
-        if _ready0 is None:
-            u8_cols = _indicator_reg_cols(reg)
-        else:
-            _waited0 = 0.0
-            while not _ready0:
-                heartbeat()
-                time.sleep(0.5)
-                _waited0 += 0.5
-                if _waited0 >= ingest_stall_s:
-                    _waited0 = 0.0
-                    if not data_plane.produce_next_missing(args.data):
-                        # Nothing landed and nothing self-producible (a
-                        # crashed import, a fingerprint-rotated dir):
-                        # stop waiting — next_claim hits the same wall,
-                        # returns None, and the worker exits instead of
-                        # heartbeating the watchdog calm forever.
-                        break
-                _ready0 = data_plane.ready_coverage(args.data, args.series)
-            u8_cols = (
-                _indicator_reg_cols(reg[_ready0[0][0]:_ready0[0][1]])
-                if _ready0 else ()
-            )
+    # silently recompile mid-run.  The decision (landed-coverage gating,
+    # stall-bounded wait, self-produce) is decide_u8_split — shared with
+    # the mesh-resident path.
+    u8_cols = decide_u8_split(args.data, reg, args.series,
+                              heartbeat=heartbeat, stall_s=ingest_stall_s)
 
     def prep(lo: int, hi: int, width: int):
         if not segmented:
@@ -834,6 +867,9 @@ def _fit_worker_body(args) -> int:
 
     # Range claims come from plan_chunks (coverage-based, never file
     # names) — see its docstring for the overlap invariants it carries.
+    # NOTE: tsspark_tpu.resident's claim loop mirrors next_claim below
+    # (same plan/lease/ready-coverage/self-produce invariants); a change
+    # to the claim logic here must land there too.
     # With the tuner each claim is sized at submit time, so the claim
     # grid follows the learned chunk size mid-run; locally-claimed
     # ranges count as covered because the writer thread may not have
@@ -918,10 +954,11 @@ def _fit_worker_body(args) -> int:
         frac_unconv = float(
             (~np.asarray(state.converged)[:b_real]).mean()
         )
-        if frac_unconv > 0.25:
-            depth["v"] = min(int(depth["v"]) * 2, max_iters)
-        elif frac_unconv < 0.005 and depth["v"] > 8:
-            depth["v"] = max(8, int(depth["v"]) * 2 // 3)
+        # THE depth policy (backends.tpu.tune_phase1_depth), shared with
+        # the mesh-resident path so the two cannot drift.
+        from tsspark_tpu.backends.tpu import tune_phase1_depth
+
+        depth["v"] = tune_phase1_depth(depth["v"], frac_unconv, max_iters)
 
     def save_and_log(lo, hi, state, fit_s, t_wait, t_put, t_dev, t1,
                      width, compiled):
@@ -1350,6 +1387,10 @@ def _fit_worker_body(args) -> int:
             # Straggler sub-chunk prep (numpy design build + packing)
             # prefetched on threads so it overlaps the deep device solves,
             # same pattern as the phase-1 loop.
+            # NOTE: tsspark_tpu.resident's phase 2 mirrors this branch
+            # (serial, sharded dispatch) and the two are pinned BITWISE
+            # equal by tests/test_resident.py — a change to the straggler
+            # gather/pad/patch logic here must land there too.
             phase2_mode = "host"
             # Partial-coverage fallback: the retained payloads serve no
             # purpose here — release them before the deep solves raise
@@ -2473,11 +2514,21 @@ def _worker_main(argv) -> int:
     ap.add_argument("--autotune", action="store_true")
     ap.add_argument("--max-ahead", type=int, default=6)
     a = ap.parse_args(argv)
+    if mode == "--_resident":
+        # Mesh-resident single-program mode (tsspark_tpu.resident): the
+        # whole fit as sharded in-process dispatches, chunk files landed
+        # through the same save_chunk_atomic/lease protocol — so the
+        # chaos harness can SIGKILL/fault this child and resume it
+        # exactly like a chunk-file fit worker.
+        from tsspark_tpu import resident
+
+        return resident.resident_worker(a)
     return {"--_fit": fit_worker, "--_prep": prep_worker}[mode](a)
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] in ("--_fit", "--_prep"):
+    if len(sys.argv) > 1 and sys.argv[1] in ("--_fit", "--_prep",
+                                             "--_resident"):
         sys.exit(_worker_main(sys.argv[1:]))
     raise SystemExit(
         "tsspark_tpu.orchestrate is a worker/launcher module; use "

@@ -22,7 +22,8 @@ scheduler code to write, which is precisely the TPU-first design win.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+import re
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -148,6 +149,159 @@ def packed_shardings(
         X_reg_bits=P(s_ax, None, None),
         prior_scales=P(None),
         mult_mask=P(None),
+    )
+
+
+# ---------------------------------------------------------------------------
+# partition rules + shard/gather fns (the mesh-resident feed machinery)
+# ---------------------------------------------------------------------------
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], tree):
+    """PartitionSpec pytree for a NamedTuple batch: each field name is
+    matched against ``rules`` (ordered ``(regex, PartitionSpec)`` pairs,
+    first match wins) — the rule-driven analog of writing a spec per
+    leaf by hand, so a new payload field inherits a layout from its
+    name instead of silently defaulting to replicated.  Scalar/0-d
+    leaves never partition.  Raises on an unmatched name: a field
+    without a rule is a layout decision nobody made."""
+    import numpy as np
+
+    def spec_for(name: str, leaf):
+        if np.ndim(leaf) == 0:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name) is not None:
+                # Trim trailing axes the leaf does not have (a rank-1
+                # leaf under a (series, None) rule shards its one axis).
+                return P(*spec[: np.ndim(leaf)])
+        raise ValueError(f"no partition rule matches field {name!r}")
+
+    return type(tree)(**{
+        name: spec_for(name, getattr(tree, name))
+        for name in tree._fields
+    })
+
+
+def resident_partition_rules(series_axis: str,
+                             x_season_per_series: bool
+                             ) -> Tuple[Tuple[str, P], ...]:
+    """THE partition rules of the mesh-resident fit feed
+    (``tsspark_tpu.resident``): per-series leaves shard on the series
+    axis, shared design tensors replicate.  Time is deliberately NOT
+    sharded — per-series math must stay shard-local so the resident
+    program is bitwise the single-device program per row
+    (tests/test_resident.py pins exactly that)."""
+    shared = r"^(ds_rel|prior_scales|mult_mask)$" \
+        if x_season_per_series else r"^(ds_rel|prior_scales|mult_mask|X_season)$"
+    return (
+        (shared, P()),
+        (r".*", P(series_axis, None, None)),
+    )
+
+
+def pad_packed_rows(packed, k: int):
+    """``packed`` with ``k`` inert series rows appended (host numpy):
+    all-NaN ``y`` (the packed encoding of an all-masked series — the
+    NaN-fold recovers mask == 0 on device), zeroed time encoding,
+    positive logistic cap.  THE padding rule shared by
+    ``fit_sharded_packed`` and the resident feed, so a shard-count pad
+    can never encode inert rows two different ways."""
+    import numpy as np
+
+    if k <= 0:
+        return packed
+
+    def pad_rows(a, fill):
+        a = np.asarray(a)
+        return np.concatenate(
+            [a, np.full((k,) + a.shape[1:], fill, a.dtype)]
+        )
+
+    return packed._replace(
+        y=pad_rows(packed.y, np.nan),   # all-masked -> inert series
+        # t_inv_span=0, t_off=0 -> reconstructed t == 0 everywhere,
+        # the same inert-row t encoding fit_sharded's zero-padding
+        # produces (a 1.0 fill would make t the raw day offsets).
+        t_off=pad_rows(packed.t_off, 0.0),
+        t_inv_span=pad_rows(packed.t_inv_span, 0.0),
+        s=pad_rows(packed.s, 0.0),
+        cap=pad_rows(packed.cap, 1.0),  # keep logistic cap positive
+        X_reg=pad_rows(packed.X_reg, 0.0),
+        X_reg_bits=pad_rows(packed.X_reg_bits, 0),
+        X_season=(
+            packed.X_season if packed.X_season.ndim == 2
+            else pad_rows(packed.X_season, 0.0)
+        ),
+    )
+
+
+def make_shard_and_gather_fns(mesh: Mesh, specs):
+    """(shard_fns, gather_fns) pytrees from a PartitionSpec pytree.
+
+    ``shard_fns`` place host arrays as sharded device arrays (one
+    ``device_put`` per leaf under its NamedSharding — each device
+    receives only its shard's bytes); ``gather_fns`` pull a sharded
+    leaf back to host numpy.  Apply with ``jax.tree.map(lambda f, x:
+    f(x), fns, tree)``; specs are leaves here (``is_leaf`` on
+    PartitionSpec), matching the SNIPPETS-style rule machinery."""
+    import numpy as np
+
+    def make_shard(spec):
+        sharding = NamedSharding(mesh, spec)
+        return lambda a: jax.device_put(a, sharding)
+
+    def make_gather(_spec):
+        return lambda a: np.asarray(a)
+
+    is_spec = lambda x: isinstance(x, P)
+    return (
+        jax.tree.map(make_shard, specs, is_leaf=is_spec),
+        jax.tree.map(make_gather, specs, is_leaf=is_spec),
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "solver_config", "reg_u8_cols"),
+)
+def fit_resident_core(
+    packed,
+    theta0: jnp.ndarray,
+    config,
+    solver_config,
+    reg_u8_cols: Tuple[int, ...] = (),
+    max_iters_dynamic=None,
+    gn_precond_dynamic=None,
+    use_theta0_dynamic=None,
+):
+    """The mesh-resident fit program (``tsspark_tpu.resident``).
+
+    Computation-follows-data: the caller ``device_put``s ``packed`` and
+    ``theta0`` under the resident partition rules' NamedShardings and
+    GSPMD partitions the program from those input shardings — there is
+    no ``with_sharding_constraint`` here because the traced body must
+    stay EXACTLY ``fit_core_packed``'s (same jaxpr, same traced phase
+    controls), which is what makes per-series results bitwise equal to
+    the file-protocol chunk workers' (the resident/fileproto parity
+    gate).
+
+    Deliberately NOT donated: donating ``theta0`` measurably corrupted
+    results under the resident pipeline's ASYNC overlap — with two
+    waves in flight on the forced-host multi-device CPU backend, the
+    donated-buffer aliasing changed (repeatably, fresh buffers per wave
+    included) the bits of whole shards, while serialized dispatches and
+    undonated pipelined dispatches both stayed bitwise-identical to the
+    single-device program.  The buffer saved is one (B, P) warm start
+    (~200 KB at B=1024); the bitwise-parity gate is worth more.  Do not
+    re-add donation without re-running tests/test_resident.py's parity
+    suite with ``pipeline_depth >= 1`` on the virtual mesh."""
+    from tsspark_tpu.models.prophet.model import fit_core_packed
+
+    return fit_core_packed(
+        packed, theta0, config, solver_config, reg_u8_cols=reg_u8_cols,
+        max_iters_dynamic=max_iters_dynamic,
+        gn_precond_dynamic=gn_precond_dynamic,
+        use_theta0_dynamic=use_theta0_dynamic,
     )
 
 
@@ -289,31 +443,13 @@ def fit_sharded_packed(
     b_pad = pad_to_multiple(b, n_series_shards)
     if b_pad != b:
         k = b_pad - b
-
-        def pad_rows(a, fill):
-            a = np.asarray(a)
-            return np.concatenate(
-                [a, np.full((k,) + a.shape[1:], fill, a.dtype)]
-            )
-
-        packed = packed._replace(
-            y=pad_rows(packed.y, np.nan),   # all-masked -> inert series
-            # t_inv_span=0, t_off=0 -> reconstructed t == 0 everywhere,
-            # the same inert-row t encoding fit_sharded's zero-padding
-            # produces (a 1.0 fill would make t the raw day offsets).
-            t_off=pad_rows(packed.t_off, 0.0),
-            t_inv_span=pad_rows(packed.t_inv_span, 0.0),
-            s=pad_rows(packed.s, 0.0),
-            cap=pad_rows(packed.cap, 1.0),  # keep logistic cap positive
-            X_reg=pad_rows(packed.X_reg, 0.0),
-            X_reg_bits=pad_rows(packed.X_reg_bits, 0),
-            X_season=(
-                packed.X_season if packed.X_season.ndim == 2
-                else pad_rows(packed.X_season, 0.0)
-            ),
-        )
+        packed = pad_packed_rows(packed, k)
         if theta0 is not None:
-            theta0 = pad_rows(theta0, 0.0)
+            theta0 = np.concatenate([
+                np.asarray(theta0),
+                np.zeros((k,) + np.asarray(theta0).shape[1:],
+                         np.asarray(theta0).dtype),
+            ])
 
     pspecs = packed_shardings(mesh, packed, shard_cfg)
     packed = jax.device_put(

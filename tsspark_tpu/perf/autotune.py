@@ -60,13 +60,24 @@ class ChunkAutotuner:
     1024 is the largest that survives the TPU tunnel's crash envelope);
     ``floor`` the smallest worth dispatching.  ``state_path=None`` keeps
     the tuner in-memory (tests, streaming).
+
+    ``multiple``: every size the tuner emits is at least this and (for
+    a power-of-two multiple) divisible by it — the SHARD-WIDTH hook for
+    the mesh-resident path (``tsspark_tpu.resident``), which tunes the
+    per-wave width over an ``n_shards``-device mesh: a wave must divide
+    evenly across the series shards or each dispatch pays inert pad
+    rows on every device.  The ladder stays pow-2 (compiled-program
+    reuse), so a pow-2 ``multiple`` composes exactly; a non-pow-2 one
+    only floors the ladder (the resident feed pads the remainder).
     """
 
     def __init__(self, cap: int, floor: int = 128,
                  state_path: Optional[str] = None,
-                 start: Optional[int] = None):
+                 start: Optional[int] = None,
+                 multiple: int = 1):
         self.cap = max(1, int(cap))
-        self.floor = max(1, min(int(floor), self.cap))
+        self.multiple = max(1, int(multiple))
+        self.floor = max(1, min(max(int(floor), self.multiple), self.cap))
         self.state_path = state_path
         self._samples: Dict[int, List[float]] = {}
         size = self.floor if start is None else int(start)
@@ -76,7 +87,7 @@ class ChunkAutotuner:
 
     @classmethod
     def load(cls, state_path: str, cap: int,
-             floor: int = 128) -> "ChunkAutotuner":
+             floor: int = 128, multiple: int = 1) -> "ChunkAutotuner":
         """Tuner warm-started from a persisted state file (fresh tuner
         when the file is absent/corrupt — the state is pure cache)."""
         start = None
@@ -99,9 +110,10 @@ class ChunkAutotuner:
         except (OSError, ValueError, TypeError, AttributeError):
             pass
         tuner = cls(cap=cap, floor=floor, state_path=state_path,
-                    start=start)
+                    start=start, multiple=multiple)
         tuner._samples = {
-            k: v for k, v in samples.items() if floor <= k <= tuner.cap
+            k: v for k, v in samples.items()
+            if tuner.floor <= k <= tuner.cap
         }
         return tuner
 

@@ -1,0 +1,770 @@
+"""Mesh-resident single-program fit path (``orchestrate --resident``).
+
+The chunk-file protocol (``tsspark_tpu.orchestrate``) is correct and
+crash-safe, but every chunk pays a process spawn, a host->device
+transfer negotiated from scratch, and a prep-file landing.  When a
+``jax.sharding.Mesh`` is available — real accelerator devices, or the
+CPU virtual-device mesh the test/bench harness forces — the whole fleet
+of series can instead run as ONE accelerator-resident program stream:
+
+* **same program, sharded** — every wave dispatches
+  ``parallel.sharding.fit_resident_core``, whose traced body is EXACTLY
+  ``fit_core_packed``'s (the chunk workers' program) with inputs
+  ``device_put`` under the resident partition rules
+  (``resident_partition_rules`` -> ``match_partition_rules`` ->
+  ``make_shard_and_gather_fns``).  Per-series math is shard-local
+  (series-axis partitioning only), so per-series results are BITWISE
+  the file protocol's — ``tests/test_resident.py`` pins it on the
+  virtual 8-device mesh, full run and crash-resume both.
+* **plane-fed** — claims gate on the data plane's landed shard coverage
+  (``data.plane.ready_coverage``) and read the column memmaps directly;
+  there are no per-chunk prep files (the memmap layout IS the prep
+  input, PR 9).
+* **checkpointed through the same protocol** — every wave's result
+  lands through ``save_chunk_atomic`` under the same lease fencing, so
+  resilience, crash-resume, exactly-once coverage, and
+  ``publish_fit_state`` hold unchanged; a killed resident run resumes
+  from its last landed flush exactly like a killed chunk worker
+  (the ``resident-kill`` chaos class drives this).
+* **fallback** — a meshless box (one device, or no JAX runtime) warns
+  ONCE and degrades to the chunk-file protocol automatically
+  (``run_resilient``): the file protocol remains the fault-domain
+  fallback, never a separate code path to keep alive by hand.
+
+Throughput levers carried over from the file protocol: the online
+width autotuner (``perf.ChunkAutotuner``, here tuning the per-wave
+shard width), the adaptive phase-1 depth policy
+(``backends.tpu.tune_phase1_depth`` — ONE definition for both paths),
+and async dispatch (a bounded in-flight pipeline; host prep and flush
+overlap device compute).  Warm-start buffer DONATION was tried and
+reverted: under pipelined overlap it corrupted shard results on the
+forced-host multi-device backend — see ``fit_resident_core``'s
+docstring for the measured evidence before re-adding it.
+
+NOTE (nproc=1 boxes): on the CPU virtual-device mesh the win is the
+removed per-chunk process spawn + JAX re-init + prep-file landing, not
+parallel silicon — read CPU numbers as protocol overhead removed, and
+see docs/PERF.md "Mesh-resident fit".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import warnings
+from collections import deque
+from typing import Callable, Optional
+
+from tsspark_tpu import orchestrate
+from tsspark_tpu.obs import context as obs
+from tsspark_tpu.resilience import faults, integrity
+from tsspark_tpu.utils.atomic import (
+    atomic_write,
+    atomic_write_text,
+    sweep_stale_temps,
+)
+
+#: The resident flush-state artifact: one small JSON replaced atomically
+#: per flush, recording how far the resident program has landed (wave
+#: index, coverage, mesh shape) — the on-disk progress signal an
+#: operator (or the chaos harness) reads without parsing chunk files.
+RESIDENT_STATE_FILE = "resident.json"
+
+#: Minimum series rows per shard for a resident dispatch.  MEASURED, not
+#: aesthetic: at 1 row per shard XLA picks a different reduction
+#: strategy for the per-row time-axis reductions than the single-device
+#: program uses, and the f32 accumulation-order difference diverges
+#: whole trajectories — the bitwise-parity gate caught it on the chaos
+#: profile's width-8 waves over 8 devices.  At >= 2 rows per shard every
+#: tested shape is bitwise the single-device program.  Waves narrower
+#: than ``2 * n_devices`` therefore run on a SUB-mesh
+#: (``_shards_for_width``) instead of padding: padding the batch is not
+#: an option either — the 8-real+8-inert 16-row program computes
+#: different bits for the real rows than the 8-row program (batch width
+#: is not per-row invariant under phase-1 geometry on this backend).
+MIN_ROWS_PER_SHARD = 2
+
+# One-shot flag for the meshless degradation warning: a fleet of calls
+# on a meshless box must not warn per call (same pattern as the
+# resilient-gate warnings in backends/tpu.py).
+_MESHLESS_WARNED = False
+
+
+def force_virtual_host_mesh(n: int = 8) -> None:
+    """Force an ``n``-device virtual CPU mesh via ``XLA_FLAGS``
+    (idempotent; an existing device-count setting wins).  Must run
+    before JAX creates its backend.  THE one definition for every
+    CPU-pinned entry point that needs the mesh — ``bench --resident``,
+    the chaos CLI, the analysis gate — so the harnesses' "virtual
+    8-device mesh" can never silently diverge (tests/conftest.py
+    bootstraps the same flag before the package is importable)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+def usable_mesh(min_devices: int = 2):
+    """A 1-D series mesh over every local device, or None when the
+    runtime cannot host a resident sharded program (fewer than
+    ``min_devices`` devices, or JAX device init fails — e.g. a wedged
+    accelerator runtime).  None means: use the file protocol."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return None
+    if len(devices) < min_devices:
+        return None
+    from tsspark_tpu.parallel import mesh as mesh_mod
+
+    return mesh_mod.make_mesh(
+        n_series_shards=len(devices), n_time_shards=1, devices=devices
+    )
+
+
+def _shards_for_width(width: int, n_devices: int) -> int:
+    """Series-shard count for one resident wave: the largest power of
+    two that divides ``width``, fits the device count, and keeps at
+    least :data:`MIN_ROWS_PER_SHARD` rows on every shard (see that
+    constant for the measured parity rationale)."""
+    k = 1
+    while (k * 2 <= n_devices and width % (k * 2) == 0
+           and width // (k * 2) >= MIN_ROWS_PER_SHARD):
+        k *= 2
+    return k
+
+
+def _write_resident_state(out_dir: str, payload: dict) -> None:
+    """Replace the resident flush-state artifact atomically (a watcher
+    — or a successor run — never parses a torn record)."""
+    atomic_write(
+        os.path.join(out_dir, RESIDENT_STATE_FILE),
+        lambda fh: json.dump(payload, fh), mode="w",
+    )
+
+
+def _times_row(out_dir: str, row: dict) -> None:
+    """One times.jsonl row (same append-only diagnostics log the chunk
+    workers write; readers tolerate a torn last line)."""
+    with open(os.path.join(out_dir, "times.jsonl"), "a") as fh:
+        fh.write(json.dumps(row) + "\n")
+
+
+def run_resident(
+    *,
+    data_dir: str,
+    out_dir: str,
+    series: int,
+    chunk: int = 1024,
+    phase1_iters: int = 12,
+    no_phase1_tune: bool = False,
+    autotune: bool = False,
+    pipeline_depth: int = 2,
+    deadline: Optional[float] = None,
+    reserve: Callable[[], float] = lambda: 10.0,
+    mesh=None,
+    state: Optional[dict] = None,
+    fallback_opts: Optional[dict] = None,
+) -> dict:
+    """Run the whole fit as one mesh-resident sharded program stream.
+
+    Drop-in peer of ``orchestrate.run_resilient`` over the same scratch
+    protocol: ``data_dir`` is a spill dir or plane dataset, ``out_dir``
+    accumulates the same ``chunk_*.npz`` coverage (a run killed at any
+    point resumes from its landed flushes, here or via the file
+    protocol — the two paths' artifacts are interchangeable).  Returns
+    the mutated ``state`` dict with ``complete`` and ``fit_path``
+    (``"resident"``, or ``"fileproto"`` after the meshless fallback).
+
+    ``chunk`` is the claim width (the autotuner's cap with
+    ``autotune=True``); ``pipeline_depth`` bounds in-flight waves —
+    each completed wave is flushed to its chunk file before more than
+    ``pipeline_depth`` dispatches queue, so the on-device -> checkpoint
+    cadence is per wave, not end-of-run.
+
+    ``fallback_opts``: extra ``run_resilient`` keywords for the
+    meshless degradation (probe_budget_s, on_idle, progress_timeout,
+    max_fruitless_retries, ...) — a wedged-accelerator box falls back
+    WITH the caller's probe-budget protections, not the library
+    defaults (bench.py forwards its usual resilience wiring here).
+    """
+    global _MESHLESS_WARNED
+    if state is None:
+        state = {}
+    state.setdefault("retries", 0)
+    mesh = mesh if mesh is not None else usable_mesh()
+    if mesh is None:
+        if not _MESHLESS_WARNED:
+            _MESHLESS_WARNED = True
+            warnings.warn(
+                "run_resident: no usable device mesh on this box (one "
+                "device, or JAX runtime init failed); degrading to the "
+                "chunk-file protocol (orchestrate.run_resilient) — the "
+                "fault-domain fallback.  Results are identical; the "
+                "resident path's per-wave speedup is not.",
+                RuntimeWarning, stacklevel=2,
+            )
+        kwargs = dict(
+            data_dir=data_dir, out_dir=out_dir, series=series, chunk=chunk,
+            min_chunk=min(orchestrate.MIN_CHUNK, chunk), segment=0,
+            phase1_iters=phase1_iters, no_phase1_tune=no_phase1_tune,
+            autotune=autotune, deadline=deadline, reserve=reserve,
+            state=state,
+        )
+        kwargs.update(fallback_opts or {})
+        out = orchestrate.run_resilient(**kwargs)
+        out["fit_path"] = "fileproto"
+        return out
+    # Bounded recovery loop, the resident analog of run_resilient's
+    # respawn loop: a round that ends with coverage incomplete (an
+    # integrity sweep re-queued a torn chunk, a fenced wave discarded
+    # its result, a drained ingest) is re-entered — _resident_body is
+    # fully resumable — as long as it LANDED something; a zero-progress
+    # round means the blocker is external (dead ingest, budget) and
+    # looping would spin.
+    rounds = 0
+    while True:
+        before = tuple(sorted(orchestrate.completed_ranges(out_dir)))
+        rc = _resident_body(
+            data_dir=data_dir, out_dir=out_dir, series=series, chunk=chunk,
+            phase1_iters=phase1_iters, no_phase1_tune=no_phase1_tune,
+            autotune=autotune, pipeline_depth=pipeline_depth,
+            deadline=deadline, reserve=reserve, mesh=mesh, state=state,
+        )
+        complete = (rc == 0 and not orchestrate.missing_ranges(
+            orchestrate.completed_ranges(out_dir), series
+        ) and os.path.exists(os.path.join(out_dir, "phase2_done")))
+        if complete or rc != 0:
+            break  # done, or budget reached (landed coverage persists)
+        rounds += 1
+        state["retries"] = rounds
+        # RANGE-SET change detection, not a count: a round that lands N
+        # waves while its integrity sweep quarantines N torn ranges
+        # keeps the count but changes the set — exactly the round that
+        # must be re-entered to refit the quarantined coverage.
+        changed = tuple(sorted(
+            orchestrate.completed_ranges(out_dir)
+        )) != before
+        if not changed or rounds > 8:
+            break
+    state["fit_path"] = "resident"
+    state["complete"] = complete
+    return state
+
+
+def _resident_body(*, data_dir, out_dir, series, chunk, phase1_iters,
+                   no_phase1_tune, autotune, pipeline_depth, deadline,
+                   reserve, mesh, state) -> int:
+    jax = orchestrate._setup_jax_child()
+    import numpy as np
+
+    from tsspark_tpu.backends.tpu import (
+        difficulty_order,
+        patch_state,
+        phase1_dynamic_args,
+        phase2_dynamic_args,
+        tune_phase1_depth,
+    )
+    from tsspark_tpu.data import plane as data_plane
+    from tsspark_tpu.models.prophet.design import pack_fit_data
+    from tsspark_tpu.models.prophet.model import (
+        ProphetModel,
+        fitstate_from_packed,
+    )
+    from tsspark_tpu.parallel import sharding as sharding_mod
+    from tsspark_tpu.perf import ChunkAutotuner, CompileWatch
+    from tsspark_tpu.resilience.report import STATUS_QUARANTINED
+
+    t_run0 = time.time()
+    os.makedirs(out_dir, exist_ok=True)
+    sweep_stale_temps(out_dir)
+    integrity.sweep_chunks(out_dir)
+    model_config, solver_config = orchestrate.load_run_config(out_dir)
+    ds, d = orchestrate._load_data(data_dir)
+    y, mask, reg = d["y"], d["mask"], d["reg"]
+    cap, floor = d["cap"], d["floor"]
+    model_ = ProphetModel(model_config, solver_config)
+    n_params = model_config.num_params
+    collapse_cap = model_config.growth != "logistic"
+    max_iters = solver_config.max_iters
+    two_phase = 0 < phase1_iters < max_iters
+    series_axis = mesh.axis_names[0]
+    n_shards = int(mesh.shape[series_axis])
+    mesh_devices = list(mesh.devices.ravel())
+    ingest_stall_s = float(os.environ.get("TSSPARK_INGEST_STALL_S", "30"))
+
+    hb_path = os.path.join(out_dir, "heartbeat")
+
+    def heartbeat():
+        atomic_write_text(hb_path, str(time.time()))
+
+    # The u8 indicator split: decide_u8_split is THE shared decision
+    # (landed-coverage gating + self-produce) — a static argument of the
+    # compiled program, so resident and file-protocol runs of the same
+    # data always agree (their bitwise-parity precondition).
+    u8_cols = orchestrate.decide_u8_split(
+        data_dir, reg, series, heartbeat=heartbeat,
+        stall_s=ingest_stall_s,
+    )
+
+    # Shard-width autotuner: the same pow-2 hill climber the chunk
+    # workers use, persisted in the same autotune.json — here the size
+    # is the per-WAVE resident width, floored at the device count
+    # (tuner ``multiple``) so steady-state waves span the full mesh;
+    # narrower widths the ladder still emits run on a sub-mesh
+    # (_shards_for_width) rather than padding.
+    tuner = None
+    if autotune:
+        tuner = ChunkAutotuner.load(
+            os.path.join(out_dir, "autotune.json"),
+            cap=chunk, floor=min(chunk, 128), multiple=n_shards,
+        )
+    compile_watch = CompileWatch((sharding_mod.fit_resident_core,))
+
+    # Sub-mesh ladder: a wave narrower than 2 * n_shards runs on fewer
+    # devices (MIN_ROWS_PER_SHARD — the measured bitwise-parity floor),
+    # never padded.  Meshes/shard-fns are cached per shard count;
+    # partition rules are built per payload shape family (X_season rank
+    # decides the shared-vs-per-series rule, a per-dataset constant).
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    _meshes = {n_shards: mesh}
+    shard_fns_cache: dict = {}
+    _theta_shardings: dict = {}
+
+    def mesh_for(k: int):
+        if k not in _meshes:
+            _meshes[k] = Mesh(
+                np.asarray(mesh_devices[:k]).reshape(k, 1),
+                mesh.axis_names,
+            )
+        return _meshes[k]
+
+    def theta_sharding(k: int):
+        if k not in _theta_shardings:
+            _theta_shardings[k] = NamedSharding(
+                mesh_for(k), P(series_axis, None)
+            )
+        return _theta_shardings[k]
+
+    def shard_payload(packed, k: int):
+        per_series = packed.X_season.ndim == 3
+        key = (k, per_series)
+        if key not in shard_fns_cache:
+            specs = sharding_mod.match_partition_rules(
+                sharding_mod.resident_partition_rules(
+                    series_axis, per_series
+                ),
+                packed,
+            )
+            shard_fns_cache[key] = sharding_mod.make_shard_and_gather_fns(
+                mesh_for(k), specs
+            )[0]
+        return jax.tree.map(
+            lambda f, a: f(a), shard_fns_cache[key], packed
+        )
+
+    _zeros_theta: dict = {}
+
+    def theta_zeros(width: int, k: int):
+        # Host zeros cached per width, placed sharded per wave.  NOT
+        # donated — see fit_resident_core's docstring: donation under
+        # pipelined overlap corrupted shard results on this backend.
+        if width not in _zeros_theta:
+            _zeros_theta[width] = np.zeros((width, n_params), np.float32)
+        return jax.device_put(_zeros_theta[width], theta_sharding(k))
+
+    def prep(lo, hi, width):
+        """Pack rows [lo, hi) padded to ``width`` — the chunk workers'
+        exact prep (shared `_pad_chunk_rows`/`_chunk_mask`), reading the
+        plane memmaps directly: no prep files, no spill copies."""
+        rows = lambda a, fill=0.0: orchestrate._pad_chunk_rows(
+            a, lo, hi, width, fill
+        )
+        y_c = rows(y)
+        data, meta = model_.prepare(
+            ds, y_c,
+            mask=orchestrate._chunk_mask(y_c, mask, lo, hi, width),
+            regressors=rows(reg), cap=rows(cap, fill=1.0),
+            floor=rows(floor), as_numpy=True,
+        )
+        packed, _ = pack_fit_data(data, meta, ds, reg_u8_cols=u8_cols,
+                                  collapse_cap=collapse_cap)
+        return lo, hi, width, hi - lo, packed, meta
+
+    # ---- claims: the chunk-file protocol's plan/lease machinery.
+    # ---- Mirrors fit_worker's next_claim (orchestrate.py) minus the
+    # ---- stolen-span bookkeeping; the claim invariants (plan_chunks
+    # ---- disjointness, lease fencing, ready-coverage gating,
+    # ---- stall-bounded self-produce) are THE SAME — change both. ----
+    claimed: list = []
+    held_leases: set = set()
+    lease_token = f"resident.{os.getpid()}.{int(t_run0 * 1e3)}"
+    claim_spans: dict = {}
+
+    def next_claim(block: bool = True):
+        waited = 0.0
+        while True:
+            width = tuner.next_size() if tuner is not None else chunk
+            ready = data_plane.ready_coverage(data_dir, series)
+            todo = orchestrate.plan_chunks(
+                orchestrate.completed_ranges(out_dir) + claimed,
+                0, series, width,
+            )
+            if ready is not None:
+                todo = [(l2, h2) for l2, h2 in todo
+                        if data_plane.covers(ready, l2, h2)]
+            for lo2, hi2 in todo:
+                claim_sid = obs.new_id() if obs.active() else None
+                if not orchestrate.claim_lease(out_dir, lo2, hi2,
+                                               lease_token,
+                                               span_id=claim_sid):
+                    continue
+                claimed.append((lo2, hi2))
+                held_leases.add((lo2, hi2))
+                if claim_sid is not None:
+                    claim_spans[(lo2, hi2)] = claim_sid
+                    obs.record("chunk.claim", time.time(), 0.0,
+                               span_id=claim_sid, lo=lo2, hi=hi2,
+                               width=width, resident=True)
+                return lo2, hi2, width
+            if ready is None or not data_plane.ingest_pending(
+                data_dir, series
+            ):
+                return None
+            if not block:
+                return None
+            if deadline is not None and \
+                    deadline - time.time() < reserve():
+                # Unlike the file protocol (whose PARENT enforces the
+                # deadline by killing the child), this wait runs in the
+                # caller's process — it must not sleep out an ingest
+                # stall past the reserve.
+                return None
+            heartbeat()
+            time.sleep(0.5)
+            waited += 0.5
+            if waited >= ingest_stall_s:
+                waited = 0.0
+                if not data_plane.produce_next_missing(data_dir):
+                    return None
+
+    # ---- phase 1: pipelined resident waves ---------------------------
+    depth = {"v": phase1_iters if two_phase else max_iters,
+             "tuned": not two_phase or bool(no_phase1_tune)}
+    crash_after = int(os.environ.get("TSSPARK_TEST_CRASH_AFTER", "0"))
+    n_flushed = 0
+    last_flush_t = {"t": t_run0}
+    device_str = str(jax.devices()[0])
+
+    def flush_wave(wave, tune: bool = True) -> Optional[object]:
+        """Block on one in-flight wave and land it through the chunk
+        protocol (the on-device -> checkpoint flush): lease fence ->
+        save_chunk_atomic -> release, plus the same spans/metrics/
+        times.jsonl telemetry the chunk workers emit.
+
+        ``tune=False`` on DRAIN flushes (end-of-run / budget-stop tail):
+        draining back-to-back pops measures milliseconds of
+        flush-to-flush wall for waves that finished long ago, and
+        feeding those phantom ~1000x series/s samples to the autotuner
+        would persist a fake optimum into autotune.json."""
+        nonlocal n_flushed
+        (lo, hi, width, b_real, meta, theta, stats, compiled, t_sub,
+         k_sh) = wave
+        theta = np.asarray(theta)[:b_real]
+        stats = np.asarray(stats)[:, :b_real]
+        heartbeat()
+        state_w = fitstate_from_packed(
+            theta, stats,
+            jax.tree.map(lambda a: np.asarray(a)[:b_real], meta),
+        )
+        now = time.time()
+        wall = max(now - last_flush_t["t"], 1e-9)
+        last_flush_t["t"] = now
+        if not orchestrate.holds_lease(out_dir, lo, hi, lease_token):
+            print(
+                f"[resident] lease on [{lo}, {hi}) lost; discarding this "
+                f"wave's result (fenced)", file=sys.stderr,
+            )
+            obs.event("fenced", lo=lo, hi=hi, resident=True)
+            return None
+        t_save0 = time.time()
+        corrupted = orchestrate.save_chunk_atomic(out_dir, lo, hi, state_w)
+        orchestrate.release_lease(out_dir, lo, hi, lease_token)
+        held_leases.discard((lo, hi))
+        if obs.active():
+            fit_sid = obs.record(
+                "chunk.fit", t_sub, t_save0 - t_sub,
+                parent_id=claim_spans.get((lo, hi)),
+                lo=lo, hi=hi, width=width, live=hi - lo,
+                compile_miss=bool(compiled), resident=True,
+            )
+            obs.record("chunk.land", t_save0, time.time() - t_save0,
+                       parent_id=fit_sid, lo=lo, hi=hi,
+                       **({"corrupted": True} if corrupted else {}))
+            orchestrate._metrics_chunk(hi - lo, wall)
+        if tune and tuner is not None and hi - lo == width:
+            tuner.record(width, hi - lo, wall, compile_miss=compiled)
+        n_flushed += 1
+        done_now = orchestrate.completed_ranges(out_dir)
+        _write_resident_state(out_dir, {
+            "unix": round(time.time(), 3), "wave": n_flushed,
+            "landed": sum(h - l for l, h in done_now),
+            "series": series, "mesh": [n_shards, 1],
+            "width": width, "path": "resident",
+        })
+        _times_row(out_dir, {
+            "lo": lo, "hi": hi, "fit_s": round(wall, 3),
+            "chunk": chunk, "width": width, "live": hi - lo,
+            "series_per_s": round((hi - lo) / wall, 2),
+            "compile_miss": bool(compiled),
+            "t": round(time.time() - t_run0, 2),
+            "device": device_str, "path": "resident",
+            "shards": k_sh,
+        })
+        # Chaos hook: the resident-kill fault class arms this point
+        # (mode "exit" kills the program mid-flush-stream; the next run
+        # resumes from the landed coverage above).
+        faults.inject("resident_flush", lo=lo, hi=hi)
+        if crash_after and n_flushed >= crash_after:
+            os._exit(17)  # simulated mid-run resident death
+        return state_w
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    try:
+        with ThreadPoolExecutor(max_workers=2) as pool:
+            pending: deque = deque()    # prep futures
+            inflight: deque = deque()   # dispatched waves awaiting flush
+
+            def submit_prep(block=False) -> bool:
+                c = next_claim(block=block)
+                if c is None:
+                    return False
+                lo2, hi2, w2 = c
+                pending.append(pool.submit(prep, lo2, hi2, w2))
+                return True
+
+            def dispatch(fut):
+                lo, hi, width, b_real, packed, meta = fut.result()
+                faults.inject("fit_chunk", lo=lo, hi=hi)
+                t_sub = time.time()
+                k = _shards_for_width(width, n_shards)
+                snap = compile_watch.size()
+                sharded = shard_payload(packed, k)
+                theta, stats = sharding_mod.fit_resident_core(
+                    sharded, theta_zeros(width, k), model_config,
+                    solver_config, reg_u8_cols=u8_cols,
+                    **phase1_dynamic_args(depth["v"], False, packed=True),
+                )
+                compiled = compile_watch.size() > snap
+                return (lo, hi, width, b_real, meta, theta, stats,
+                        compiled, t_sub, k)
+
+            for i in range(pipeline_depth + 1):
+                if not submit_prep(block=(i == 0)):
+                    break
+            while pending or inflight:
+                if deadline is not None and \
+                        deadline - time.time() < reserve():
+                    while inflight:
+                        flush_wave(inflight.popleft(), tune=False)
+                    return 1  # budget reached; landed coverage persists
+                if pending:
+                    wave = dispatch(pending.popleft())
+                    submit_prep()
+                    if not depth["tuned"]:
+                        # Depth must settle before wave 1 dispatches, so
+                        # wave 0 flushes inline (same policy point as
+                        # the chunk workers: backends.tpu.
+                        # tune_phase1_depth).
+                        st0 = flush_wave(wave)
+                        if st0 is not None:
+                            frac = float(
+                                (~np.asarray(st0.converged)).mean()
+                            )
+                            depth["v"] = tune_phase1_depth(
+                                depth["v"], frac, max_iters
+                            )
+                        depth["tuned"] = True
+                    else:
+                        inflight.append(wave)
+                    while len(inflight) > pipeline_depth:
+                        flush_wave(inflight.popleft())
+                else:
+                    # Pipeline draining (no prep in flight): these waves
+                    # finished while earlier flushes ran — their
+                    # flush-to-flush wall is not a throughput sample.
+                    flush_wave(inflight.popleft(), tune=False)
+                if not pending and not inflight:
+                    submit_prep(block=True)
+    finally:
+        # Unflushed claims (budget stop, an exception mid-wave) must not
+        # leave LIVE leases behind: this process stays alive, so a
+        # fallback/successor run in the same process would be locked out
+        # until expiry instead of reclaiming immediately.
+        for lo_h, hi_h in sorted(held_leases):
+            orchestrate.release_lease(out_dir, lo_h, hi_h, lease_token)
+        held_leases.clear()
+
+    # ---- phase 2: compacted stragglers through the same resident
+    # ---- program (host gather off the memmaps, sharded dispatch).
+    # ---- Mirrors the chunk workers' "host" phase-2 branch
+    # ---- (orchestrate._fit_worker_body) — the bitwise-parity tests
+    # ---- pin the two; change the gather/pad/patch logic in BOTH. ----
+    marker = os.path.join(out_dir, "phase2_done")
+    if integrity.sweep_chunks(out_dir):
+        return 0  # corrupt ranges re-queued; the caller's rescan refits
+    done = orchestrate.completed_ranges(out_dir)
+    if orchestrate.missing_ranges(done, series):
+        return 0
+    if not two_phase:
+        if not os.path.exists(marker):
+            atomic_write_text(marker, "ok\n")
+            obs.record("phase2.done", time.time(), 0.0)
+        return 0
+    if os.path.exists(marker):
+        return 0
+
+    t_p2 = time.time()
+    straggler_idx, straggler_theta, straggler_gn = [], [], []
+    files = {}
+    for lo, hi in done:
+        z = dict(np.load(orchestrate._chunk_path(out_dir, lo, hi)))
+        files[(lo, hi)] = z
+        if z.get("phase2") is not None:
+            continue
+        bad = np.flatnonzero(
+            ~z["converged"] & (z["status"] != STATUS_QUARANTINED)
+        )
+        straggler_idx.extend(int(lo + i) for i in bad)
+        straggler_theta.append(z["theta"][bad])
+        straggler_gn.append(z["grad_norm"][bad])
+    if straggler_idx:
+        heartbeat()
+        idx = np.asarray(straggler_idx)
+        order = difficulty_order(np.concatenate(straggler_gn))
+        idx = idx[order]
+        theta_cat = np.concatenate(straggler_theta, axis=0)[order]
+        n_s = len(straggler_idx)
+        p2_chunk = tuner.best_size if tuner is not None else chunk
+        pad = (-n_s) % p2_chunk
+        pad_rows = lambda a: np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)]
+        ) if pad else a
+        g = lambda a: None if a is None else pad_rows(
+            np.ascontiguousarray(a[idx], np.float32)
+        )
+        y_s = g(y)
+        if mask is not None:
+            m_s = g(mask)
+        else:
+            m_s = np.zeros_like(y_s)
+            m_s[:idx.size] = np.isfinite(y_s[:idx.size])
+        r_s, c_s, f_s = g(reg), g(cap), g(floor)
+        init_s = pad_rows(theta_cat.astype(np.float32))
+        k2 = _shards_for_width(p2_chunk, n_shards)
+        subs = []
+        for lo2 in range(0, n_s + pad, p2_chunk):
+            hi2 = lo2 + p2_chunk
+            sl = lambda a: None if a is None else a[lo2:hi2]
+            data2, meta2 = model_.prepare(
+                ds, y_s[lo2:hi2], mask=sl(m_s), regressors=sl(r_s),
+                cap=sl(c_s), floor=sl(f_s), as_numpy=True,
+            )
+            packed2, _ = pack_fit_data(
+                data2, meta2, ds, reg_u8_cols=u8_cols,
+                collapse_cap=collapse_cap,
+            )
+            init2 = np.asarray(init_s[lo2:hi2], np.float32)
+            th2, st2 = sharding_mod.fit_resident_core(
+                shard_payload(packed2, k2),
+                jax.device_put(init2, theta_sharding(k2)),
+                model_config, solver_config, reg_u8_cols=u8_cols,
+                **phase2_dynamic_args(solver_config, packed=True),
+            )
+            jax.block_until_ready(th2)
+            heartbeat()
+            subs.append(fitstate_from_packed(
+                np.asarray(th2), np.asarray(st2), meta2,
+            ))
+        state2 = jax.tree.map(
+            lambda *xs: np.concatenate(xs, axis=0)[:n_s], *subs
+        )
+        for (lo, hi), z in files.items():
+            if z.get("phase2") is not None:
+                continue
+            in_chunk = np.flatnonzero((idx >= lo) & (idx < hi))
+            local = idx[in_chunk] - lo
+            chunk_state = orchestrate._state_from_chunk(z)
+            sub = jax.tree.map(
+                lambda a: np.asarray(a)[in_chunk], state2
+            )
+            patched = patch_state(chunk_state, local, sub)
+            t_patch0 = time.time()
+            corrupted = orchestrate.save_chunk_atomic(
+                out_dir, lo, hi, patched,
+                extra_arrays={"phase2": np.asarray(1)},
+            )
+            obs.record("chunk.land", t_patch0, time.time() - t_patch0,
+                       lo=lo, hi=hi, phase2=True,
+                       **({"corrupted": True} if corrupted else {}))
+    _times_row(out_dir, {
+        "phase2_s": round(time.time() - t_p2, 3),
+        "stragglers": len(straggler_idx),
+        "phase2_mode": "resident-sharded",
+    })
+    atomic_write_text(marker, "ok\n")
+    obs.record("fit.phase2", t_p2, time.time() - t_p2,
+               stragglers=len(straggler_idx), mode="resident-sharded")
+    obs.record("phase2.done", time.time(), 0.0)
+    return 0
+
+
+def resident_worker(args) -> int:
+    """Child entry point (``python -m tsspark_tpu.orchestrate
+    --_resident``): the resident run as a fault-isolatable process the
+    chaos harness can kill mid-flush.  Adopts the spawner's trace like
+    the chunk workers; a meshless child degrades to the in-process
+    chunk-worker body (NOT a fresh subprocess tree — this IS the
+    worker)."""
+    obs.adopt_env()
+    t0 = time.time()
+    wspan = obs.open_span("resident.worker", make_current=True,
+                          series=args.series, chunk=args.chunk)
+    try:
+        mesh = usable_mesh()
+        if mesh is None:
+            # Degrade to the chunk-worker body in THIS process (same
+            # coverage protocol; the spawner's watchdog keeps working).
+            args.hi = args.hi or args.series
+            rc = orchestrate.fit_worker(args)
+        else:
+            st = run_resident(
+                data_dir=args.data, out_dir=args.out, series=args.series,
+                chunk=args.chunk, phase1_iters=args.phase1_iters,
+                no_phase1_tune=args.no_phase1_tune,
+                autotune=getattr(args, "autotune", False), mesh=mesh,
+            )
+            rc = 0 if st.get("complete") else 1
+    except BaseException:
+        obs.close_span(wspan, "resident.worker", t0, status="err")
+        raise
+    obs.close_span(wspan, "resident.worker", t0, rc=rc)
+    if obs.active():
+        from tsspark_tpu.obs.metrics import DEFAULT
+
+        try:
+            DEFAULT.export(
+                os.path.join(args.out,
+                             f"metrics_resident_{os.getpid()}.json"),
+                trace_id=obs.trace_id(),
+            )
+        except OSError:
+            pass
+    return rc

@@ -96,13 +96,20 @@ class Snapshot:
     @classmethod
     def build(cls, version: int, state: FitState, series_ids,
               step: Optional[np.ndarray]) -> "Snapshot":
-        ids = tuple(str(s) for s in series_ids)
+        # C-level id normalization + C-iterated dict build: this runs on
+        # every snapshot load, and the former per-series Python passes
+        # (`str(s) for s in ids`, an enumerate dict comprehension) were
+        # the registry's O(n_series) interpreter cost at million-series
+        # scale (ROADMAP item 2; micro-benched in tests/test_resident.py).
+        from tsspark_tpu.orchestrate import normalize_series_ids
+
+        ids = tuple(normalize_series_ids(series_ids).tolist())
         n = len(ids)
         if step is None:
             step = np.ones(n)
         step = np.where(np.asarray(step, np.float64) > 0, step, 1.0)
         return cls(version=version, state=state, series_ids=ids,
-                   step=step, row_of={s: i for i, s in enumerate(ids)})
+                   step=step, row_of=dict(zip(ids, range(n))))
 
     def rows(self, series_ids) -> Tuple[np.ndarray, List[str]]:
         """Row indices for ``series_ids`` + the ids this version lacks."""
@@ -267,7 +274,9 @@ class ParamRegistry:
         version number.  Concurrent publishers serialize on the
         manifest lock (``_locked``)."""
         t_pub0 = time.time()
-        ids = np.asarray([str(s) for s in series_ids])
+        from tsspark_tpu.orchestrate import normalize_series_ids
+
+        ids = normalize_series_ids(series_ids)
         if len(ids) != int(np.asarray(state.theta).shape[0]):
             raise ValueError(
                 f"{len(ids)} series ids for "
