@@ -460,6 +460,15 @@ def main() -> None:
                          "per-chunk process spawn or prep files; falls "
                          "back to the chunk-file protocol on a meshless "
                          "box (docs/PERF.md \"Mesh-resident fit\")")
+    ap.add_argument("--scale", default=None, metavar="RUNG",
+                    help="run the million-series scale ladder instead "
+                         "of the M5 fit benchmark: one rung "
+                         "('smoke'/'30k'/'100k'/'1m') or 'ladder' for "
+                         "30k -> 100k -> 1m — ingest -> resident fit "
+                         "-> mmap-snapshot publish -> pool serve "
+                         "against one data plane, emitting "
+                         "SCALE_*.json (docs/SERVING.md, 'Snapshot "
+                         "plane & memory model')")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for a quick pipeline check")
     ap.add_argument("--keep", action="store_true",
@@ -472,6 +481,22 @@ def main() -> None:
     if args.profile:
         profile_main(args)
         return
+    if args.scale:
+        # The ladder needs the virtual host mesh for the resident fit
+        # path, same forcing as --resident (before anything imports
+        # jax).
+        from tsspark_tpu.resident import force_virtual_host_mesh
+
+        force_virtual_host_mesh()
+        from tsspark_tpu import bench_scale
+
+        if args.scale == "ladder":
+            reports = bench_scale.run_ladder()
+        else:
+            reports = [bench_scale.run_rung(args.scale)]
+        sys.exit(0 if all(r.get("complete")
+                          and r.get("sentinel_ok", True)
+                          for r in reports) else 1)
     if args.smoke:
         args.series, args.days, args.chunk = 512, 256, 512
     if args.resident:

@@ -248,17 +248,21 @@ def test_registry_corrupt_active_snapshot_falls_back(tmp_path, fitted):
     reg = _registry(tmp_path, fitted)  # v1 active
     v2 = reg.publish(state._replace(theta=state.theta * 1.01), ids)
     assert reg.active_version() == v2
-    # Silent corruption: flip bytes at several offsets in the active
-    # snapshot (same spread as faults.corrupt_file — a single flip can
-    # land entirely inside npz alignment padding no loader parses).
-    path = os.path.join(reg.root, f"v{v2:06d}", "state.npz")
-    size = os.path.getsize(path)
-    with open(path, "r+b") as fh:
-        for k in range(1, 8):
-            fh.seek(size * k // 8)
-            chunk = fh.read(16)
-            fh.seek(size * k // 8)
-            fh.write(bytes(b ^ 0xFF for b in chunk))
+    # Silent corruption of BOTH snapshot representations (the mmap
+    # column plane is the preferred format and the npz its per-version
+    # archival fallback — only when both are torn does the registry
+    # degrade to an older version): flip bytes at several offsets (same
+    # spread as faults.corrupt_file — a single flip can land entirely
+    # inside npz alignment padding no loader parses).
+    for name in ("state.npz", "snapcol_theta.npy"):
+        path = os.path.join(reg.root, f"v{v2:06d}", name)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as fh:
+            for k in range(1, 8):
+                fh.seek(size * k // 8)
+                chunk = fh.read(16)
+                fh.seek(size * k // 8)
+                fh.write(bytes(b ^ 0xFF for b in chunk))
 
     with pytest.warns(RuntimeWarning, match="last good"):
         snap = reg.load()
@@ -453,7 +457,8 @@ def test_orchestrate_publish_fit_state(tmp_path, fitted):
     reg = ParamRegistry(str(tmp_path / "registry"), CFG)
     assert orchestrate.publish_fit_state(reg, out, ids) == 1
     snap = reg.load()
-    assert snap.series_ids == tuple(ids)
+    # The mmap snapshot exposes ids as an array view, not a tuple.
+    assert list(snap.series_ids) == list(ids)
     np.testing.assert_allclose(
         np.asarray(snap.state.theta), np.asarray(state.theta), rtol=1e-6
     )

@@ -1,0 +1,355 @@
+"""Snapshot plane (serve/snapplane.py + registry mmap path,
+docs/SERVING.md "Snapshot plane & memory model"): bitwise parity of
+predictions served from an mmap snapshot vs the same version's npz —
+direct engine AND through the replica pool, through a version flip and
+a registry fallback — plus torn-shard sentinel rejection, the bounded
+forecast cache's eviction accounting, the analysis gate's bytecode
+hygiene checker, and the tier-1 scale-ladder smoke rung."""
+
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.serve import (
+    ForecastCache,
+    ParamRegistry,
+    PredictionEngine,
+    RegistryError,
+)
+from tsspark_tpu.serve import snapplane
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+)
+SOLVER = SolverConfig(max_iters=25)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(3)
+    t = np.arange(140.0)
+    y = (12 + 0.03 * t[None, :] + np.sin(2 * np.pi * t[None, :] / 7)
+         + rng.normal(0, 0.1, (6, 140)))
+    backend = get_backend("tpu", CFG, SOLVER)
+    state = backend.fit(t, jnp.asarray(y))
+    return backend, state, [f"s{i}" for i in range(6)]
+
+
+def _registry(tmp_path, fitted, name="registry", **kwargs):
+    backend, state, ids = fitted
+    reg = ParamRegistry(str(tmp_path / name), CFG, **kwargs)
+    reg.publish(state, ids, step=np.ones(len(ids)))
+    return reg
+
+
+def _tear(path):
+    """Byte-flip several offsets of one file (same spread as
+    faults.corrupt_file)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        for k in range(1, 8):
+            fh.seek(size * k // 8)
+            chunk = fh.read(16)
+            fh.seek(size * k // 8)
+            fh.write(bytes(b ^ 0xFF for b in chunk))
+
+
+# ---------------------------------------------------------------------------
+# plane write/attach mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_publish_lands_plane_and_npz(tmp_path, fitted):
+    reg = _registry(tmp_path, fitted)
+    vdir = os.path.join(reg.root, "v000001")
+    names = set(os.listdir(vdir))
+    assert {"snap_spec.json", "snapok.json", "state.npz",
+            "state.json"} <= names
+    assert {"snapcol_theta.npy", "snapcol_ids.npy",
+            "snapcol_ids_sorted.npy", "snapcol_id_order.npy",
+            "snapcol_extra_step.npy"} <= names
+    assert snapplane.verify_plane(vdir)
+    assert snapplane.snapshot_nbytes(vdir) > 0
+    # The manifest records which formats landed.
+    m = reg._read_manifest()
+    assert m["versions"]["1"]["formats"] == ["mmap", "npz"]
+
+
+def test_mmap_rows_match_dict_lookup(tmp_path, fitted):
+    """The vectorized searchsorted lookup is semantically identical to
+    the npz path's dict: order preserved, duplicates kept, unknown ids
+    reported, empty query tolerated."""
+    reg = _registry(tmp_path, fitted)
+    mm = reg.load()
+    npz = ParamRegistry(reg.root, CFG, snapshot_format="npz").load()
+    assert mm.source == "mmap" and npz.source == "npz"
+    for query in (["s3", "s1", "s1", "s5"], ["nope"], ["s0", "zzz"],
+                  []):
+        i_mm, miss_mm = mm.rows(query)
+        i_npz, miss_npz = npz.rows(query)
+        assert i_mm.tolist() == i_npz.tolist()
+        assert miss_mm == miss_npz
+
+
+def test_torn_plane_shard_rejected_then_npz_archival_fallback(
+        tmp_path, fitted):
+    """A torn plane shard must be rejected by the CRC sentinel; with
+    the SAME version's archival npz intact, the registry degrades to it
+    (one warning) — not to an older version."""
+    reg = _registry(tmp_path, fitted)
+    vdir = os.path.join(reg.root, "v000001")
+    _tear(os.path.join(vdir, "snapcol_theta.npy"))
+    assert not snapplane.verify_plane(vdir)
+    with pytest.raises(snapplane.SnapshotPlaneError):
+        snapplane.attach(vdir)
+    with pytest.warns(RuntimeWarning, match="archival npz"):
+        snap = reg.load()
+    assert snap.source == "npz" and snap.version == 1
+    assert snap.fallback_from is None  # same version, different format
+
+
+def test_torn_plane_only_version_falls_back_to_previous(tmp_path,
+                                                        fitted):
+    """A plane-ONLY version (no npz) with a torn shard must degrade
+    down the active->previous chain, exactly like a corrupt npz."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)  # v1, both formats
+    v2 = reg.publish(state._replace(theta=state.theta * 1.01), ids,
+                     snapshot_format="mmap")
+    _tear(os.path.join(reg.root, f"v{v2:06d}", "snapcol_theta.npy"))
+    with pytest.warns(RuntimeWarning, match="last good"):
+        snap = reg.load()
+    assert snap.version == 1 and snap.fallback_from == v2
+    with pytest.raises(RegistryError) as e:
+        reg.load(v2)
+    assert e.value.reason == "corrupt-snapshot"
+
+
+# ---------------------------------------------------------------------------
+# bitwise parity: engine, flip, fallback
+# ---------------------------------------------------------------------------
+
+
+def _forecast_values(engine, sids, horizon):
+    res = engine.forecast(sids, horizon)
+    return res.version, np.asarray(res.ds), {
+        k: np.asarray(v) for k, v in res.values.items()
+    }
+
+
+def _assert_bitwise(a, b):
+    va, dsa, vala = a
+    vb, dsb, valb = b
+    assert va == vb
+    assert np.array_equal(dsa, dsb)
+    assert set(vala) == set(valb)
+    for k in vala:
+        assert np.array_equal(vala[k], valb[k]), k
+
+
+def test_engine_predictions_bitwise_equal_across_formats(tmp_path,
+                                                         fitted):
+    """One registry, two engines — one on the mmap plane, one pinned to
+    the npz — must serve bit-identical forecasts, including after a
+    version flip and under a registry fallback."""
+    backend, state, ids = fitted
+    reg_mm = _registry(tmp_path, fitted)
+    reg_npz = ParamRegistry(reg_mm.root, CFG, snapshot_format="npz")
+    eng_mm = PredictionEngine(reg_mm, cache=ForecastCache(64))
+    eng_npz = PredictionEngine(reg_npz, cache=ForecastCache(64))
+    assert eng_mm.refresh().source == "mmap"
+    assert eng_npz.refresh().source == "npz"
+    for sids, h in ((["s0"], 7), (["s4", "s2", "s0"], 12),
+                    (["s5", "s5"], 3)):
+        _assert_bitwise(_forecast_values(eng_mm, sids, h),
+                        _forecast_values(eng_npz, sids, h))
+
+    # Through a version flip (each engine refreshes independently).
+    v2 = reg_mm.publish(state._replace(theta=state.theta * 1.02), ids,
+                        step=np.ones(len(ids)))
+    a = _forecast_values(eng_mm, ["s1", "s3"], 9)
+    b = _forecast_values(eng_npz, ["s1", "s3"], 9)
+    assert a[0] == v2
+    _assert_bitwise(a, b)
+
+    # Through a registry fallback: v2 torn in BOTH formats -> both
+    # engines degrade to v1 and still agree bit for bit.
+    for name in ("state.npz", "snapcol_theta.npy"):
+        _tear(os.path.join(reg_mm.root, f"v{v2:06d}", name))
+    with pytest.warns(RuntimeWarning, match="last good"):
+        assert eng_mm.ensure_version(1)
+    with pytest.warns(RuntimeWarning, match="last good"):
+        assert eng_npz.ensure_version(1)
+    a = _forecast_values(eng_mm, ["s2", "s0"], 7)
+    b = _forecast_values(eng_npz, ["s2", "s0"], 7)
+    assert a[0] == 1
+    _assert_bitwise(a, b)
+
+
+def test_pool_predictions_bitwise_equal_across_formats(tmp_path,
+                                                       fitted,
+                                                       monkeypatch):
+    """The parity contract THROUGH the replica pool: one pool of
+    replicas attached to the mmap plane, one env-pinned to the npz
+    format — responses (including through a pool-materialized version
+    flip) are bit-identical."""
+    from tsspark_tpu.serve.pool import ReplicaPool
+
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    v2 = reg.publish(state._replace(theta=state.theta * 1.01), ids,
+                     step=np.ones(len(ids)), activate=False)
+
+    def collect(pool, version):
+        out = []
+        for sids, h in ((["s0"], 7), (["s3", "s1"], 9)):
+            resp = pool.forecast(sids, h)
+            assert resp.get("ok") and resp["version"] == version, resp
+            out.append({k: resp[k] for k in
+                        ("ds", "yhat", "series_ids", "version")
+                        if k in resp})
+        return out
+
+    monkeypatch.delenv("TSSPARK_SNAPSHOT_FORMAT", raising=False)
+    pool = ReplicaPool(str(tmp_path / "pool_mm"), reg.root,
+                       n_replicas=1)
+    pool.start()
+    try:
+        got_mm_v1 = collect(pool, 1)
+        pool.activate(v2, hot_series=ids[:2], horizons=(7, 9))
+        got_mm_v2 = collect(pool, v2)
+    finally:
+        pool.stop()
+
+    reg.activate(1)  # reset the active pointer for the npz pool
+    monkeypatch.setenv("TSSPARK_SNAPSHOT_FORMAT", "npz")
+    pool = ReplicaPool(str(tmp_path / "pool_npz"), reg.root,
+                       n_replicas=1)
+    pool.start()
+    try:
+        got_npz_v1 = collect(pool, 1)
+        pool.activate(v2, hot_series=ids[:2], horizons=(7, 9))
+        got_npz_v2 = collect(pool, v2)
+    finally:
+        pool.stop()
+    assert got_mm_v1 == got_npz_v1
+    assert got_mm_v2 == got_npz_v2
+
+
+# ---------------------------------------------------------------------------
+# bounded forecast cache
+# ---------------------------------------------------------------------------
+
+
+def test_cache_lru_eviction_counted():
+    cache = ForecastCache(capacity=3)
+    for i in range(5):
+        cache.put((1, f"s{i}", 8, 0, 0), {"yhat": np.zeros(8)})
+    assert len(cache) == 3
+    assert cache.evicted == 2
+    assert cache.stats()["evicted"] == 2
+    # LRU order: oldest two went first.
+    assert cache.peek((1, "s0", 8, 0, 0)) is None
+    assert cache.peek((1, "s4", 8, 0, 0)) is not None
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+    text = METRICS.to_prometheus()
+    assert "tsspark_serve_cache_evicted" in text
+
+
+def test_cache_capacity_from_env(monkeypatch):
+    monkeypatch.setenv("TSSPARK_SERVE_CACHE_CAPACITY", "17")
+    assert ForecastCache().capacity == 17
+    assert ForecastCache(capacity=5).capacity == 5
+    monkeypatch.delenv("TSSPARK_SERVE_CACHE_CAPACITY")
+    from tsspark_tpu.serve.cache import FALLBACK_CAPACITY
+
+    assert ForecastCache().capacity == FALLBACK_CAPACITY
+
+
+# ---------------------------------------------------------------------------
+# hygiene checker (committed bytecode)
+# ---------------------------------------------------------------------------
+
+
+def test_hygiene_flags_committed_bytecode(tmp_path):
+    from tsspark_tpu.analysis import hygiene
+
+    (tmp_path / ".gitignore").write_text("__pycache__/\n*.pyc\n")
+    clean = hygiene.check_hygiene(
+        str(tmp_path), tracked=["tsspark_tpu/serve/engine.py"]
+    )
+    assert clean == []
+    dirty = hygiene.check_hygiene(str(tmp_path), tracked=[
+        "tsspark_tpu/serve/engine.py",
+        "__pycache__/bench.cpython-310.pyc",
+        "tsspark_tpu/__pycache__/config.cpython-310.pyc",
+        "tsspark_tpu/native/blob.pyo",
+    ])
+    assert sorted(f.rule for f in dirty) == ["committed-bytecode"] * 3
+    # The gitignore coverage check.
+    (tmp_path / ".gitignore").write_text("*.log\n")
+    gap = hygiene.check_hygiene(str(tmp_path), tracked=[])
+    assert [f.rule for f in gap] == ["gitignore-gap"]
+
+
+def test_repo_has_no_tracked_bytecode_and_ignores_pycache():
+    """The live gate over THIS checkout: no bytecode in the index, and
+    the root .gitignore keeps covering __pycache__/ (root dir
+    included)."""
+    from tsspark_tpu.analysis import hygiene
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert hygiene.check_hygiene(root) == []
+
+
+# ---------------------------------------------------------------------------
+# scale ladder: the tier-1 smoke rung
+# ---------------------------------------------------------------------------
+
+
+def test_scale_smoke_rung_in_process(tmp_path, monkeypatch):
+    """The in-process smoke rung of ``bench --scale``: ingest -> fit
+    (resident path; the test mesh is the conftest's 8 virtual devices)
+    -> mmap publish -> engine serve with a mid-run flip — wired through
+    the regression sentinel so ladder metrics accrue baselines under
+    the scale-scoped workload key."""
+    from tsspark_tpu import bench_scale
+    from tsspark_tpu.obs import history
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("TSSPARK_DATA_ROOT", str(tmp_path / "plane"))
+    rep = bench_scale.run_rung(
+        "smoke", scratch_root=str(tmp_path / "scratch"),
+        sentinel=True,
+    )
+    assert rep["complete"], rep
+    assert rep["fit"]["fit_path"] == "resident"
+    assert rep["publish"]["snapshot_mb"] > 0
+    serve = rep["serve"]
+    assert serve["outcomes"]["failed"] == 0
+    assert serve["flip"]["version"] == 2
+    assert serve["time_to_first_request_s"] is not None
+    assert os.path.exists(rep["path"])
+    # The sentinel ingested the rung under its scale-scoped workload
+    # key — the namespace discipline that keeps 1M rows from ever
+    # baselining against smoke rows.
+    rows = history.read_history(str(tmp_path / "RUNHISTORY.jsonl"))
+    srows = [r for r in rows if r["kind"] == "scale"]
+    assert len(srows) == 1
+    assert srows[0]["workload"] == "scale_smoke"
+    m = srows[0]["metrics"]
+    assert m["agg_requests_per_s"] > 0
+    assert m["rss_mb_per_replica"] > 0
+    assert rep.get("sentinel_ok", True)
+    # Re-ingesting the same report is a no-op (idempotent by trace id).
+    row, appended = history.ingest(
+        json.load(open(rep["path"])),
+        str(tmp_path / "RUNHISTORY.jsonl"),
+    )
+    assert row is not None and not appended

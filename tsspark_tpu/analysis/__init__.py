@@ -10,6 +10,9 @@ Three checkers, all device-free:
 * ``fileproto``  — static model of the orchestrator/streaming/
   checkpoint artifact lifecycle: atomic-write enforcement plus a
   small-model check that range claims can never overlap.
+* ``hygiene``    — repo hygiene: no committed bytecode
+  (``__pycache__``/``.pyc`` in the git index) and the root
+  ``.gitignore`` keeps covering interpreter-generated dirs.
 
 Run locally with ``python -m tsspark_tpu.analysis``; the same pass runs
 as a default-on tier-1 test (``tests/test_analysis.py``), so a PR that
@@ -53,11 +56,17 @@ class AnalysisReport:
 def run_all(
     root: Optional[str] = None,
     settings: Optional[AnalysisSettings] = None,
-    checkers: Tuple[str, ...] = ("trace", "contracts", "fileproto"),
+    checkers: Tuple[str, ...] = ("trace", "contracts", "fileproto",
+                                 "hygiene"),
 ) -> AnalysisReport:
     """The full pass over the repo at ``root`` (default: the installed
     package's parent)."""
-    from tsspark_tpu.analysis import contracts, fileproto, tracelint
+    from tsspark_tpu.analysis import (
+        contracts,
+        fileproto,
+        hygiene,
+        tracelint,
+    )
 
     root = root or repo_root()
     settings = settings or load_settings(root)
@@ -75,6 +84,10 @@ def run_all(
     if "fileproto" in checkers:
         found = fileproto.check_fileproto(root)
         counts.append(("fileproto", len(found)))
+        raw += found
+    if "hygiene" in checkers:
+        found = hygiene.check_hygiene(root)
+        counts.append(("hygiene", len(found)))
         raw += found
     kept, suppressed = apply_suppressions(tuple(raw), settings)
     return AnalysisReport(kept, suppressed, tuple(counts))

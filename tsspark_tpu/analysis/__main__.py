@@ -29,7 +29,8 @@ def main(argv=None) -> int:
         description="JAX/TPU-aware static analysis (docs/ANALYSIS.md)",
     )
     ap.add_argument(
-        "--checker", choices=("trace", "contracts", "fileproto"),
+        "--checker",
+        choices=("trace", "contracts", "fileproto", "hygiene"),
         action="append",
         help="run only this checker (repeatable; default: all)",
     )
@@ -51,7 +52,7 @@ def main(argv=None) -> int:
     report = analysis.run_all(
         root=args.root,
         checkers=tuple(args.checker) if args.checker
-        else ("trace", "contracts", "fileproto"),
+        else ("trace", "contracts", "fileproto", "hygiene"),
     )
     for f in report.findings:
         print(f)

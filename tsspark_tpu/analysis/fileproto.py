@@ -250,6 +250,28 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "pure diagnostics folded into BENCH extras",
     ),
     ArtifactSpec(
+        "snapshot-plane", ("snapcol_", "snap_spec.json", "snapok.json"),
+        ("write_plane",),
+        "mmap snapshot column plane (serve/snapplane.py): spec first, "
+        "one atomic .npy per FitState column + the id->row index, the "
+        "per-shard CRC sentinel LAST — the unit of visibility, exactly "
+        "the data plane's protocol.  The version dir is "
+        "publisher-private until the registry manifest references it, "
+        "so a publisher killed mid-plane leaves an orphan dir the "
+        "version allocator skips; readers attach mmap and REJECT any "
+        "plane whose sentinel CRCs mismatch (fallback: the archival "
+        "npz, then the active->previous chain)",
+    ),
+    ArtifactSpec(
+        "scale-report", ("SCALE_",),
+        ("_write_scale_report",),
+        "scale-ladder rung report (tsspark_tpu.bench_scale): ingest/"
+        "fit/publish/serve timings + sharing-aware RSS accounting, "
+        "written once at rung end, atomic so a watcher never parses a "
+        "partial JSON; ingested into RUNHISTORY under scale_<rung> "
+        "workload keys",
+    ),
+    ArtifactSpec(
         "registry-manifest", ("manifest.json",),
         ("ParamRegistry._write_manifest",),
         "versioned serve-registry index (serve/registry.py), replaced "
@@ -345,11 +367,13 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/perf/autotune.py",
     "tsspark_tpu/perf/recorder.py",
     "tsspark_tpu/serve/registry.py",
+    "tsspark_tpu/serve/snapplane.py",
     "tsspark_tpu/serve/engine.py",
     "tsspark_tpu/serve/cache.py",
     "tsspark_tpu/serve/pool.py",
     "tsspark_tpu/serve/replica.py",
     "tsspark_tpu/serve/__main__.py",
+    "tsspark_tpu/bench_scale.py",
     "tsspark_tpu/chaos/storm.py",
     "tsspark_tpu/chaos/harness.py",
     "tsspark_tpu/chaos/invariants.py",

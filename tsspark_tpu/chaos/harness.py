@@ -922,11 +922,15 @@ def run_storm(seed: int = 0, profile: str = "full",
             else:
                 v1 = registry.publish(got_state, ids,
                                       step=np.ones(prof.series))
+            # v2 is published npz-only so the legacy registry-corrupt
+            # class keeps its meaning (the ARCHIVAL format is the torn
+            # artifact; an intact plane would legitimately serve v2).
             v2 = registry.publish(
                 got_state._replace(
                     theta=np.asarray(got_state.theta) * 1.01
                 ),
                 ids, step=np.ones(prof.series),
+                snapshot_format="npz",
             )
             snap_path = os.path.join(
                 registry.root, f"v{v2:06d}", "state.npz"
@@ -949,6 +953,58 @@ def run_storm(seed: int = 0, profile: str = "full",
         }
         stages["registry"] = {"v1": v1, "v2_corrupt": v2,
                               "fallback_served": fb_snap.version}
+
+        # ---- snapshot-torn-shard: tear the ACTIVE version's mmap
+        # ---- plane under its CRC sentinel, mid-flip ------------------
+        torn_inj = storm.direct("snapshot-torn-shard")
+        if torn_inj is not None:
+            from tsspark_tpu.serve import snapplane
+
+            with obs.span("stage.snapplane"):
+                # A plane-ONLY version (no archival npz): the fallback
+                # chain, not the same-version npz, must absorb the tear.
+                v3 = registry.publish(
+                    got_state._replace(
+                        theta=np.asarray(got_state.theta) * 1.03
+                    ),
+                    ids, step=np.ones(prof.series),
+                    snapshot_format="mmap",
+                )
+                v3_dir = os.path.join(registry.root, f"v{v3:06d}")
+                obs.event("fault", tag="snapshot-torn-shard",
+                          mode="direct", version=v3)
+                t_torn = time.time()
+                mm = np.lib.format.open_memmap(
+                    os.path.join(v3_dir, "snapcol_theta.npy"),
+                    mode="r+",
+                )
+                row = (torn_inj.series or 0) % mm.shape[0]
+                mm[row:row + 1].view(np.uint32)[...] ^= \
+                    np.uint32(0x5A5A5A5A)
+                mm.flush()
+                del mm
+                torn_rejected = not snapplane.verify_plane(v3_dir)
+                with _warnings.catch_warnings():
+                    _warnings.simplefilter("ignore", RuntimeWarning)
+                    torn_snap = registry.load()
+                mttr["snapshot-torn-shard"] = time.time() - t_torn
+                obs.event("recovered", tag="snapshot-torn-shard")
+            invariants["snapshot_torn_shard"] = {
+                # The sentinel must reject the torn plane, the fallback
+                # chain must serve the last GOOD version (v2's npz is
+                # itself corrupt, so that is v1), and the served
+                # parameters must never be the torn ones.
+                "ok": (torn_rejected and torn_snap.version == v1
+                       and torn_snap.fallback_from == v3),
+                "torn_version": v3,
+                "sentinel_rejected": torn_rejected,
+                "served_version": torn_snap.version,
+                "fallback_from": torn_snap.fallback_from,
+            }
+            stages["snapplane"] = {
+                "v3_torn": v3, "torn_row": int(row),
+                "fallback_served": torn_snap.version,
+            }
 
         # ---- stage C: streaming under storm --------------------------
         if prof.run_streaming:

@@ -24,6 +24,11 @@ Fault classes (docs/RESILIENCE.md "Chaos harness & failure domains"):
   slow-io          a chunk fit stalls (sleep) — latency, not failure
   wedged-client    the accelerator probe reports a wedge (full profile)
   registry-corrupt the ACTIVE registry snapshot npz is byte-flipped
+  snapshot-torn-shard a CRC-covered shard of the ACTIVE version's mmap
+                   snapshot plane (serve/snapplane.py) is byte-flipped
+                   mid-flip: the attach-time sentinel must reject the
+                   plane and the fallback chain serve the last GOOD
+                   version — never torn parameters
   stream-fault     streaming source polls raise transiently
   serve-fault      engine predict dispatches raise until the breaker opens
   queue-overload   a request burst exceeds the engine's bounded queue
@@ -258,6 +263,11 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
     inj.append(Injection(
         cls="registry-corrupt", stage="registry",
         point=REGISTRY_SNAPSHOT_POINT, mode="corrupt", attempts=1,
+    ))
+    inj.append(Injection(
+        cls="snapshot-torn-shard", stage="registry",
+        point="snapshot_plane_shard", mode="direct",
+        series=rng.randrange(1 << 16),  # picks the torn shard/rows
     ))
 
     # -- streaming stage ----------------------------------------------

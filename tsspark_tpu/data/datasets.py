@@ -215,21 +215,49 @@ def dataset_calendar(generator: str, n_timesteps: int) -> np.ndarray:
     return 13514.0 + np.arange(n_timesteps, dtype=np.float64)
 
 
+def _zero_pad(idx: np.ndarray, width: int) -> np.ndarray:
+    """``f"{i:0{width}d}"`` vectorized.  ``np.char.zfill`` TRUNCATES to
+    its width argument, so values with more natural digits than
+    ``width`` (row 10000 of a 4-wide scheme — exactly the million-series
+    regime) must keep their own digits, like the f-spec does."""
+    # Explicit natural width: int->str astype defaults to U21 (int64's
+    # worst case), which would quadruple the id columns' bytes at 1M
+    # rows for digits no id ever uses.
+    natw = len(str(int(idx.max()))) if idx.size else 1
+    s = idx.astype(f"<U{natw}")
+    maxw = max(width, natw)
+    out = s.astype(f"<U{maxw}")
+    short = np.char.str_len(s) < width
+    if short.any():
+        out[short] = np.char.zfill(s[short], width)
+    return out
+
+
 def dataset_ids(generator: str, lo: int, hi: int) -> np.ndarray:
     """Series ids for rows [lo, hi) of a named block generator —
     deterministic formulas, so a warm cache reader never regenerates
-    data just to learn the ids."""
+    data just to learn the ids.  Vectorized end to end: the former
+    per-row f-string comprehension was an O(n_series) interpreter pass
+    on every publish and scale-ladder rung (ROADMAP item 2; at 1M
+    series it dominated the id path)."""
     idx = np.arange(lo, hi)
     if generator == "m5_hier":
         store = idx % HIER_STORES
         dept = (idx // HIER_STORES) % HIER_DEPTS
         item = idx // (HIER_STORES * HIER_DEPTS)
-        return np.asarray([
-            f"S{s}_D{d}_I{k:05d}" for s, d, k in zip(store, dept, item)
-        ])
+        # Width-bounded astypes: a bare astype(np.str_) defaults to U21
+        # per component and np.char.add SUMS itemsizes, which would
+        # quadruple the id columns' bytes for digits no id ever uses.
+        s_w = len(str(HIER_STORES - 1))
+        d_w = len(str(HIER_DEPTS - 1))
+        out = np.char.add("S", store.astype(f"<U{s_w}"))
+        out = np.char.add(out, "_D")
+        out = np.char.add(out, dept.astype(f"<U{d_w}"))
+        out = np.char.add(out, "_I")
+        return np.char.add(out, _zero_pad(item, 5))
     if generator == "demo_weekly":
-        return np.asarray([f"s{i:04d}" for i in idx])
-    return np.asarray([f"M5_{i:05d}" for i in idx])
+        return np.char.add("s", _zero_pad(idx, 4))
+    return np.char.add("M5_", _zero_pad(idx, 5))
 
 
 def _m5_block(rng, n_days: int, ds: np.ndarray, scenario: str,

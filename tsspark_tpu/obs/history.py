@@ -49,7 +49,8 @@ from tsspark_tpu.utils.atomic import append_line
 HISTORY_FILE = "RUNHISTORY.jsonl"
 
 #: Artifact families the backfill scans for (filename prefixes).
-FAMILIES = ("BENCH_", "SERVE_", "CHAOS_", "EVAL_", "RUNLEDGER_")
+FAMILIES = ("BENCH_", "SERVE_", "CHAOS_", "EVAL_", "RUNLEDGER_",
+            "SCALE_")
 
 _git_rev_cache: Dict[str, Optional[str]] = {}
 
@@ -218,6 +219,53 @@ def _serve_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _scale_row(rep: Dict[str, Any]) -> Dict[str, Any]:
+    """Scale-ladder rung rows (bench --scale; tsspark_tpu.bench_scale).
+    The rung name IS part of the workload key: a 1M-series row must
+    never baseline against a smoke row — the same flat-namespace
+    discipline PR 11 gave the fit-path suffix."""
+    fit = rep.get("fit") or {}
+    pub = rep.get("publish") or {}
+    serve = rep.get("serve") or {}
+    mem = serve.get("mem") or {}
+    lat = serve.get("latency_ms") or {}
+    flip = serve.get("flip") or {}
+    cmp_ = serve.get("rss_compare") or {}
+    m: Dict[str, float] = {}
+    _put(m, "complete", rep.get("complete"))
+    _put(m, "wall_s", rep.get("wall_s"))
+    _put(m, "ingest_s", (rep.get("ingest") or {}).get("ingest_s"))
+    _put(m, "fit_s", fit.get("fit_s"))
+    if fit.get("series_done"):
+        _put(m, "series_per_s", fit.get("series_per_s"))
+    _put(m, "publish_s", pub.get("publish_s"))
+    _put(m, "snapshot_mb", pub.get("snapshot_mb"))
+    _put(m, "time_to_first_request_s",
+         serve.get("time_to_first_request_s"))
+    _put(m, "agg_requests_per_s", serve.get("agg_requests_per_s"))
+    _put(m, "p50_ms", lat.get("p50"))
+    _put(m, "p99_ms", lat.get("p99"))
+    _put(m, "flip_p99_ms", flip.get("p99_ms"))
+    _put(m, "rss_mb_per_replica", mem.get("rss_mb_per_replica"))
+    _put(m, "pss_mb_per_replica", mem.get("pss_mb_per_replica"))
+    _put(m, "rss_anon_mb_per_replica",
+         mem.get("rss_anon_mb_per_replica"))
+    _put(m, "snap_pss_total_mb", mem.get("snap_pss_total_mb"))
+    _put(m, "rss_reduction_x", cmp_.get("rss_reduction_x"))
+    _put(m, "wrong_version", serve.get("wrong_version"))
+    return {
+        "kind": "scale",
+        "trace_id": rep.get("trace_id"),
+        "unix": rep.get("unix"),
+        "workload": f"scale_{rep.get('rung')}",
+        "device": rep.get("device"),
+        "numerics_rev": rep.get("numerics_rev"),
+        "config_fingerprint": rep.get("config_fingerprint"),
+        "git_rev": rep.get("git_rev"),
+        "metrics": m,
+    }
+
+
 def _chaos_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     m: Dict[str, float] = {}
     _put(m, "ok", rep.get("ok"))
@@ -293,6 +341,8 @@ def classify(rep: Dict[str, Any]) -> Optional[str]:
     kind = rep.get("kind")
     if kind == "serve-loadgen":
         return "serve"
+    if kind == "scale-ladder":
+        return "scale"
     if kind == "chaos-storm":
         return "chaos"
     if kind == "run-ledger":
@@ -311,6 +361,7 @@ def classify(rep: Dict[str, Any]) -> Optional[str]:
 _ROW_BUILDERS = {
     "bench": _bench_row,
     "serve": _serve_row,
+    "scale": _scale_row,
     "chaos": _chaos_row,
     "eval": _eval_row,
     "ledger": _ledger_row,
@@ -464,6 +515,9 @@ _TRAJECTORY_COLUMNS = {
     "serve": ("requests_per_s", "p50_ms", "p99_ms", "shed_rate",
               "hit_rate", "agg_requests_per_s", "failovers",
               "flip_p99_ms"),
+    "scale": ("series_per_s", "agg_requests_per_s",
+              "time_to_first_request_s", "flip_p99_ms",
+              "rss_mb_per_replica", "rss_reduction_x", "complete"),
     "chaos": ("ok", "invariant_fails"),
     "eval": ("config3_m5.smape_holdout_cpu",
              "config3_m5.delta_holdout_p50",
@@ -502,7 +556,8 @@ def trajectory(rows: Sequence[Dict[str, Any]]) -> List[str]:
     """Human-readable trajectory: one line per row, grouped by family
     in ingest order (the roadmap's 'bench trajectory' block)."""
     lines: List[str] = []
-    for kind in ("bench", "eval", "serve", "chaos", "ledger"):
+    for kind in ("bench", "eval", "serve", "scale", "chaos",
+                 "ledger"):
         group = [r for r in rows if r.get("kind") == kind]
         if not group:
             continue

@@ -82,6 +82,21 @@ DEFAULT_SLO: Dict[str, Any] = {
             "flip_p99_ms": {"direction": "lower", "max_rise_frac": 1.0,
                             "slack_abs": 50.0},
         },
+        "scale": {
+            "rss_mb_per_replica": {"direction": "lower",
+                                   "max_rise_frac": 0.5,
+                                   "slack_abs": 128.0},
+            "agg_requests_per_s": {"direction": "higher",
+                                   "max_drop_frac": 0.5},
+            "time_to_first_request_s": {"direction": "lower",
+                                        "max_rise_frac": 1.0,
+                                        "slack_abs": 5.0},
+            "flip_p99_ms": {"direction": "lower",
+                            "max_rise_frac": 1.0,
+                            "slack_abs": 50.0},
+            "series_per_s": {"direction": "higher",
+                             "max_drop_frac": 0.5},
+        },
         "chaos": {
             "ok": {"direction": "higher", "max_drop_abs": 0.5},
             "mttr_*": {"direction": "lower", "max_rise_frac": 1.0,

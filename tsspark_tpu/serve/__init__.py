@@ -12,7 +12,14 @@ The read path as a first-class subsystem — the fit side's mirror image:
                 (the fit path's ``compacted_width`` ladder, so the jit
                 cache stays small), deadline shedding with structured
                 errors, ``RetryPolicy``-wrapped dispatch.
-  cache.py    — version-keyed per-series forecast LRU, invalidated on
+  snapplane.py — memmap snapshot column plane: every registry version
+                as spec-first / CRC-sentinel-last ``.npy`` columns the
+                engine and every pool replica attach read-only, so N
+                processes map ONE page-cache copy of the active
+                version (the npz stays the archival fallback; the two
+                formats serve bitwise-equal predictions).
+  cache.py    — version-keyed per-series forecast LRU, BOUNDED with
+                strict eviction + an eviction counter, invalidated on
                 registry activation, with hit/miss counters.
   __main__.py — ``python -m tsspark_tpu.serve``: a stdin/stdout JSONL
                 daemon, plus ``--loadgen`` which replays a synthetic

@@ -632,7 +632,9 @@ def main(argv=None) -> int:
                     "plane root, tsspark_tpu.data.plane.default_root)")
     ap.add_argument("--max-queue", type=int, default=4096)
     ap.add_argument("--max-batch", type=int, default=128)
-    ap.add_argument("--cache-capacity", type=int, default=8192)
+    ap.add_argument("--cache-capacity", type=int, default=None,
+                    help="forecast-cache entries per engine (default: "
+                    "$TSSPARK_SERVE_CACHE_CAPACITY, else 8192)")
     ap.add_argument("--metrics-every", type=float, default=None,
                     metavar="N",
                     help="daemon: export an atomic metrics_daemon.json "

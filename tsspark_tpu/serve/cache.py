@@ -9,13 +9,39 @@ hit/miss counters.
 Entries are PER SERIES, not per request: a request for (a, b, c) that
 follows one for (b, c, d) re-dispatches only ``a`` — series-level reuse
 is where a heavy-traffic mix actually overlaps.
+
+The cache is BOUNDED (strict LRU eviction at ``capacity``): at
+million-series scale an unbounded version-keyed cache is a slow OOM —
+every distinct (series, horizon-bucket) pair a long-lived engine ever
+serves would stay pinned until the next version flip.  Evictions are
+counted (``stats()["evicted"]`` and the ``tsspark_serve_cache_evicted``
+metric) so an undersized cache shows up in the SERVE report and the
+SLO watch instead of as silent hit-rate decay.  The default capacity
+comes from ``$TSSPARK_SERVE_CACHE_CAPACITY`` so operators size it per
+deployment without touching call sites.
 """
 
 from __future__ import annotations
 
 import collections
+import os
 import threading
 from typing import Dict, Hashable, Optional
+
+#: Fallback capacity when neither the constructor nor the environment
+#: picks one (entries are (H,)-row dicts — 8192 is a few tens of MB at
+#: serving horizons).
+FALLBACK_CAPACITY = 8192
+
+
+def default_capacity() -> int:
+    """Configured default: ``$TSSPARK_SERVE_CACHE_CAPACITY`` or the
+    module fallback (pool replicas inherit the env, so one knob sizes
+    every engine in a deployment)."""
+    try:
+        return int(os.environ.get("TSSPARK_SERVE_CACHE_CAPACITY", ""))
+    except ValueError:
+        return FALLBACK_CAPACITY
 
 
 class ForecastCache:
@@ -26,8 +52,9 @@ class ForecastCache:
     engine scatters per series.
     """
 
-    def __init__(self, capacity: int = 8192):
-        self.capacity = int(capacity)
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = (default_capacity() if capacity is None
+                         else int(capacity))
         self._data: "collections.OrderedDict[Hashable, Dict]" = (
             collections.OrderedDict()
         )
@@ -48,6 +75,7 @@ class ForecastCache:
         self.hits = 0
         self.misses = 0
         self.invalidations = 0
+        self.evicted = 0
 
     def __len__(self) -> int:
         return len(self._data)
@@ -79,6 +107,7 @@ class ForecastCache:
     def put(self, key: Hashable, value: Dict) -> None:
         if self.capacity <= 0:
             return
+        evictions = 0
         with self._lock:
             if (self._accept_version is not None
                     and isinstance(key, tuple) and key
@@ -89,6 +118,17 @@ class ForecastCache:
             self._data.move_to_end(key)
             while len(self._data) > self.capacity:
                 self._data.popitem(last=False)
+                evictions += 1
+            self.evicted += evictions
+        if evictions:
+            # Metric resolved outside the cache lock (and per event, so
+            # a METRICS.reset() between loadgen runs never strands a
+            # stale handle).
+            from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+            METRICS.counter("tsspark_serve_cache_evicted").inc(
+                evictions
+            )
 
     def invalidate(self, version: Optional[int] = None) -> int:
         """Drop entries for versions OTHER than ``version`` (``None``
@@ -128,4 +168,5 @@ class ForecastCache:
             "misses": self.misses,
             "hit_rate": round(self.hits / total, 4) if total else 0.0,
             "invalidations": self.invalidations,
+            "evicted": self.evicted,
         }

@@ -206,7 +206,7 @@ class _Replica:
                  socket_path: str, *, gen: int = 1,
                  heartbeat_s: float = 0.25, lease_ttl_s: float = 1.5,
                  max_queue: int = 4096, max_batch: int = 128,
-                 cache_capacity: int = 8192,
+                 cache_capacity: Optional[int] = None,
                  fence_grace_s: float = 8.0):
         self.pool_dir = pool_dir
         self.slot = int(slot)
@@ -217,7 +217,8 @@ class _Replica:
         self.lease_ttl_s = float(lease_ttl_s)
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch)
-        self.cache_capacity = int(cache_capacity)
+        self.cache_capacity = (None if cache_capacity is None
+                               else int(cache_capacity))
         self.fence_grace_s = float(fence_grace_s)
         self.token = _slot_token(self.slot)
         self.fenced = threading.Event()
@@ -652,7 +653,7 @@ class ReplicaPool:
                  breaker_threshold: int = 3,
                  breaker_reset_s: float = 0.5,
                  max_queue: int = 4096, max_batch: int = 128,
-                 cache_capacity: int = 8192,
+                 cache_capacity: Optional[int] = None,
                  hot_horizons: Sequence[int] = (7, 14, 28)):
         from tsspark_tpu.serve.registry import ParamRegistry
 
@@ -674,7 +675,8 @@ class ReplicaPool:
         )
         self.max_queue = int(max_queue)
         self.max_batch = int(max_batch)
-        self.cache_capacity = int(cache_capacity)
+        self.cache_capacity = (None if cache_capacity is None
+                               else int(cache_capacity))
         self.hot_horizons = tuple(int(h) for h in hot_horizons)
         os.makedirs(self.pool_dir, exist_ok=True)
         self.registry = ParamRegistry.open(self.registry_root)
@@ -721,7 +723,7 @@ class ReplicaPool:
     # -- lifecycle -------------------------------------------------------------
 
     def _spawn_cmd(self, info: ReplicaInfo) -> List[str]:
-        return [
+        cmd = [
             sys.executable, "-m", "tsspark_tpu.serve.replica",
             "--pool-dir", self.pool_dir,
             "--slot", str(info.slot),
@@ -732,8 +734,10 @@ class ReplicaPool:
             "--lease-ttl-s", str(self.lease_ttl_s),
             "--max-queue", str(self.max_queue),
             "--max-batch", str(self.max_batch),
-            "--cache-capacity", str(self.cache_capacity),
         ]
+        if self.cache_capacity is not None:
+            cmd += ["--cache-capacity", str(self.cache_capacity)]
+        return cmd
 
     def _child_env(self) -> Dict[str, str]:
         env = dict(os.environ)
@@ -1276,6 +1280,11 @@ class ReplicaPool:
             except (OSError, ValueError, ConnectionError):
                 resp = None
             if resp is not None and resp.get("ok"):
+                from tsspark_tpu.utils.procmem import (
+                    mapped_file_mem,
+                    proc_mem,
+                )
+
                 st = resp["stats"]
                 per[str(slot)] = {
                     "pid": resp.get("pid"), "gen": resp.get("gen"),
@@ -1288,6 +1297,14 @@ class ReplicaPool:
                     "fast_failed": st.get("fast_failed"),
                     "latency_ms": st.get("latency_ms"),
                     "cache": resp.get("cache"),
+                    # Sharing-aware memory (utils.procmem): rss_anon is
+                    # the private heap an npz snapshot would live in;
+                    # the snap_* fields are the replica's resident cost
+                    # in the mmap snapshot plane's shared columns.
+                    "mem": {
+                        **proc_mem(resp.get("pid")),
+                        "snap": mapped_file_mem(resp.get("pid")),
+                    },
                 }
             else:
                 per[str(slot)] = {"down": True}

@@ -44,11 +44,19 @@ from tsspark_tpu.config import NUMERICS_REV, ProphetConfig
 from tsspark_tpu.models.prophet.model import FitState
 from tsspark_tpu.obs import context as obs
 from tsspark_tpu.resilience import integrity
+from tsspark_tpu.serve import snapplane
 from tsspark_tpu.utils import checkpoint as ckpt
 from tsspark_tpu.utils.atomic import atomic_write, sweep_stale_temps
 
 _MANIFEST = "manifest.json"
 _FORMAT = 1
+
+#: Snapshot formats a registry publishes/reads.  "both" (default) lands
+#: the memmap column plane AND the archival npz per version; "mmap"
+#: skips the npz (bulk publishes at million-series scale); "npz" pins
+#: the legacy private-heap format (the scale ladder's RSS comparison
+#: arm forces it via TSSPARK_SNAPSHOT_FORMAT).
+SNAPSHOT_FORMATS = ("both", "mmap", "npz")
 
 
 class RegistryError(RuntimeError):
@@ -59,6 +67,11 @@ class RegistryError(RuntimeError):
     def __init__(self, reason: str, detail: str):
         super().__init__(f"{reason}: {detail}")
         self.reason = reason
+
+
+class SnapshotAbsent(Exception):
+    """Internal control flow: the version dir has no snapshot plane
+    (pre-plane publish) — fall through to the npz, no warning."""
 
 
 def take_fitstate(state: FitState, idx: np.ndarray) -> FitState:
@@ -77,10 +90,28 @@ def take_fitstate(state: FitState, idx: np.ndarray) -> FitState:
     return jax.tree.map(take, state)
 
 
+def _normalize_step(step: Optional[np.ndarray], n: int) -> np.ndarray:
+    if step is None:
+        step = np.ones(n)
+    return np.where(np.asarray(step, np.float64) > 0, step, 1.0)
+
+
 @dataclasses.dataclass(frozen=True)
 class Snapshot:
     """One loaded registry version: the batch FitState plus the id->row
-    map and per-series cadence the read path needs.
+    index and per-series cadence the read path needs.
+
+    Two sources, one read API:
+
+    * ``source="npz"`` — the archival checkpoint, fully materialized in
+      this process's heap; ``row_of`` is an eager id->row dict.
+    * ``source="mmap"`` — a lazy view over the version's snapshot plane
+      (``serve.snapplane``): every FitState leaf and the id index are
+      read-only memmaps, so ``rows``/``take`` touch only the pages a
+      request actually gathers and N processes share ONE page-cache
+      copy.  ``row_of`` is None; lookup is a vectorized
+      ``np.searchsorted`` against the publish-time sorted index — no
+      O(n_series) Python pass anywhere on the load path.
 
     ``fallback_from``: set when this snapshot was served because the
     ACTIVE version failed its integrity/load check (see
@@ -88,39 +119,67 @@ class Snapshot:
 
     version: int
     state: FitState
-    series_ids: Tuple[str, ...]
+    series_ids: Tuple[str, ...]           # or (n,) unicode memmap
     step: np.ndarray                      # (B,) median cadence, days
-    row_of: Dict[str, int]
+    row_of: Optional[Dict[str, int]] = None
     fallback_from: Optional[int] = None
+    source: str = "npz"
+    ids_sorted: Optional[np.ndarray] = None   # mmap: lexicographic ids
+    id_order: Optional[np.ndarray] = None     # mmap: sorted pos -> row
 
     @classmethod
     def build(cls, version: int, state: FitState, series_ids,
               step: Optional[np.ndarray]) -> "Snapshot":
         # C-level id normalization + C-iterated dict build: this runs on
-        # every snapshot load, and the former per-series Python passes
-        # (`str(s) for s in ids`, an enumerate dict comprehension) were
-        # the registry's O(n_series) interpreter cost at million-series
-        # scale (ROADMAP item 2; micro-benched in tests/test_resident.py).
+        # every npz snapshot load, and the former per-series Python
+        # passes (`str(s) for s in ids`, an enumerate dict
+        # comprehension) were the registry's O(n_series) interpreter
+        # cost at million-series scale (ROADMAP item 2; micro-benched in
+        # tests/test_resident.py).
         from tsspark_tpu.orchestrate import normalize_series_ids
 
         ids = tuple(normalize_series_ids(series_ids).tolist())
         n = len(ids)
-        if step is None:
-            step = np.ones(n)
-        step = np.where(np.asarray(step, np.float64) > 0, step, 1.0)
         return cls(version=version, state=state, series_ids=ids,
-                   step=step, row_of=dict(zip(ids, range(n))))
+                   step=_normalize_step(step, n),
+                   row_of=dict(zip(ids, range(n))))
+
+    @classmethod
+    def attach(cls, version: int, view: "snapplane.PlaneView"
+               ) -> "Snapshot":
+        """Lazy mmap snapshot over an attached plane view."""
+        return cls(
+            version=version, state=view.state, series_ids=view.ids,
+            step=_normalize_step(view.extras.get("step"),
+                                 view.n_series),
+            row_of=None, source="mmap",
+            ids_sorted=view.ids_sorted, id_order=view.id_order,
+        )
 
     def rows(self, series_ids) -> Tuple[np.ndarray, List[str]]:
         """Row indices for ``series_ids`` + the ids this version lacks."""
-        idx, missing = [], []
-        for s in series_ids:
-            i = self.row_of.get(str(s))
-            (missing.append(str(s)) if i is None else idx.append(i))
-        return np.asarray(idx, np.int64), missing
+        if self.row_of is not None:
+            idx, missing = [], []
+            for s in series_ids:
+                i = self.row_of.get(str(s))
+                (missing.append(str(s)) if i is None
+                 else idx.append(i))
+            return np.asarray(idx, np.int64), missing
+        from tsspark_tpu.orchestrate import normalize_series_ids
+
+        q = normalize_series_ids(series_ids)
+        n = len(self.ids_sorted)
+        if len(q) == 0 or n == 0:
+            return np.empty(0, np.int64), [str(s) for s in q]
+        pos = np.minimum(np.searchsorted(self.ids_sorted, q), n - 1)
+        found = self.ids_sorted[pos] == q
+        idx = np.asarray(self.id_order[pos[found]], np.int64)
+        missing = [str(s) for s in q[~found]]
+        return idx, missing
 
     def take(self, idx: np.ndarray) -> Tuple[FitState, np.ndarray]:
-        """(gathered FitState, gathered cadence) for row indices."""
+        """(gathered FitState, gathered cadence) for row indices — on
+        an mmap snapshot the gather reads only the touched pages."""
         return take_fitstate(self.state, idx), np.take(self.step, idx)
 
 
@@ -128,11 +187,24 @@ class ParamRegistry:
     """Publish / activate / rollback fitted-parameter versions."""
 
     def __init__(self, root: str, config: ProphetConfig,
-                 numerics_rev: int = NUMERICS_REV, strict: bool = True):
+                 numerics_rev: int = NUMERICS_REV, strict: bool = True,
+                 snapshot_format: Optional[str] = None):
+        """``snapshot_format``: "both" (default) / "mmap" / "npz" —
+        which snapshot representation ``publish`` lands and ``load``
+        prefers (see ``SNAPSHOT_FORMATS``).  Defaults from
+        ``$TSSPARK_SNAPSHOT_FORMAT`` so pool replica processes inherit
+        the front's choice without wire-protocol plumbing."""
         self.root = root
         self.config = config
         self.numerics_rev = int(numerics_rev)
         self.strict = strict
+        fmt = (snapshot_format
+               or os.environ.get("TSSPARK_SNAPSHOT_FORMAT") or "both")
+        if fmt not in SNAPSHOT_FORMATS:
+            raise ValueError(
+                f"snapshot_format {fmt!r} not in {SNAPSHOT_FORMATS}"
+            )
+        self.snapshot_format = fmt
         self._listeners: List[Callable[[Optional[int]], None]] = []
         os.makedirs(root, exist_ok=True)
         # A publisher SIGKILLed mid-snapshot orphans a pid-suffixed
@@ -268,12 +340,25 @@ class ParamRegistry:
 
     def publish(self, state: FitState, series_ids,
                 step: Optional[np.ndarray] = None,
-                activate: bool = True) -> int:
+                activate: bool = True,
+                snapshot_format: Optional[str] = None) -> int:
         """Persist one snapshot as the next version (snapshot files
         first, manifest last); optionally activate it.  Returns the new
         version number.  Concurrent publishers serialize on the
-        manifest lock (``_locked``)."""
+        manifest lock (``_locked``).
+
+        The snapshot lands as the memmap column plane
+        (``serve.snapplane``) plus the archival npz, per
+        ``snapshot_format`` (default: the registry's) — the plane is
+        what the engine and pool replicas map as one shared page-cache
+        copy; the npz is the per-version fallback when a plane shard
+        tears."""
         t_pub0 = time.time()
+        fmt = snapshot_format or self.snapshot_format
+        if fmt not in SNAPSHOT_FORMATS:
+            raise ValueError(
+                f"snapshot_format {fmt!r} not in {SNAPSHOT_FORMATS}"
+            )
         from tsspark_tpu.orchestrate import normalize_series_ids
 
         ids = normalize_series_ids(series_ids)
@@ -299,16 +384,27 @@ class ParamRegistry:
                 version += 1
             vdir = f"v{version:06d}"
             os.makedirs(os.path.join(self.root, vdir))
-        ckpt.save_state(
-            os.path.join(self.root, vdir, "state"), state,
-            self.config, series_ids=ids, extras=extras,
-        )
+        if fmt != "mmap":
+            ckpt.save_state(
+                os.path.join(self.root, vdir, "state"), state,
+                self.config, series_ids=ids, extras=extras,
+            )
+        if fmt != "npz":
+            snapplane.write_plane(
+                os.path.join(self.root, vdir), state, ids,
+                extras=extras,
+                fingerprint=ckpt.config_fingerprint(self.config),
+                numerics_rev=self.numerics_rev,
+            )
         with self._locked():
             m = self._read_manifest()
             m["versions"][str(version)] = {
                 "path": vdir,
                 "n_series": int(len(ids)),
                 "published_unix": round(time.time(), 3),
+                "formats": sorted(
+                    ({"both": ("mmap", "npz")}.get(fmt, (fmt,)))
+                ),
             }
             if activate:
                 m["previous_version"] = m["active_version"]
@@ -423,7 +519,63 @@ class ParamRegistry:
         if entry is None:
             raise RegistryError("unknown-version",
                                 f"version {version} was never published")
-        base = os.path.join(self.root, entry["path"], "state")
+        vdir = os.path.join(self.root, entry["path"])
+        if self.snapshot_format != "npz":
+            try:
+                return self._load_plane(vdir, int(version), entry)
+            except SnapshotAbsent:
+                pass  # version predates the plane: npz is the format
+            except RegistryError as e:
+                # Plane torn: the SAME version's archival npz is the
+                # first fallback, BEFORE the active->previous chain —
+                # only when it too is missing/corrupt does the caller
+                # degrade to an older version.  One verification pass:
+                # _load_npz does the CRC check itself; a failure there
+                # re-raises the PLANE error (the root cause).
+                try:
+                    snap = self._load_npz(vdir, int(version), entry)
+                except RegistryError:
+                    raise e
+                warnings.warn(
+                    f"registry version {version}: snapshot plane failed "
+                    f"its CRC sentinel ({e}); serving the archival npz "
+                    "for this version — republish to restore the "
+                    "one-copy mmap path",
+                    RuntimeWarning,
+                )
+                return snap
+        return self._load_npz(vdir, int(version), entry)
+
+    def _load_plane(self, vdir: str, version: int,
+                    entry: Dict) -> Snapshot:
+        """Attach the version's memmap column plane as a lazy Snapshot.
+        The CRC sweep inside ``snapplane.attach`` is the torn-shard
+        gate AND the page warming (one sequential pass; pages stay
+        shared for every other mapping process)."""
+        try:
+            view = snapplane.attach(
+                vdir, verify=True, expected_n=int(entry["n_series"])
+            )
+        except snapplane.SnapshotPlaneError as e:
+            if e.reason == "absent":
+                raise SnapshotAbsent(str(e))
+            raise RegistryError(
+                "corrupt-snapshot", f"version {version}: {e}"
+            )
+        if self.strict and view.fingerprint is not None \
+                and view.fingerprint != ckpt.config_fingerprint(
+                    self.config):
+            raise RegistryError(
+                "corrupt-snapshot",
+                f"version {version}: plane was published under config "
+                f"fingerprint {view.fingerprint}, reader has "
+                f"{ckpt.config_fingerprint(self.config)}",
+            )
+        return Snapshot.attach(version, view)
+
+    def _load_npz(self, vdir: str, version: int,
+                  entry: Dict) -> Snapshot:
+        base = os.path.join(vdir, "state")
         if not integrity.verify_file(base + ".npz"):
             raise RegistryError(
                 "corrupt-snapshot",

@@ -46,7 +46,9 @@ def main(argv=None) -> int:
     ap.add_argument("--lease-ttl-s", type=float, default=1.5)
     ap.add_argument("--max-queue", type=int, default=4096)
     ap.add_argument("--max-batch", type=int, default=128)
-    ap.add_argument("--cache-capacity", type=int, default=8192)
+    # None defers to the configured default
+    # ($TSSPARK_SERVE_CACHE_CAPACITY -> serve.cache.default_capacity).
+    ap.add_argument("--cache-capacity", type=int, default=None)
     args = ap.parse_args(argv)
 
     from tsspark_tpu.obs import context as obs

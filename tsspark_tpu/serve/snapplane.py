@@ -1,0 +1,330 @@
+"""Memmap snapshot column plane: registry versions as shared pages.
+
+``ParamRegistry.load`` used to materialize every snapshot npz into the
+loading process's PRIVATE heap — N pool replicas therefore held N full
+copies of the active version, the measured reason 4 replicas aggregate
+less throughput than one engine on a one-core box (ROADMAP item 5
+stretch).  This module publishes each registry version the way the data
+plane (``data/plane.py``) publishes datasets: one ``.npy`` column file
+per FitState leaf plus the id->row index, under the same
+spec-first / sentinel-last visibility protocol —
+
+* ``snap_spec.json``  — identity record (column dtypes/shapes,
+  n_series, config fingerprint, NUMERICS_REV), written FIRST;
+* ``snapcol_<name>.npy`` — one plain npy per column: ``theta``, the
+  solver diagnostics, every ``meta_*`` ScalingMeta leaf (host float64),
+  ``extra_*`` side arrays (per-series cadence), plus the id index
+  triple ``ids`` / ``ids_sorted`` / ``id_order`` (see below);
+* ``snapok.json``     — the CRC sentinel, written LAST: per-shard CRC32
+  of every column's rows.  A reader trusts nothing this sentinel does
+  not cover, so a torn or silently corrupted column is REJECTED at
+  attach instead of being assembled into forecasts (the exact contract
+  ``resilience.integrity`` gives the npz format).
+
+Readers attach with ``np.load(..., mmap_mode="r")``: the engine and
+every pool replica then map ONE page-cache copy of the active version
+instead of each parsing a private npz heap — per-replica incremental
+RSS is O(1) in snapshot size.  The attach-time CRC sweep doubles as
+``madvise``-style page warming: it walks every column sequentially, so
+an activation prefetch (``PredictionEngine.prefetch`` -> ``registry.
+load``) leaves the pages hot for the first post-flip requests, and the
+second and later replicas to attach find them already resident.
+
+Row lookup without an O(n_series) Python pass: the publisher writes the
+id column alongside ``ids_sorted`` (the ids in lexicographic order) and
+``id_order`` (the original row of each sorted position), so
+``Snapshot.rows`` resolves a request with one vectorized
+``np.searchsorted`` against the sorted memmap — no per-series dict
+build at load time, no million-entry Python dict in any replica.
+
+The npz (``utils.checkpoint.save_state``) stays the archival/fallback
+format: ``ParamRegistry._load_version`` prefers the plane, degrades to
+the same version's npz when the plane is torn, and only then walks the
+active->previous fallback chain.  Predictions served from the two
+formats are pinned bitwise equal (tests/test_snapshot_plane.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tsspark_tpu.models.prophet.design import ScalingMeta
+from tsspark_tpu.models.prophet.model import FitState
+from tsspark_tpu.utils.atomic import atomic_write
+
+#: Plane format revision (bump on incompatible layout change; the
+#: reader refuses unknown revisions instead of misparsing them).
+SNAP_FORMAT = 1
+
+SNAP_SPEC = "snap_spec.json"
+SNAP_OK = "snapok.json"
+COL_PREFIX = "snapcol_"
+
+#: CRC shard width (rows).  Shards bound what one torn write can hide
+#: behind a stale CRC and give the chaos harness a named unit to tear;
+#: 64k rows keeps the sentinel a few entries even at 1M series.
+DEFAULT_SHARD_ROWS = 65536
+
+
+class SnapshotPlaneError(RuntimeError):
+    """Structured plane failure.  ``reason`` is ``"absent"`` (no plane
+    was ever published in this version dir — fall back to the npz
+    silently) or ``"corrupt"`` (a plane exists but fails its sentinel —
+    the caller must treat the version as torn)."""
+
+    def __init__(self, reason: str, detail: str):
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+
+
+def _col_path(vdir: str, name: str) -> str:
+    return os.path.join(vdir, f"{COL_PREFIX}{name}.npy")
+
+
+def shard_ranges(n: int, shard_rows: int) -> List[Tuple[int, int]]:
+    return [(lo, min(lo + shard_rows, n))
+            for lo in range(0, n, shard_rows)]
+
+
+def state_columns(state: FitState,
+                  extras: Optional[Dict[str, np.ndarray]] = None
+                  ) -> Dict[str, np.ndarray]:
+    """FitState -> host-numpy column dict, the exact key set
+    ``utils.checkpoint.save_state`` puts in the npz (minus the
+    integrity stamp) — one leaf naming scheme for both formats, so the
+    bitwise-parity contract is checkable key by key."""
+    cols = {
+        "theta": np.asarray(state.theta),
+        "loss": np.asarray(state.loss),
+        "grad_norm": np.asarray(state.grad_norm),
+        "converged": np.asarray(state.converged),
+        "n_iters": np.asarray(state.n_iters),
+    }
+    if state.status is not None:
+        cols["status"] = np.asarray(state.status)
+    cols.update(
+        {f"meta_{k}": np.asarray(v)
+         for k, v in state.meta._asdict().items()}
+    )
+    cols.update(
+        {f"extra_{k}": np.asarray(v)
+         for k, v in (extras or {}).items()}
+    )
+    return cols
+
+
+def _shard_crcs(cols: Dict[str, np.ndarray], lo: int,
+                hi: int) -> Dict[str, int]:
+    return {
+        k: zlib.crc32(np.ascontiguousarray(a[lo:hi]).tobytes())
+        for k, a in cols.items()
+    }
+
+
+def write_plane(vdir: str, state: FitState, ids: np.ndarray,
+                extras: Optional[Dict[str, np.ndarray]] = None, *,
+                fingerprint: Optional[str] = None,
+                numerics_rev: Optional[int] = None,
+                shard_rows: int = DEFAULT_SHARD_ROWS) -> None:
+    """Land one version's column plane in ``vdir``: spec first, columns
+    (each itself atomic), CRC sentinel last.  The version dir is
+    publisher-private until the registry manifest references it, so a
+    publisher killed mid-plane leaves an orphan dir the version
+    allocator skips — never a half-visible snapshot."""
+    ids = np.asarray(ids)
+    if ids.dtype.kind not in ("U", "S"):
+        ids = ids.astype(np.str_)
+    cols = state_columns(state, extras)
+    n = int(cols["theta"].shape[0])
+    if len(ids) != n:
+        raise ValueError(f"{len(ids)} ids for {n} state rows")
+    # The searchsorted row index, PRECOMPUTED at publish: readers mmap
+    # the sorted view directly instead of paying an O(n log n) sort (or
+    # an O(n) dict build) on every snapshot load.
+    order = np.argsort(ids, kind="stable").astype(np.int64)
+    cols["ids"] = ids
+    cols["ids_sorted"] = ids[order]
+    cols["id_order"] = order
+    spec = {
+        "format": SNAP_FORMAT,
+        "n_series": n,
+        "shard_rows": int(shard_rows),
+        "fingerprint": fingerprint,
+        "numerics_rev": numerics_rev,
+        "columns": {k: {"dtype": a.dtype.str, "shape": list(a.shape)}
+                    for k, a in cols.items()},
+    }
+    atomic_write(os.path.join(vdir, SNAP_SPEC),
+                 lambda fh: json.dump(spec, fh, indent=1), mode="w")
+    for k, a in cols.items():
+        atomic_write(_col_path(vdir, k),
+                     lambda fh, a=a: np.save(fh, a))
+    sentinel = {
+        "format": SNAP_FORMAT,
+        "n_series": n,
+        "shard_rows": int(shard_rows),
+        "unix": round(time.time(), 3),
+        "shards": [[lo, hi, _shard_crcs(cols, lo, hi)]
+                   for lo, hi in shard_ranges(n, shard_rows)],
+    }
+    atomic_write(os.path.join(vdir, SNAP_OK),
+                 lambda fh: json.dump(sentinel, fh), mode="w")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlaneView:
+    """One attached (memmap) snapshot plane."""
+
+    n_series: int
+    state: FitState                # leaves are read-only memmaps
+    ids: np.ndarray                # (n,) memmap, original row order
+    ids_sorted: np.ndarray         # (n,) memmap, lexicographic
+    id_order: np.ndarray           # (n,) int64 memmap, sorted pos -> row
+    extras: Dict[str, np.ndarray]
+    fingerprint: Optional[str]
+    numerics_rev: Optional[int]
+
+
+def _read_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+        return d if isinstance(d, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def attach(vdir: str, *, verify: bool = True,
+           expected_n: Optional[int] = None) -> PlaneView:
+    """Attach the plane in ``vdir`` as memmap views.
+
+    ``verify`` recomputes every shard CRC against the sentinel before
+    any column is trusted — a sequential read of the shared pages that
+    doubles as the activation prefetch's page warming (the pages stay
+    in cache for every other process mapping this version).  Raises
+    ``SnapshotPlaneError("absent")`` when no plane was published here,
+    ``("corrupt")`` for anything torn, truncated, or mismatched.
+    """
+    sentinel = _read_json(os.path.join(vdir, SNAP_OK))
+    spec = _read_json(os.path.join(vdir, SNAP_SPEC))
+    if sentinel is None and spec is None:
+        raise SnapshotPlaneError(
+            "absent", f"no snapshot plane under {vdir}"
+        )
+    if spec is None or sentinel is None:
+        raise SnapshotPlaneError(
+            "corrupt",
+            f"{vdir}: plane is half-published "
+            f"(spec={'ok' if spec else 'missing'}, "
+            f"sentinel={'ok' if sentinel else 'missing'})",
+        )
+    if spec.get("format") != SNAP_FORMAT \
+            or sentinel.get("format") != SNAP_FORMAT:
+        raise SnapshotPlaneError(
+            "corrupt",
+            f"{vdir}: plane format {spec.get('format')} != {SNAP_FORMAT}",
+        )
+    n = int(spec.get("n_series", -1))
+    if expected_n is not None and n != int(expected_n):
+        raise SnapshotPlaneError(
+            "corrupt",
+            f"{vdir}: plane carries {n} series, manifest says "
+            f"{expected_n}",
+        )
+    cols: Dict[str, np.ndarray] = {}
+    for name, meta in (spec.get("columns") or {}).items():
+        path = _col_path(vdir, name)
+        try:
+            mm = np.load(path, mmap_mode="r")
+        except Exception as e:
+            # Not just OSError/ValueError: a header torn mid-byte
+            # surfaces as SyntaxError out of numpy's literal_eval — any
+            # unreadable column IS a corrupt plane.
+            raise SnapshotPlaneError("corrupt", f"{path}: {e}")
+        if (mm.dtype.str != meta.get("dtype")
+                or list(mm.shape) != meta.get("shape")):
+            raise SnapshotPlaneError(
+                "corrupt",
+                f"{path}: on-disk {mm.dtype.str}{list(mm.shape)} != "
+                f"spec {meta.get('dtype')}{meta.get('shape')}",
+            )
+        cols[name] = mm
+    for req in ("theta", "ids", "ids_sorted", "id_order"):
+        if req not in cols:
+            raise SnapshotPlaneError(
+                "corrupt", f"{vdir}: plane is missing column {req!r}"
+            )
+    if verify:
+        for entry in sentinel.get("shards") or ():
+            lo, hi, crcs = int(entry[0]), int(entry[1]), entry[2]
+            got = _shard_crcs(cols, lo, hi)
+            for name, want in crcs.items():
+                if got.get(name) != int(want):
+                    raise SnapshotPlaneError(
+                        "corrupt",
+                        f"{_col_path(vdir, name)}: shard [{lo}, {hi}) "
+                        "CRC mismatch (torn or silently corrupted "
+                        "snapshot column)",
+                    )
+    meta_fields = {
+        k[len("meta_"):]: np.asarray(cols[k], np.float64)
+        for k in cols if k.startswith("meta_")
+    }
+    state = FitState(
+        theta=cols["theta"],
+        meta=ScalingMeta(**meta_fields),
+        loss=cols["loss"],
+        grad_norm=cols["grad_norm"],
+        converged=cols["converged"],
+        n_iters=cols["n_iters"],
+        status=cols.get("status"),
+    )
+    return PlaneView(
+        n_series=n,
+        state=state,
+        ids=cols["ids"],
+        ids_sorted=cols["ids_sorted"],
+        id_order=cols["id_order"],
+        extras={k[len("extra_"):]: v for k, v in cols.items()
+                if k.startswith("extra_")},
+        fingerprint=spec.get("fingerprint"),
+        numerics_rev=spec.get("numerics_rev"),
+    )
+
+
+def has_plane(vdir: str) -> bool:
+    """Cheap presence probe (no CRC sweep)."""
+    return os.path.exists(os.path.join(vdir, SNAP_OK))
+
+
+def verify_plane(vdir: str) -> bool:
+    """Deep integrity check: True when the plane attaches AND every
+    shard CRC matches (the chaos harness's torn-shard probe)."""
+    try:
+        attach(vdir, verify=True)
+        return True
+    except SnapshotPlaneError:
+        return False
+
+
+def snapshot_nbytes(vdir: str) -> Optional[int]:
+    """Total column bytes of the plane in ``vdir`` (the denominator of
+    the scale ladder's one-physical-copy RSS accounting); None when no
+    plane is published."""
+    spec = _read_json(os.path.join(vdir, SNAP_SPEC))
+    if spec is None:
+        return None
+    total = 0
+    for meta in (spec.get("columns") or {}).values():
+        n = 1
+        for d in meta.get("shape") or ():
+            n *= int(d)
+        total += n * int(np.dtype(meta["dtype"]).itemsize)
+    return total

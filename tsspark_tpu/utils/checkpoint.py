@@ -76,10 +76,22 @@ def save_state(
     host = {k: np.asarray(v) for k, v in arrays.items()}
     host = integrity.stamp(host)
     atomic_write(path + ".npz", lambda fh: np.savez(fh, **host))
+    if series_ids is None:
+        sidecar_ids = None
+    else:
+        # C-level id stringification: the former per-element
+        # ``[str(s) for s in ids]`` was an O(n_series) interpreter pass
+        # on every registry publish (ROADMAP item 2).
+        ids_arr = np.asarray(series_ids)
+        if ids_arr.ndim == 0:  # sized-less iterable: materialize
+            ids_arr = np.asarray(list(series_ids))
+        if ids_arr.dtype.kind != "U":
+            ids_arr = ids_arr.astype(np.str_)
+        sidecar_ids = ids_arr.tolist()
     sidecar = {
         "fingerprint": config_fingerprint(config),
         "n_series": int(state.theta.shape[0]),
-        "series_ids": None if series_ids is None else [str(s) for s in series_ids],
+        "series_ids": sidecar_ids,
         "format": 1,
     }
     atomic_write(path + ".json", lambda fh: json.dump(sidecar, fh),
