@@ -469,6 +469,21 @@ def main() -> None:
                          "against one data plane, emitting "
                          "SCALE_*.json (docs/SERVING.md, 'Snapshot "
                          "plane & memory model')")
+    ap.add_argument("--delta", nargs="?", const="smoke", default=None,
+                    metavar="RUNG",
+                    help="delta-refit churn sweep (tsspark_tpu.refit) "
+                         "at a scale-ladder rung ('smoke' default, or "
+                         "'30k'): cold resident fit + publish once, "
+                         "then per churn fraction land a synthetic "
+                         "row-advance, run one warm delta-refit cycle "
+                         "(detect -> fit changed set -> copy-forward "
+                         "delta publish -> materialized flip), and "
+                         "stamp delta_series_per_s / delta_wall_frac "
+                         "into BENCH_delta_* reports (docs/PERF.md "
+                         "\"Delta refit\")")
+    ap.add_argument("--churns", default=None,
+                    help="comma-separated churn fractions for --delta "
+                         "(default 0.01,0.1,0.3)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for a quick pipeline check")
     ap.add_argument("--keep", action="store_true",
@@ -481,6 +496,18 @@ def main() -> None:
     if args.profile:
         profile_main(args)
         return
+    if args.delta:
+        # Same mesh forcing as --resident/--scale: the delta cycles run
+        # the resident fit path in-process.
+        from tsspark_tpu.resident import force_virtual_host_mesh
+
+        force_virtual_host_mesh()
+        from tsspark_tpu import refit
+
+        reports = refit.run_delta_bench(
+            args.delta, churns=refit.parse_churns(args.churns)
+        )
+        sys.exit(0 if refit.sweep_ok(reports) else 1)
     if args.scale:
         # The ladder needs the virtual host mesh for the resident fit
         # path, same forcing as --resident (before anything imports

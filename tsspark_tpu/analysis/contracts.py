@@ -400,6 +400,29 @@ def _c_compact_gather(case: ShapeCase, out) -> List[str]:
     return errs
 
 
+def _k_warm_gather(case: ShapeCase):
+    """The delta-refit warm-start gather (refit.warm_theta_gather): a
+    row-subset take over the active snapshot's theta that must stay
+    float32 under x64 drift — a leaked f64 init would double every
+    warm wave's transfer AND flip fit_resident_core's traced input
+    dtype, recompiling (or poisoning) the shared warm/cold program."""
+    import jax
+
+    cfg, _ = _configs(case)
+    from tsspark_tpu.refit import warm_theta_gather
+
+    theta = _sds((case.b, cfg.num_params))
+    idx = _sds((max(case.b // 2, 1),), "int32")
+    return jax.eval_shape(warm_theta_gather, theta, idx)
+
+
+def _c_warm_gather(case: ShapeCase, out) -> List[str]:
+    cfg, _ = _configs(case)
+    k = max(case.b // 2, 1)
+    return _expect(out, (k, cfg.num_params), "float32",
+                   "warm_theta_gather rows")
+
+
 def _k_forecast(case: ShapeCase):
     """The batched predict entry point the serving engine dispatches
     through (predict.forecast_jit): traced with sampling ON so the
@@ -553,6 +576,8 @@ def default_kernels() -> Tuple[KernelContract, ...]:
         KernelContract("model.mcmc_core", _k_mcmc, _c_mcmc),
         KernelContract("compact.take_state+take_fit_data",
                        _k_compact_gather, _c_compact_gather),
+        KernelContract("refit.warm_theta_gather", _k_warm_gather,
+                       _c_warm_gather),
         KernelContract("predict.forecast (serve batched entry)",
                        _k_forecast, _c_forecast),
         KernelContract("sharding.fit_sharded", _k_sharded, _c_sharded,

@@ -225,8 +225,55 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "its hit marker",
     ),
     ArtifactSpec(
+        "plane-delta-lock", (".delta.lock",),
+        ("land_delta",),
+        "advisory flock target serializing delta landers' whole "
+        "seq-allocation -> visibility-record window (data/plane.py): "
+        "opened append, never written or read — the lock lives on the "
+        "file description, exactly the registry-lock pattern",
+        append_ok=True,
+    ),
+    ArtifactSpec(
+        "plane-delta-ok", ("deltaok_",),
+        ("land_delta",),
+        "row-advance delta visibility record (data/plane.py): written "
+        "atomically LAST, after the patch payload landed, the column "
+        "memmaps were mutated, and every touched shard sentinel was "
+        "re-landed with fresh CRCs — advanced_since() unions only "
+        "records that made it here, so a torn delta never half-appears "
+        "in a refit claim set",
+    ),
+    ArtifactSpec(
+        "plane-delta-patch", ("deltapatch_",),
+        ("land_delta",),
+        "row-advance patch payload (data/plane.py): changed rows + the "
+        "new trailing-window values, atomic + CRC-stamped FIRST — the "
+        "replayable record write_shard re-applies after regenerating a "
+        "base shard, so repair after a delta converges to the same "
+        "bytes bitwise",
+    ),
+    ArtifactSpec(
+        "refit-plan", ("refit_plan.json",),
+        ("_write_refit_plan",),
+        "delta-refit cycle plan (tsspark_tpu.refit): base version, "
+        "coverage stamps, the pinned changed-row set — replaced "
+        "atomically at detect time and again (complete=true) after the "
+        "flip, so a successor of a killed cycle resumes the SAME claim "
+        "set instead of racing deltas landed after the kill",
+    ),
+    ArtifactSpec(
+        "delta-bench-report", ("BENCH_delta_",),
+        ("run_delta_bench",),
+        "delta-refit churn-sweep report (bench --delta): one "
+        "bench-family artifact per (rung, churn) stamping "
+        "delta_series_per_s / delta_wall_frac, written once atomically "
+        "and ingested through the regression sentinel under a "
+        "+delta<churn> workload key",
+    ),
+    ArtifactSpec(
         "plane-shard-ok", ("shardok_",),
-        ("write_shard", "import_batch"),
+        ("write_shard", "import_batch", "_land_shard_sentinel",
+         "_reland_sentinel_from_disk"),
         "per-shard visibility sentinel (data/plane.py): atomic write "
         "AFTER the shard's memmap rows are flushed, payload CRCs "
         "inside; readers trust only sentinel-covered rows, so a torn "
@@ -250,8 +297,20 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "pure diagnostics folded into BENCH extras",
     ),
     ArtifactSpec(
+        "delta-manifest", ("delta_manifest.json",),
+        ("write_plane_delta",),
+        "delta-publish metadata (serve/snapplane.py): base version, "
+        "the changed row/id set, the data-plane coverage stamp — "
+        "written atomically after the new version's sentinel (pure "
+        "metadata: the registry manifest referencing the version dir "
+        "is the real visibility gate); the serving side reads it to "
+        "carry unchanged series' cache entries forward across a delta "
+        "flip.  Must precede the registry-manifest spec: its filename "
+        "contains the 'manifest.json' fragment",
+    ),
+    ArtifactSpec(
         "snapshot-plane", ("snapcol_", "snap_spec.json", "snapok.json"),
-        ("write_plane",),
+        ("write_plane", "write_plane_delta", "_link_or_copy"),
         "mmap snapshot column plane (serve/snapplane.py): spec first, "
         "one atomic .npy per FitState column + the id->row index, the "
         "per-shard CRC sentinel LAST — the unit of visibility, exactly "
@@ -355,6 +414,7 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
 PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/orchestrate.py",
     "tsspark_tpu/resident.py",
+    "tsspark_tpu/refit.py",
     "tsspark_tpu/data/plane.py",
     "tsspark_tpu/data/ingest.py",
     "tsspark_tpu/streaming/state.py",

@@ -806,6 +806,186 @@ def _run_resident_storm(scratch: str, storm: StormPlan,
     return stage, {"resident_exactly_once": inv_res}
 
 
+# ---------------------------------------------------------------------------
+# stage H: delta-refit engine under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_refit_storm(scratch: str, storm: StormPlan,
+                     mttr: Dict[str, Optional[float]],
+                     deadline_s: float) -> Tuple[Dict, Dict]:
+    """The refit-kill class: a delta lands on the data plane, a
+    delta-refit child (``python -m tsspark_tpu.refit``) runs the warm
+    waves, and an armed ``delta_publish`` exit fault kills it MID
+    DELTA-PUBLISH (copy-forward columns half-written, manifest never
+    updated).  Invariants: the pool serves only the last complete
+    version throughout (zero wrong-version), the in-process successor
+    resumes from the landed chunk flushes (zero refit dispatches) and
+    re-publishes, and the final snapshot's unchanged rows are bitwise
+    the prior active version's.
+
+    Runs with the STORM env plan popped: the stage's only fault is the
+    child's PRIVATE plan — the successor's in-process resident waves
+    must not consume (or fire!) the resident-kill rule's claims, and an
+    exit fault firing in-process would kill the harness itself."""
+    import subprocess
+
+    from tsspark_tpu import orchestrate, refit, resident
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.serve.pool import ReplicaPool
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    prof = storm.profile
+    base = os.path.join(scratch, "refit")
+    cfg, solver = _config(prof.max_iters)
+    t0 = time.time()
+    env_plan = os.environ.pop(faults.ENV_VAR, None)
+    pool = None
+    try:
+        # ---- setup: plane dataset (private root — deltas mutate
+        # ---- landed rows), cold resident fit, publish v1, pool ------
+        spec = plane.DatasetSpec(
+            generator="demo_weekly", n_series=prof.refit_series,
+            n_timesteps=64, seed=storm.seed,
+            shard_rows=prof.plane_shard_rows,
+        )
+        dset = plane.ensure(spec, root=os.path.join(base, "plane"))
+        ids = plane.series_ids(spec)
+        out_dir = os.path.join(base, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        orchestrate.save_run_config(out_dir, cfg, solver)
+        resident.run_resident(
+            data_dir=dset, out_dir=out_dir, series=prof.refit_series,
+            chunk=prof.refit_chunk, phase1_iters=0, no_phase1_tune=True,
+        )
+        registry = ParamRegistry(os.path.join(base, "registry"), cfg)
+        v1 = orchestrate.publish_fit_state(
+            registry, out_dir, ids, step=np.ones(prof.refit_series),
+            data_stamp=plane.delta_seq(dset),
+        )
+        pool = ReplicaPool(os.path.join(base, "pool"), registry.root,
+                           n_replicas=max(2, prof.pool_replicas),
+                           heartbeat_s=0.2, breaker_reset_s=0.3,
+                           spawn_timeout_s=180.0)
+        pool.start()
+        first = pool.forecast([str(ids[0])], 5)
+        assert first.get("ok") and first.get("version") == v1, first
+
+        delta_rec = plane.land_synthetic_delta(dset, prof.refit_churn)
+
+        # ---- the kill: refit child with delta_publish armed ---------
+        inj = storm.direct("refit-kill")
+        child_plan = faults.FaultPlan(
+            state_dir=os.path.join(base, "faults")
+        )
+        child_plan.fail("delta_publish", attempts=1, after=inj.after,
+                        mode="exit", rc=inj.rc, tag="refit-kill")
+        env = orchestrate._child_env()
+        env[faults.ENV_VAR] = child_plan.to_env()
+        obs.inject_env(env)
+        refit_scratch = os.path.join(base, "refit_scratch")
+        cmd = [sys.executable, "-m", "tsspark_tpu.refit",
+               "--data", dset, "--registry", registry.root,
+               "--scratch", refit_scratch,
+               "--chunk", str(prof.refit_chunk),
+               "--max-iters", str(prof.max_iters), "--no-activate"]
+        child = subprocess.run(cmd, env=env, stdout=sys.stderr,
+                               timeout=deadline_s)
+        t_fault = time.time()
+        obs.event("fault", tag="refit-kill", mode="direct",
+                  rc=child.returncode)
+        fired = inv.fault_firing_times(
+            child_plan.state_dir,
+            {child_plan.rules[0]["id"]: "refit-kill"},
+            child_plan.rules,
+        ).get("refit-kill", [])
+
+        # ---- mid-kill probes: only the last COMPLETE version serves -
+        active_after_kill = registry.active_version()
+        probe = pool.forecast([str(ids[0])], 5)
+        probe_ok = bool(probe.get("ok")
+                        and probe.get("version") == v1)
+
+        # ---- successor: resume from landed flushes, publish, flip ---
+        res = refit.run_refit(
+            data_dir=dset, registry=registry, scratch=refit_scratch,
+            chunk=prof.refit_chunk, solver_config=solver,
+            warm_start=True, pool=pool,
+            hot_series=[str(s) for s in ids[:8]], horizons=(5, 7),
+        )
+        v2 = res.get("version")
+        recovered = None
+        deadline = time.time() + 30.0
+        while v2 is not None and time.time() < deadline:
+            resp = pool.forecast([str(ids[1])], 5)
+            if resp.get("ok") and resp.get("version") == v2:
+                recovered = time.time() - t_fault
+                break
+            pool.ensure_alive()
+            time.sleep(0.1)
+        mttr["refit-kill"] = recovered
+        if recovered is not None:
+            obs.event("recovered", tag="refit-kill")
+
+        # ---- invariants (an incomplete successor must FAIL the
+        # ---- invariant, never crash the storm report) ---------------
+        v1_dir = registry.version_dir(v1)
+        if v2 is not None:
+            info = registry.delta_info(v2) or {}
+            bitwise = inv.refit_unchanged_bitwise(
+                v1_dir, registry.version_dir(v2),
+                info.get("changed_rows") or (),
+            )
+        else:
+            bitwise = {"ok": False,
+                       "errors": ["successor published no version"]}
+        wrong_version = pool.wrong_version
+        inv_refit = {
+            "ok": (child.returncode != 0 and len(fired) == 1
+                   and active_after_kill == v1 and probe_ok
+                   and wrong_version == 0
+                   and bool(res.get("complete"))
+                   and res.get("fit_dispatches") == 0
+                   and recovered is not None and bitwise["ok"]),
+            "child_rc": child.returncode,
+            "fault_fired": len(fired),
+            "active_after_kill": active_after_kill,
+            "served_v1_after_kill": probe_ok,
+            "wrong_version": wrong_version,
+            "successor_complete": bool(res.get("complete")),
+            "successor_fit_dispatches": res.get("fit_dispatches"),
+            "unchanged_bitwise": bitwise,
+        }
+        errs = []
+        if child.returncode == 0:
+            errs.append("refit child survived its armed delta_publish "
+                        "exit fault")
+        if res.get("fit_dispatches"):
+            errs.append("successor re-dispatched fit waves instead of "
+                        "resuming from landed flushes")
+        if not probe_ok or wrong_version:
+            errs.append("pool served something other than the last "
+                        "complete version after the kill")
+        if errs:
+            inv_refit["errors"] = errs
+        stage = {
+            "wall_s": round(time.time() - t0, 3),
+            "delta_seq": delta_rec["seq"],
+            "n_changed": res.get("n_changed"),
+            "v1": v1, "v2": v2,
+            "child_rc": child.returncode,
+            "successor": {k: res.get(k) for k in
+                          ("fit_dispatches", "resumed", "wall_s",
+                           "publish_s", "flip_s")},
+        }
+        return stage, {"refit_delta_publish": inv_refit}
+    finally:
+        if pool is not None:
+            pool.stop()
+        if env_plan is not None:
+            os.environ[faults.ENV_VAR] = env_plan
+
+
 def run_storm(seed: int = 0, profile: str = "full",
               scratch: Optional[str] = None,
               keep_scratch: bool = False,
@@ -1061,6 +1241,14 @@ def run_storm(seed: int = 0, profile: str = "full",
                     time.time(),
                 ))
 
+        # ---- stage H: delta-refit engine under storm -----------------
+        if prof.refit_series:
+            with obs.span("stage.refit", series=prof.refit_series):
+                stages["refit"], refit_inv = _run_refit_storm(
+                    scratch, storm, mttr, deadline_s
+                )
+            invariants.update(refit_inv)
+
         # ---- cross-stage invariants ----------------------------------
         if out_dir is not None:
             corrupt_injected = sum(
@@ -1186,6 +1374,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "pool_requests": prof.pool_requests,
                 "plane_series": prof.plane_series,
                 "resident_series": prof.resident_series,
+                "refit_series": prof.refit_series,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),

@@ -223,6 +223,58 @@ def plane_consistent(spec, root: str) -> Dict:
             "shards": len(plane.shard_ranges(spec)), "errors": errs}
 
 
+def refit_unchanged_bitwise(base_vdir: str, new_vdir: str,
+                            changed_rows) -> Dict:
+    """Delta-publish parity: every per-series column of the NEW
+    version's snapshot plane must be bitwise the base version's on the
+    UNCHANGED rows (copy-forward preserved them; a scatter that bled
+    into a neighboring row — or a torn copy — breaks this), and the new
+    plane must pass its own CRC sentinel."""
+    import json
+
+    from tsspark_tpu.serve import snapplane
+
+    errs: List[str] = []
+    try:
+        with open(os.path.join(base_vdir, snapplane.SNAP_SPEC)) as fh:
+            spec = json.load(fh)
+    except (OSError, ValueError) as e:
+        return {"ok": False, "errors": [f"base spec unreadable: {e}"]}
+    n = int(spec.get("n_series", 0))
+    changed = np.unique(np.asarray(changed_rows, np.int64))
+    unchanged = np.setdiff1d(np.arange(n, dtype=np.int64), changed)
+    compared = []
+    for name in spec.get("columns") or {}:
+        try:
+            base = np.load(
+                os.path.join(base_vdir, f"{snapplane.COL_PREFIX}{name}.npy"),
+                mmap_mode="r",
+            )
+            new = np.load(
+                os.path.join(new_vdir, f"{snapplane.COL_PREFIX}{name}.npy"),
+                mmap_mode="r",
+            )
+        except (OSError, ValueError) as e:
+            errs.append(f"column {name}: unreadable ({e})")
+            continue
+        if not np.array_equal(np.asarray(base[unchanged]),
+                              np.asarray(new[unchanged])):
+            errs.append(
+                f"column {name}: unchanged rows differ from the base "
+                "version (copy-forward broke bitwise stability)"
+            )
+        compared.append(name)
+    if not snapplane.verify_plane(new_vdir):
+        errs.append("new version's plane fails its CRC sentinel")
+    return {
+        "ok": not errs,
+        "columns_compared": compared,
+        "n_unchanged": int(len(unchanged)),
+        "n_changed": int(len(changed)),
+        **({"errors": errs} if errs else {}),
+    }
+
+
 def fault_firing_times(state_dir: str, rule_cls: Dict[str, str],
                        rules: List[dict]) -> Dict[str, List[float]]:
     """Per-class wall-clock firing times, read off the fault plan's

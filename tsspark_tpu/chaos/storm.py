@@ -66,6 +66,19 @@ Mesh-resident class (the single-program fit path, profiles with
                      last LANDED checkpoint flush, finish with
                      exactly-once coverage, and assemble a state
                      bitwise equal to a fault-free reference
+
+Delta-refit class (tsspark_tpu.refit, profiles with ``refit_series``
+> 0):
+
+  refit-kill         the delta-refit child dies (exit fault at the
+                     ``delta_publish`` point) MID DELTA-PUBLISH — after
+                     its warm waves landed, while the new version's
+                     copy-forward columns are half-written: the pool
+                     must keep serving only the last complete version
+                     (zero wrong-version), a successor must resume from
+                     the landed chunk flushes (zero refit dispatches)
+                     and re-publish, and the final snapshot's UNCHANGED
+                     rows must be bitwise the prior active version's
 """
 
 from __future__ import annotations
@@ -132,6 +145,9 @@ class StormProfile:
     plane_shard_rows: int = 16
     resident_series: int = 0
     resident_chunk: int = 8
+    refit_series: int = 0
+    refit_chunk: int = 8
+    refit_churn: float = 0.25
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -167,6 +183,7 @@ PROFILES: Dict[str, StormProfile] = {
         recovery_budget_s=150.0, pool_replicas=2, pool_requests=48,
         plane_series=64, plane_shard_rows=16,
         resident_series=32, resident_chunk=8,
+        refit_series=32, refit_chunk=8, refit_churn=0.25,
     ),
 }
 
@@ -333,6 +350,16 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
             cls="resident-kill", stage="resident",
             point="resident_flush", mode="exit",
             after=rng.randrange(0, max(1, n_waves - 1)), attempts=1,
+            rc=rng.choice((17, 23, 29)),
+        ))
+
+    # -- delta-refit stage (the harness arms the child's PRIVATE fault
+    # -- plan at the delta_publish point; ``after`` picks which
+    # -- copy-forward column write the kill lands between) ------------
+    if prof.refit_series:
+        inj.append(Injection(
+            cls="refit-kill", stage="refit", point="delta_publish",
+            mode="direct", after=rng.randrange(2, 8),
             rc=rng.choice((17, 23, 29)),
         ))
 

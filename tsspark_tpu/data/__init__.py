@@ -27,11 +27,15 @@ from tsspark_tpu.data.datasets import (
 from tsspark_tpu.data.plane import (
     DatasetSpec,
     GENERATORS,
+    advanced_since,
     dataset_fingerprint,
     default_root,
+    delta_seq,
     ensure,
     generate_rows,
     import_batch,
+    land_delta,
+    land_synthetic_delta,
     open_batch,
     ready_coverage,
 )
@@ -40,9 +44,10 @@ __all__ = [
     "SEED_BLOCK", "SeriesBatch", "dataset_ids", "demo_weekly_rows",
     "m4_hourly_like", "m5_like", "m5_rows", "peyton_manning_like",
     "wiki_logistic_like",
-    "DatasetSpec", "GENERATORS", "dataset_fingerprint", "default_root",
-    "ensure", "generate_rows", "import_batch", "open_batch",
-    "ready_coverage",
+    "DatasetSpec", "GENERATORS", "advanced_since",
+    "dataset_fingerprint", "default_root", "delta_seq", "ensure",
+    "generate_rows", "import_batch", "land_delta",
+    "land_synthetic_delta", "open_batch", "ready_coverage",
     "load_m4", "load_m5",
 ]
 

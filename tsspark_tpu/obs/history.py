@@ -147,6 +147,13 @@ def _bench_row(rep: Dict[str, Any]) -> Dict[str, Any]:
              extra.get("resident_series_per_s"))
     for k in ("first_flush_s", "compile_misses", "n_chunks"):
         _put(m, k, perf.get(k))
+    # Delta-refit rows (bench --delta; tsspark_tpu.refit): cycle
+    # throughput over the CHANGED set, the cycle wall as a fraction of
+    # the same run's measured cold fit+publish wall, and the flip-window
+    # cache carry-forward — budgeted in [tool.tsspark.slo.bench].
+    for k in ("delta_series_per_s", "delta_wall_frac", "cache_carried",
+              "flip_hit_rate"):
+        _put(m, k, extra.get(k))
     # The fit path rides the workload key: resident and chunk-file runs
     # of the same shape are DIFFERENT workloads to the regression
     # sentinel — their throughput baselines must never mix.  Only the
@@ -157,6 +164,12 @@ def _bench_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     fit_path = extra.get("fit_path")
     if workload and fit_path and fit_path != "fileproto":
         workload = f"{workload}+{fit_path}"
+    # Delta cycles additionally scope on the churn fraction: a 1%-churn
+    # cycle's wall must never baseline a 30%-churn cycle's (and the
+    # delta metric name already keeps them clear of cold-fit rows).
+    delta_churn = extra.get("delta_churn")
+    if workload and delta_churn is not None:
+        workload = f"{workload}+delta{delta_churn}"
     return {
         "kind": "bench",
         "trace_id": extra.get("trace_id"),

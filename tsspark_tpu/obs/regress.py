@@ -66,6 +66,11 @@ DEFAULT_SLO: Dict[str, Any] = {
                               "slack_abs": 0.02},
             "smape_insample_mean": {"direction": "lower",
                                     "max_rise_frac": 0.05},
+            "delta_series_per_s": {"direction": "higher",
+                                   "max_drop_frac": 0.5},
+            "delta_wall_frac": {"direction": "lower",
+                                "max_rise_frac": 0.5,
+                                "slack_abs": 0.05},
         },
         "serve": {
             "p50_ms": {"direction": "lower", "max_rise_frac": 1.0,

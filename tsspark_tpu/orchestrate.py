@@ -274,7 +274,8 @@ def load_fit_state(out_dir: str, n_series: int):
 
 
 def publish_fit_state(registry, out_dir: str, series_ids,
-                      step=None, activate: bool = True) -> int:
+                      step=None, activate: bool = True,
+                      data_stamp=None) -> int:
     """Assemble a completed run's chunk coverage and publish it as one
     serve-registry version (tsspark_tpu.serve.registry.ParamRegistry).
 
@@ -286,10 +287,16 @@ def publish_fit_state(registry, out_dir: str, series_ids,
     any sub-daily/weekly workload.  Integrity/coverage gates are
     ``load_fit_state``'s: a torn or incomplete run raises instead of
     publishing a partial version.  Returns the published version.
+
+    ``data_stamp``: the data plane's delta coverage stamp this run was
+    fitted at (``data.plane.delta_seq``) — recorded in the registry
+    manifest so the delta-refit engine (``tsspark_tpu.refit``) can
+    later claim exactly the series that advanced past this version.
     """
     ids = normalize_series_ids(series_ids)
     state = load_fit_state(out_dir, len(ids))
-    return registry.publish(state, ids, step=step, activate=activate)
+    return registry.publish(state, ids, step=step, activate=activate,
+                            data_stamp=data_stamp)
 
 
 def normalize_series_ids(series_ids):

@@ -1,0 +1,681 @@
+"""Incremental delta-refit engine: refit cost scales with CHANGED series.
+
+PR 12's 1M rung pays ~52 s of resident fit per refresh even when 1% of
+the fleet gained a row — every refit today is a cold full-fleet fit.
+This module closes ROADMAP item 4's perf core: an always-on loop where
+each cycle touches only the series whose DATA actually advanced.
+
+One ``run_refit`` cycle:
+
+1. **detect** — the data plane's row-advance accounting
+   (``data.plane.advanced_since``): the active registry version records
+   the delta coverage stamp it was fitted at
+   (``ParamRegistry.version_stamp``), and the changed set is exactly
+   the rows of every delta landed after it.  The set is pinned in an
+   atomic ``refit_plan.json`` so a killed cycle's successor refits the
+   SAME plan instead of racing fresh deltas mid-flight.
+2. **plan + fit** — the changed rows are compacted into a dense
+   ``[0, n_changed)`` claim space and run through the PR 11
+   mesh-resident path (``tsspark_tpu.resident``) over a gathered spill:
+   the SAME ``plan_chunks``/lease/chunk-file machinery, so 10% churn
+   produces ~10% of the waves and a SIGKILLed cycle resumes from its
+   landed flushes.  Waves are **warm-started** from the active
+   snapshot's theta, mmap-gathered per wave off the snapshot plane
+   (``warm_theta_gather`` — only the touched pages are read), under the
+   recorded PR 11 parity constraints: no buffer donation under
+   pipelined overlap, >=2 rows/shard sub-mesh rule, ``use_theta0`` as a
+   dynamic arg so warm and cold waves share one compiled program.
+   ``warm_start=False`` is bitwise the cold resident path.
+3. **delta publish** — ``ParamRegistry.publish_delta`` /
+   ``snapplane.write_plane_delta``: the new version's plane
+   copy-forwards unchanged rows from the active plane (vectorized
+   scatter of the refit rows into a sequential copy; a column no
+   changed row lands in — and EVERY column on a zero-delta cycle — is
+   hardlinked wholesale, zero new snapshot bytes).
+4. **flip** — through the PR 10 materialize/drain path
+   (``ReplicaPool.activate`` when a pool is attached, or the engine's
+   prefetch/materialize/activate analog), with partial cache
+   invalidation: unchanged series' forecast-cache entries carry
+   forward to the new version (``ForecastCache.carry_forward``).
+
+``run_delta_bench`` (``bench --delta``) sweeps churn fractions at the
+scale-ladder rungs and stamps ``delta_series_per_s`` +
+``delta_wall_frac`` (delta cycle wall over the same run's measured cold
+fit+publish wall) into bench-family reports the regression sentinel
+baselines under ``+delta<churn>``-scoped workload keys.
+
+See docs/PERF.md "Delta refit" for engage rules and reading guidance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from tsspark_tpu import orchestrate
+from tsspark_tpu.obs import context as obs
+from tsspark_tpu.utils.atomic import atomic_write
+
+#: The cycle's pinned plan: base version, coverage stamps, the changed
+#: row set — replaced atomically, so a successor after a mid-cycle kill
+#: resumes exactly this claim set (never a fresh detect that would race
+#: deltas landed after the kill).
+REFIT_PLAN_FILE = "refit_plan.json"
+
+
+def warm_theta_gather(theta, idx):
+    """Warm-start gather: rows ``idx`` of the active snapshot's theta,
+    float32, NaN/inf scrubbed (a warm INIT must never smuggle a poison
+    value into the solver — correctness never depends on init quality).
+
+    Host arrays (the snapshot plane's memmap) take the numpy path —
+    fancy indexing reads only the touched pages, which is what makes
+    the per-wave gather O(wave), not O(fleet).  Traced values take the
+    jnp path; the analysis gate's kernel-contract matrix traces this
+    function under ``enable_x64`` so an f64 leak in the gather (the
+    classic un-pinned-dtype drift) surfaces statically."""
+    if isinstance(theta, np.ndarray):
+        rows = np.take(np.asarray(theta), np.asarray(idx, np.int64),
+                       axis=0)
+        return np.nan_to_num(rows).astype(np.float32)
+    import jax.numpy as jnp
+
+    rows = jnp.take(jnp.asarray(theta), jnp.asarray(idx), axis=0)
+    return jnp.nan_to_num(rows).astype(jnp.float32)
+
+
+def read_refit_plan(scratch: str) -> Optional[Dict]:
+    """The pinned plan in ``scratch``, or None (absent/torn)."""
+    try:
+        with open(os.path.join(scratch, REFIT_PLAN_FILE)) as fh:
+            d = json.load(fh)
+        return d if isinstance(d, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _write_refit_plan(scratch: str, plan: Dict) -> None:
+    atomic_write(
+        os.path.join(scratch, REFIT_PLAN_FILE),
+        lambda fh: json.dump(plan, fh), mode="w",
+    )
+
+
+def run_refit(
+    *,
+    data_dir: str,
+    registry,
+    scratch: str,
+    chunk: int = 512,
+    solver_config=None,
+    phase1_iters: int = 0,
+    no_phase1_tune: bool = True,
+    warm_start: bool = True,
+    pool=None,
+    hot_series: Optional[Sequence[str]] = None,
+    horizons: Sequence[int] = (7, 14),
+    activate: bool = True,
+    flip_fn: Optional[Callable[[int], None]] = None,
+    deadline: Optional[float] = None,
+) -> Dict:
+    """One delta-refit cycle: detect -> warm resident fit over the
+    changed set -> copy-forward delta publish -> flip.  Returns the
+    cycle's metrics dict (versions, per-stage walls, dispatch count).
+
+    ``registry`` is an attached ``ParamRegistry`` with an ACTIVE
+    version whose snapshot plane exists (the warm-start source and the
+    copy-forward base).  ``scratch`` persists across cycles: the
+    current plan plus a per-(base-version, stamp) cycle dir whose chunk
+    files make a killed cycle resumable.  The flip goes through
+    ``pool.activate`` (the PR 10 materialize/drain path) when a pool is
+    attached, else ``flip_fn`` when given, else ``registry.activate``;
+    ``activate=False`` publishes without flipping (the chaos child —
+    the harness's front owns the flip).
+
+    Zero-delta fast path: no advanced series -> zero fit dispatches,
+    a fully-hardlinked version (zero new snapshot bytes), and the
+    serving side keeps returning bitwise-identical forecasts.
+    """
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.serve import snapplane
+
+    t_cycle0 = time.time()
+    os.makedirs(scratch, exist_ok=True)
+    if solver_config is None:
+        solver_config = SolverConfig()
+    base_version = registry.active_version()
+    if base_version is None:
+        from tsspark_tpu.serve.registry import RegistryError
+
+        raise RegistryError("no-active-version",
+                            "delta refit needs an active base version")
+
+    # ---- detect: pin (or resume) the plan ---------------------------
+    t0 = time.time()
+    plan = read_refit_plan(scratch)
+    resumed = bool(plan is not None and not plan.get("complete")
+                   and plan.get("base_version") == int(base_version))
+    if not resumed:
+        base_stamp = registry.version_stamp(int(base_version))
+        plan_stamp = plane.delta_seq(data_dir)
+        changed = plane.advanced_since(data_dir, base_stamp)
+        plan = {
+            "base_version": int(base_version),
+            "base_stamp": int(base_stamp),
+            "plan_stamp": int(plan_stamp),
+            "n_changed": int(len(changed)),
+            "changed_rows": [int(r) for r in changed.tolist()],
+            "complete": False,
+        }
+        _write_refit_plan(scratch, plan)
+    changed = np.asarray(plan["changed_rows"], np.int64)
+    n_changed = int(plan["n_changed"])
+    detect_s = time.time() - t0
+    obs.record("refit.detect", t0, detect_s, n_changed=n_changed,
+               base_version=int(base_version), resumed=resumed)
+
+    cycle_dir = os.path.join(
+        scratch,
+        f"cycle_v{plan['base_version']:06d}_s{plan['plan_stamp']:06d}",
+    )
+    result: Dict = {
+        "base_version": int(base_version),
+        "base_stamp": plan["base_stamp"],
+        "plan_stamp": plan["plan_stamp"],
+        "n_changed": n_changed,
+        "resumed": resumed,
+        "warm_start": bool(warm_start),
+        "detect_s": round(detect_s, 3),
+        "fit_dispatches": 0,
+        "fit_s": 0.0,
+    }
+
+    state_sub = None
+    step_sub = None
+    if n_changed:
+        # ---- fit: compacted claim space through the resident path ---
+        ddir = os.path.join(cycle_dir, "delta_data")
+        out_dir = os.path.join(cycle_dir, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        # Gate on the PLAN's spilled flag, not file presence: each spill
+        # file is individually atomic but the set is not — a kill
+        # between columns would leave ds.npy without mask.npy, and a
+        # presence check would resume against half a gather.  Re-spilling
+        # before the flag is safe (no chunk file can exist yet).
+        if not plan.get("spilled"):
+            batch = plane.open_batch(data_dir)
+            sub = lambda a: (None if a is None
+                             else np.ascontiguousarray(a[changed]))
+            orchestrate.spill_data(
+                ddir, np.asarray(batch.ds), sub(batch.y),
+                mask=sub(batch.mask), regressors=sub(batch.regressors),
+                cap=sub(batch.cap),
+            )
+            plan = dict(plan, spilled=True)
+            _write_refit_plan(scratch, plan)
+        orchestrate.save_run_config(out_dir, registry.config,
+                                    solver_config)
+
+        theta0_fn = None
+        base_view = None
+        base_vdir = registry.version_dir(int(base_version))
+        if warm_start:
+            try:
+                # verify=False: the registry CRC-swept this plane when
+                # it was loaded for serving; a warm INIT cannot affect
+                # correctness (warm_theta_gather scrubs non-finite
+                # values), so the refit skips a second full sweep.
+                base_view = snapplane.attach(base_vdir, verify=False)
+            except snapplane.SnapshotPlaneError:
+                import warnings
+
+                warnings.warn(
+                    f"refit: base version {base_version} has no "
+                    "readable snapshot plane; warm start disabled for "
+                    "this cycle (cold ridge init — results stay "
+                    "correct, the warm-start perf lever is lost)",
+                    RuntimeWarning,
+                )
+        if base_view is not None:
+            theta_mm = base_view.state.theta
+
+            def theta0_fn(lo, hi):
+                # Per-wave mmap gather: base rows of this wave's slice
+                # of the compacted changed set — touched pages only.
+                return warm_theta_gather(theta_mm, changed[lo:hi])
+
+        from tsspark_tpu import resident
+
+        chunks_before = len(orchestrate.completed_ranges(out_dir))
+        t0 = time.time()
+        fit_state = resident.run_resident(
+            data_dir=ddir, out_dir=out_dir, series=n_changed,
+            chunk=int(chunk), phase1_iters=phase1_iters,
+            no_phase1_tune=no_phase1_tune, autotune=False,
+            deadline=deadline, theta0_fn=theta0_fn,
+        )
+        result["fit_s"] = round(time.time() - t0, 3)
+        result["fit_path"] = fit_state.get("fit_path")
+        result["fit_dispatches"] = (
+            len(orchestrate.completed_ranges(out_dir)) - chunks_before
+        )
+        if not fit_state.get("complete"):
+            result["complete"] = False
+            result["wall_s"] = round(time.time() - t_cycle0, 3)
+            return result
+        state_sub = orchestrate.load_fit_state(out_dir, n_changed)
+        if base_view is not None and "step" in base_view.extras:
+            step_sub = np.asarray(
+                base_view.extras["step"][changed], np.float64
+            )
+
+    # ---- delta publish: copy-forward + scatter ----------------------
+    t0 = time.time()
+    v_new = registry.publish_delta(
+        state_sub, changed, base_version=int(base_version),
+        step_sub=step_sub, data_stamp=plan["plan_stamp"],
+        activate=False,
+    )
+    result["version"] = int(v_new)
+    result["publish_s"] = round(time.time() - t0, 3)
+
+    # ---- flip: PR 10 materialize/drain ------------------------------
+    t0 = time.time()
+    if pool is not None:
+        pool.activate(v_new, hot_series=list(hot_series or ()),
+                      horizons=tuple(horizons))
+    elif flip_fn is not None:
+        flip_fn(int(v_new))
+    elif activate:
+        registry.activate(int(v_new))
+    result["flip_s"] = round(time.time() - t0, 3)
+    result["flipped"] = bool(pool is not None or flip_fn is not None
+                             or activate)
+
+    plan = dict(plan, complete=True, published_version=int(v_new))
+    _write_refit_plan(scratch, plan)
+    # Completed cycle dirs are dead weight (the plan is done); reap
+    # every cycle dir, including this one — the next cycle keys a new
+    # one off its own (base version, stamp).
+    for name in os.listdir(scratch):
+        if name.startswith("cycle_"):
+            shutil.rmtree(os.path.join(scratch, name),
+                          ignore_errors=True)
+    result["complete"] = True
+    result["wall_s"] = round(time.time() - t_cycle0, 3)
+    obs.record("refit.cycle", t_cycle0, result["wall_s"],
+               n_changed=n_changed, version=result.get("version"),
+               warm_start=bool(warm_start))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# bench --delta: the churn-fraction sweep
+# ---------------------------------------------------------------------------
+
+#: Churn fractions ``bench --delta`` sweeps by default.
+DEFAULT_CHURNS = (0.01, 0.1, 0.3)
+
+
+def parse_churns(spec: Optional[str]):
+    """Churn fractions from a ``--churns`` CLI string (None -> the
+    defaults).  ONE parser for both entry points (bench.py --delta and
+    python -m tsspark_tpu.refit --delta-bench)."""
+    if not spec:
+        return DEFAULT_CHURNS
+    return tuple(float(c) for c in spec.split(","))
+
+
+def sweep_ok(reports: Sequence[Dict]) -> bool:
+    """The sweep's pass/fail contract — every cycle complete AND
+    sentinel-green — reduced in ONE place so the two entry points'
+    exit codes can never diverge.  Success reports are bench-shaped
+    (``complete`` lives under ``extra``); failure records carry it at
+    the top level — accept both, and an EMPTY sweep is a failure."""
+    if not reports:
+        return False
+    return all(
+        bool(r.get("complete", (r.get("extra") or {}).get("complete")))
+        and r.get("sentinel_ok", True)
+        for r in reports
+    )
+
+
+#: A delta-bench run tree untouched this long is reaped on the next
+#: sweep: each invocation keys a fresh ``run_<unix>`` dir (the cold
+#: fit must be a real measurement, never a warm resume), so without an
+#: age gate repeated sweeps accumulate rung-sized chunk/registry trees
+#: forever.
+STALE_RUN_S = 6 * 3600.0
+
+
+def _sweep_stale_runs(scratch: str, keep: str,
+                      max_age_s: float = STALE_RUN_S) -> int:
+    removed = 0
+    try:
+        names = os.listdir(scratch)
+    except OSError:
+        return 0
+    for name in names:
+        d = os.path.join(scratch, name)
+        if (not name.startswith("run_") or not os.path.isdir(d)
+                or os.path.abspath(d) == os.path.abspath(keep)):
+            continue
+        try:
+            import glob as glob_mod
+
+            newest = max(
+                (os.path.getmtime(p) for p in
+                 glob_mod.glob(os.path.join(d, "**"), recursive=True)),
+                default=os.path.getmtime(d),
+            )
+        except OSError:
+            continue
+        if time.time() - newest > max_age_s:
+            shutil.rmtree(d, ignore_errors=True)
+            removed += 1
+    return removed
+
+
+def _delta_report(rung, churn: float, cold: Dict, res: Dict,
+                  serve_stats: Dict, cfg) -> Dict:
+    """One bench-family report per (rung, churn): the regression
+    sentinel keys its workload ``...+delta<churn>`` (obs.history), so
+    delta cycles are never baselined against cold fits."""
+    import jax
+
+    from tsspark_tpu.config import NUMERICS_REV
+    from tsspark_tpu.obs.history import git_rev
+    from tsspark_tpu.utils import checkpoint as ckpt
+
+    n_changed = res["n_changed"]
+    fit_s = res.get("fit_s") or 0.0
+    wall = res["wall_s"]
+    cold_wall = cold["fit_s"] + cold["publish_s"]
+    extra = {
+        "trace_id": obs.trace_id(),
+        "numerics_rev": NUMERICS_REV,
+        "git_rev": git_rev(),
+        "config_fingerprint": ckpt.config_fingerprint(cfg),
+        "device": str(jax.devices()[0]),
+        "complete": bool(res.get("complete")),
+        "fit_path": res.get("fit_path", "resident"),
+        "warm_start": res.get("warm_start"),
+        "delta_churn": churn,
+        "n_changed": n_changed,
+        "series_done": n_changed,
+        "series_total": rung.series,
+        "delta_series_per_s": (round(n_changed / fit_s, 2)
+                               if fit_s and n_changed else None),
+        "delta_wall_frac": (round(wall / cold_wall, 4)
+                            if cold_wall else None),
+        "cold_fit_s": round(cold["fit_s"], 3),
+        "cold_publish_s": round(cold["publish_s"], 3),
+        "cold_wall_s": round(cold_wall, 3),
+        "detect_s": res.get("detect_s"),
+        "fit_s": round(fit_s, 3),
+        "publish_s": res.get("publish_s"),
+        "flip_s": res.get("flip_s"),
+        "fit_dispatches": res.get("fit_dispatches"),
+        "version": res.get("version"),
+        **serve_stats,
+    }
+    return {
+        "metric": (f"delta_{rung.name}_{rung.series}x{rung.timesteps}"
+                   "_refit_wall"),
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": 0.0,
+        "unix": round(time.time(), 3),
+        "extra": extra,
+    }
+
+
+def run_delta_bench(rung="smoke",
+                    churns: Sequence[float] = DEFAULT_CHURNS,
+                    data_root: Optional[str] = None,
+                    scratch_root: Optional[str] = None,
+                    sentinel: Optional[bool] = None) -> List[Dict]:
+    """``bench --delta``: cold-fit one scale-ladder rung, then sweep
+    ``churns`` — land a synthetic advance, run one warm delta-refit
+    cycle (detect -> fit -> delta publish -> engine-materialized flip),
+    and measure the flip-window cache carry-forward.  One bench-family
+    ``BENCH_delta_*`` artifact per churn, each judged by the regression
+    sentinel.
+
+    The rung's plane dataset lives under a PRIVATE data root (deltas
+    mutate landed rows in place; the shared cache's bytes must stay
+    bitwise-stable for every other bench).  The cold fit runs in a
+    fresh out dir each invocation so ``cold_wall`` is always a real
+    measured fit, never a warm resume."""
+    import tempfile
+
+    from tsspark_tpu import bench_scale, resident
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.serve.cache import ForecastCache
+    from tsspark_tpu.serve.engine import PredictionEngine
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    if isinstance(rung, str):
+        rung = bench_scale.RUNGS[rung]
+    cfg = bench_scale._config()
+    solver = SolverConfig(max_iters=rung.max_iters)
+    scratch = os.path.join(
+        scratch_root or tempfile.gettempdir(),
+        f"tsdelta_{rung.name}_{rung.series}x{rung.timesteps}"
+        f"_{plane.dataset_fingerprint()}",
+    )
+    os.makedirs(scratch, exist_ok=True)
+    prev_run = obs.start_run(os.path.join(scratch, "spans.jsonl"))
+    reports: List[Dict] = []
+    try:
+        droot = data_root or os.path.join(scratch, "plane")
+        spec = plane.DatasetSpec(
+            generator="demo_weekly", n_series=rung.series,
+            n_timesteps=rung.timesteps, seed=2,
+        )
+        dset_dir = plane.ensure(spec, root=droot)
+        ids = plane.series_ids(spec)
+
+        # ---- cold reference: resident fit + publish, fresh out dir --
+        run_dir = os.path.join(scratch, f"run_{int(time.time())}")
+        _sweep_stale_runs(scratch, keep=run_dir)
+        out_dir = os.path.join(run_dir, "cold_out")
+        os.makedirs(out_dir, exist_ok=True)
+        orchestrate.save_run_config(out_dir, cfg, solver)
+        t0 = time.time()
+        cold_state = resident.run_resident(
+            data_dir=dset_dir, out_dir=out_dir, series=rung.series,
+            chunk=rung.chunk, phase1_iters=0, no_phase1_tune=True,
+        )
+        cold_fit_s = time.time() - t0
+        if not cold_state.get("complete"):
+            print("[delta] cold fit incomplete; aborting the sweep",
+                  file=sys.stderr)
+            return [{"complete": False, "stage": "cold-fit"}]
+        registry = ParamRegistry(os.path.join(run_dir, "registry"), cfg)
+        t0 = time.time()
+        orchestrate.publish_fit_state(
+            registry, out_dir, ids,
+            data_stamp=plane.delta_seq(dset_dir),
+        )
+        cold = {"fit_s": cold_fit_s, "publish_s": time.time() - t0,
+                "fit_path": cold_state.get("fit_path")}
+        print(json.dumps({"delta_bench": rung.name,
+                          "cold_fit_s": round(cold_fit_s, 3),
+                          "cold_publish_s": round(cold["publish_s"], 3),
+                          "fit_path": cold["fit_path"]}), flush=True)
+
+        # ---- serving side: in-process engine, warm hot set ----------
+        sample, _ = bench_scale._request_mix(rung, ids)
+        hot = [str(s) for s in sample[:rung.hot]]
+        engine = PredictionEngine(registry, cache=ForecastCache())
+        engine.materialize(hot, bench_scale.HORIZONS)
+
+        for churn in churns:
+            t0 = time.time()
+            delta_rec = plane.land_synthetic_delta(dset_dir, churn)
+            land_s = time.time() - t0
+            # Idempotent re-warm: the flip-window stats must start from
+            # a warm steady state, not a cold cache.
+            engine.materialize(hot, bench_scale.HORIZONS)
+            stats0 = engine.cache.stats()
+
+            def flip_fn(v):
+                # The engine analog of the pool's materialize/drain
+                # flip: prefetch (plane CRC sweep = page warming),
+                # materialize the hot set into the warm window, flip.
+                engine.prefetch(v)
+                engine.materialize(hot, bench_scale.HORIZONS, version=v)
+                registry.activate(v)
+
+            res = run_refit(
+                data_dir=dset_dir, registry=registry,
+                scratch=os.path.join(run_dir, "refit"),
+                chunk=rung.chunk, solver_config=solver,
+                warm_start=True, flip_fn=flip_fn,
+                horizons=bench_scale.HORIZONS,
+            )
+            if not res.get("complete"):
+                # Same graceful failure as the cold-fit path: record
+                # the incomplete cycle instead of crashing the sweep.
+                print(f"[delta] churn {churn}: refit cycle incomplete; "
+                      f"stopping the sweep", file=sys.stderr)
+                reports.append({"complete": False, "stage": "refit",
+                                "churn": churn, **res})
+                break
+            # Flip-window loadgen over the hot set: carried entries
+            # serve unchanged series without a dispatch — the hit-rate
+            # win partial invalidation buys.
+            changed_ids = set(
+                (registry.delta_info(res["version"]) or {})
+                .get("changed_ids") or ()
+            )
+            n_req = 0
+            for sid in hot:
+                engine.forecast([sid], bench_scale.HORIZONS[0])
+                n_req += 1
+            stats1 = engine.cache.stats()
+            d_hits = stats1["hits"] - stats0["hits"]
+            d_total = (stats1["hits"] + stats1["misses"]
+                       - stats0["hits"] - stats0["misses"])
+            serve_stats = {
+                "land_s": round(land_s, 3),
+                "delta_seq": delta_rec["seq"],
+                "cache_carried": stats1["carried"] - stats0["carried"],
+                "flip_requests": n_req,
+                "flip_hit_rate": (round(d_hits / d_total, 4)
+                                  if d_total else None),
+                "hot_changed": sum(1 for s in hot if s in changed_ids),
+            }
+            rep = _delta_report(rung, churn, cold, res, serve_stats,
+                                cfg)
+            path = (f"BENCH_delta_{rung.name}_c{int(churn * 1000):04d}"
+                    f"_{int(rep['unix'])}.json")
+            atomic_write(path,
+                         lambda fh: json.dump(rep, fh, indent=1),
+                         mode="w")
+            rep["path"] = path
+            print(json.dumps({
+                "rung": rung.name, "churn": churn,
+                "n_changed": res["n_changed"],
+                "delta_wall_s": res["wall_s"],
+                "delta_wall_frac": rep["extra"]["delta_wall_frac"],
+                "delta_series_per_s":
+                    rep["extra"]["delta_series_per_s"],
+                "cache_carried": serve_stats["cache_carried"],
+                "flip_hit_rate": serve_stats["flip_hit_rate"],
+                "report": path,
+            }), flush=True)
+            if sentinel is None:
+                sentinel_on = (os.environ.get("TSSPARK_SENTINEL", "1")
+                               != "0")
+            else:
+                sentinel_on = sentinel
+            if sentinel_on:
+                try:
+                    from tsspark_tpu.obs import regress
+
+                    verdict = regress.sentinel_report(
+                        rep, source=path
+                    )
+                    if verdict is not None:
+                        print(f"[delta] {regress.summarize(verdict)}",
+                              file=sys.stderr)
+                        rep["sentinel_ok"] = verdict["ok"]
+                except Exception as e:  # never mask the report
+                    print(f"[delta] sentinel skipped: {e!r}",
+                          file=sys.stderr)
+            reports.append(rep)
+        return reports
+    finally:
+        obs.end_run(prev_run)
+
+
+# ---------------------------------------------------------------------------
+# CLI (python -m tsspark_tpu.refit): one cycle as a killable process
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Run one delta-refit cycle (or the churn-sweep bench) as its own
+    process — the fault-isolatable unit the refit-kill chaos class
+    SIGKILLs mid delta-publish.  Adopts the spawner's trace."""
+    from tsspark_tpu.resident import force_virtual_host_mesh
+
+    force_virtual_host_mesh()
+    ap = argparse.ArgumentParser(prog="python -m tsspark_tpu.refit")
+    ap.add_argument("--data", help="plane dataset dir")
+    ap.add_argument("--registry", help="serve registry root")
+    ap.add_argument("--scratch", help="refit scratch dir")
+    ap.add_argument("--chunk", type=int, default=512)
+    ap.add_argument("--max-iters", type=int, default=20)
+    ap.add_argument("--phase1-iters", type=int, default=0)
+    ap.add_argument("--cold", action="store_true",
+                    help="disable the warm start (bitwise the cold "
+                         "resident path over the changed set)")
+    ap.add_argument("--no-activate", action="store_true",
+                    help="publish without flipping (a pool front owns "
+                         "the flip)")
+    ap.add_argument("--delta-bench", default=None, metavar="RUNG",
+                    help="run the churn-fraction sweep at a scale "
+                         "rung instead of one cycle")
+    ap.add_argument("--churns", default=None,
+                    help="comma-separated churn fractions for "
+                         "--delta-bench")
+    args = ap.parse_args(argv)
+    obs.adopt_env()
+    if args.delta_bench:
+        reports = run_delta_bench(args.delta_bench,
+                                  churns=parse_churns(args.churns))
+        return 0 if sweep_ok(reports) else 1
+    if not (args.data and args.registry and args.scratch):
+        ap.error("--data, --registry and --scratch are required for a "
+                 "refit cycle")
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    registry = ParamRegistry.open(args.registry)
+    res = run_refit(
+        data_dir=args.data, registry=registry, scratch=args.scratch,
+        chunk=args.chunk,
+        solver_config=SolverConfig(max_iters=args.max_iters),
+        phase1_iters=args.phase1_iters,
+        warm_start=not args.cold,
+        activate=not args.no_activate,
+    )
+    print(json.dumps(res), flush=True)
+    return 0 if res.get("complete") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

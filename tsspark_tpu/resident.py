@@ -170,6 +170,7 @@ def run_resident(
     mesh=None,
     state: Optional[dict] = None,
     fallback_opts: Optional[dict] = None,
+    theta0_fn: Optional[Callable[[int, int], "object"]] = None,
 ) -> dict:
     """Run the whole fit as one mesh-resident sharded program stream.
 
@@ -192,6 +193,21 @@ def run_resident(
     max_fruitless_retries, ...) — a wedged-accelerator box falls back
     WITH the caller's probe-budget protections, not the library
     defaults (bench.py forwards its usual resilience wiring here).
+
+    ``theta0_fn``: optional warm start — ``fn(lo, hi)`` returns a host
+    ``(hi - lo, n_params)`` float32 init for the wave's REAL rows (pad
+    rows are zero-filled here); phase 1 then dispatches with
+    ``use_theta0`` ON instead of the ridge init.  The delta-refit
+    engine (``tsspark_tpu.refit``) gathers these rows per wave off the
+    active snapshot plane's theta memmap.  ``use_theta0`` is a DYNAMIC
+    traced arg, so warm and cold waves share one compiled program, and
+    ``theta0_fn=None`` leaves the cold path bit-for-bit untouched (the
+    bitwise-parity contract).  The init buffer is placed with
+    ``device_put`` and NOT donated — the recorded PR 11 constraint:
+    donation under pipelined overlap corrupts shard results on the
+    forced-host multi-device backend.  The meshless fallback runs COLD
+    (the chunk-file workers have no warm-start input); correctness is
+    unchanged, only the warm-start perf lever is lost.
     """
     global _MESHLESS_WARNED
     if state is None:
@@ -235,6 +251,7 @@ def run_resident(
             phase1_iters=phase1_iters, no_phase1_tune=no_phase1_tune,
             autotune=autotune, pipeline_depth=pipeline_depth,
             deadline=deadline, reserve=reserve, mesh=mesh, state=state,
+            theta0_fn=theta0_fn,
         )
         complete = (rc == 0 and not orchestrate.missing_ranges(
             orchestrate.completed_ranges(out_dir), series
@@ -259,7 +276,7 @@ def run_resident(
 
 def _resident_body(*, data_dir, out_dir, series, chunk, phase1_iters,
                    no_phase1_tune, autotune, pipeline_depth, deadline,
-                   reserve, mesh, state) -> int:
+                   reserve, mesh, state, theta0_fn=None) -> int:
     jax = orchestrate._setup_jax_child()
     import numpy as np
 
@@ -378,6 +395,16 @@ def _resident_body(*, data_dir, out_dir, series, chunk, phase1_iters,
         if width not in _zeros_theta:
             _zeros_theta[width] = np.zeros((width, n_params), np.float32)
         return jax.device_put(_zeros_theta[width], theta_sharding(k))
+
+    def theta_init(lo, hi, width, k):
+        """The wave's init buffer: zeros (ridge init — the cold path,
+        bit-for-bit the PR 11 program) or the caller's warm rows padded
+        to the wave width (same placement, same no-donation rule)."""
+        if theta0_fn is None:
+            return theta_zeros(width, k)
+        host = np.zeros((width, n_params), np.float32)
+        host[:hi - lo] = np.asarray(theta0_fn(lo, hi), np.float32)
+        return jax.device_put(host, theta_sharding(k))
 
     def prep(lo, hi, width):
         """Pack rows [lo, hi) padded to ``width`` — the chunk workers'
@@ -558,9 +585,11 @@ def _resident_body(*, data_dir, out_dir, series, chunk, phase1_iters,
                 snap = compile_watch.size()
                 sharded = shard_payload(packed, k)
                 theta, stats = sharding_mod.fit_resident_core(
-                    sharded, theta_zeros(width, k), model_config,
+                    sharded, theta_init(lo, hi, width, k), model_config,
                     solver_config, reg_u8_cols=u8_cols,
-                    **phase1_dynamic_args(depth["v"], False, packed=True),
+                    **phase1_dynamic_args(depth["v"],
+                                          theta0_fn is not None,
+                                          packed=True),
                 )
                 compiled = compile_watch.size() > snap
                 return (lo, hi, width, b_real, meta, theta, stats,

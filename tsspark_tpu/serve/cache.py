@@ -76,6 +76,7 @@ class ForecastCache:
         self.misses = 0
         self.invalidations = 0
         self.evicted = 0
+        self.carried = 0
 
     def __len__(self) -> int:
         return len(self._data)
@@ -130,6 +131,48 @@ class ForecastCache:
                 evictions
             )
 
+    def carry_forward(self, old_version, new_version,
+                      changed_ids) -> int:
+        """Delta-flip cache migration: re-key ``old_version``'s entries
+        for series NOT in ``changed_ids`` to ``new_version``.  A delta
+        publish copy-forwards unchanged series' parameters bitwise, so
+        their cached forecasts are exactly what the new version would
+        compute — dropping them (the full-flip behavior) would turn a
+        1%-churn flip into a 100% cold cache.  Changed series are left
+        to miss and recompute.  Must run BEFORE ``invalidate`` settles
+        the flip (the engine's refresh hook orders the two); counted in
+        ``stats()["carried"]``.  The capacity bound holds through the
+        warm window: migrated entries evict LRU exactly like ``put``
+        (at worst the base version's coldest entries go first — they
+        are about to be invalidated anyway).  Returns the entries
+        migrated."""
+        if self.capacity <= 0:
+            return 0
+        moved = evictions = 0
+        with self._lock:
+            for key in list(self._data):
+                if not (isinstance(key, tuple) and key
+                        and key[0] == old_version):
+                    continue
+                if key[1] in changed_ids:
+                    continue
+                new_key = (new_version,) + key[1:]
+                if new_key not in self._data:
+                    self._data[new_key] = self._data[key]
+                    moved += 1
+            while len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                evictions += 1
+            self.carried += moved
+            self.evicted += evictions
+        if evictions:
+            from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+            METRICS.counter("tsspark_serve_cache_evicted").inc(
+                evictions
+            )
+        return moved
+
     def invalidate(self, version: Optional[int] = None) -> int:
         """Drop entries for versions OTHER than ``version`` (``None``
         drops everything and clears the version gate).  Returns the
@@ -169,4 +212,5 @@ class ForecastCache:
             "hit_rate": round(self.hits / total, 4) if total else 0.0,
             "invalidations": self.invalidations,
             "evicted": self.evicted,
+            "carried": self.carried,
         }

@@ -374,8 +374,29 @@ class PredictionEngine:
     # -- snapshot lifecycle ----------------------------------------------------
 
     def _on_activate(self, version: Optional[int]) -> None:
+        if version is not None:
+            self._carry_cache_forward(version)
         self.cache.invalidate(version)
         self._snapshot = None
+
+    def _carry_cache_forward(self, new_version: int) -> None:
+        """Partial cache invalidation on a DELTA flip: when the version
+        being activated is a delta publish, unchanged series'
+        parameters are bitwise the base version's, so their cached
+        forecasts migrate to the new version instead of being dropped
+        with the rest (``ForecastCache.carry_forward``).  Runs before
+        the ``invalidate`` that settles the flip; a full publish (no
+        delta metadata) keeps the drop-everything behavior."""
+        try:
+            info = self.registry.delta_info(int(new_version))
+        except Exception:
+            return  # torn/racing manifest: fall back to the full drop
+        if not info or info.get("base_version") is None:
+            return
+        self.cache.carry_forward(
+            info["base_version"], int(new_version),
+            set(info.get("changed_ids") or ()),
+        )
 
     def refresh(self) -> Snapshot:
         """The current active snapshot, reloading on version flips.
@@ -418,6 +439,7 @@ class PredictionEngine:
                 # window elapses.  The next pump retries (the breaker
                 # gate keeps retries cheap while it stays open).
                 return snap
+            self._carry_cache_forward(loaded.version)
             self.cache.invalidate(loaded.version)
             self._snapshot = loaded
             self._active_seen = active
@@ -513,6 +535,17 @@ class PredictionEngine:
                     and pre.version == int(version)
                     else self.prefetch(version))
         self.cache.allow_version(snap.version)
+        # Delta flip: migrate unchanged series' cached rows into the
+        # warm window FIRST — the materialization loop below then
+        # computes only what carry-forward cannot cover (the refit
+        # series), which is the whole point of a delta publish.  Gated
+        # on warming a version this engine is NOT yet serving: once the
+        # flip settles, re-materializing the (delta) active version
+        # must not re-read the delta manifest and rescan the cache
+        # under its lock per call.  A full publish is a no-op either
+        # way.
+        if snap.version != self.served_version():
+            self._carry_cache_forward(snap.version)
         ids = list(dict.fromkeys(str(s) for s in series_ids))
         _, missing = snap.rows(ids)
         absent = set(missing)

@@ -333,6 +333,20 @@ class ParamRegistry:
             int(v) for v in self._read_manifest()["versions"]
         ))
 
+    def version_dir(self, version: int) -> str:
+        """Absolute directory of a published version's snapshot files
+        (the manifest's ``path`` field resolved against the root) —
+        the supported way for out-of-registry readers (the delta-refit
+        engine's warm-start gather, the chaos bitwise probes) to reach
+        a version's plane without manifest-layout knowledge."""
+        entry = self._read_manifest()["versions"].get(str(int(version)))
+        if entry is None:
+            raise RegistryError(
+                "unknown-version",
+                f"version {version} was never published",
+            )
+        return os.path.join(self.root, entry["path"])
+
     def active_version(self) -> Optional[int]:
         return self._read_manifest()["active_version"]
 
@@ -341,7 +355,8 @@ class ParamRegistry:
     def publish(self, state: FitState, series_ids,
                 step: Optional[np.ndarray] = None,
                 activate: bool = True,
-                snapshot_format: Optional[str] = None) -> int:
+                snapshot_format: Optional[str] = None,
+                data_stamp: Optional[int] = None) -> int:
         """Persist one snapshot as the next version (snapshot files
         first, manifest last); optionally activate it.  Returns the new
         version number.  Concurrent publishers serialize on the
@@ -352,7 +367,13 @@ class ParamRegistry:
         ``snapshot_format`` (default: the registry's) — the plane is
         what the engine and pool replicas map as one shared page-cache
         copy; the npz is the per-version fallback when a plane shard
-        tears."""
+        tears.
+
+        ``data_stamp``: the data plane's delta coverage stamp
+        (``data.plane.delta_seq``) this snapshot was fitted at —
+        recorded in the manifest entry so the delta-refit engine can
+        later ask ``advanced_since(stamp)`` for exactly the series this
+        version is stale for."""
         t_pub0 = time.time()
         fmt = snapshot_format or self.snapshot_format
         if fmt not in SNAPSHOT_FORMATS:
@@ -405,6 +426,8 @@ class ParamRegistry:
                 "formats": sorted(
                     ({"both": ("mmap", "npz")}.get(fmt, (fmt,)))
                 ),
+                **({"data_stamp": int(data_stamp)}
+                   if data_stamp is not None else {}),
             }
             if activate:
                 m["previous_version"] = m["active_version"]
@@ -416,6 +439,114 @@ class ParamRegistry:
         if activate:
             self._notify(version)
         return version
+
+    def publish_delta(self, sub_state: Optional[FitState], changed_rows,
+                      *, base_version: Optional[int] = None,
+                      step_sub: Optional[np.ndarray] = None,
+                      data_stamp: Optional[int] = None,
+                      activate: bool = True) -> int:
+        """Delta publish: the next version as a COPY-FORWARD of
+        ``base_version`` (default: the active one) with only
+        ``changed_rows`` replaced by ``sub_state``'s refit rows —
+        ``serve.snapplane.write_plane_delta``: unchanged rows are
+        bitwise the base plane's (untouched columns hardlink wholesale;
+        a zero-row delta hardlinks EVERYTHING — zero new snapshot
+        bytes).  Delta versions are plane-only (no archival npz: the
+        npz would re-serialize the whole fleet, defeating the delta);
+        a torn delta plane degrades down the active->previous chain
+        like any plane-only version.  Returns the new version."""
+        t_pub0 = time.time()
+        with self._locked():
+            m = self._read_manifest()
+            if base_version is None:
+                base_version = m["active_version"]
+            if base_version is None:
+                raise RegistryError(
+                    "no-active-version",
+                    "delta publish needs a base version",
+                )
+            base_entry = m["versions"].get(str(int(base_version)))
+            if base_entry is None:
+                raise RegistryError(
+                    "unknown-version",
+                    f"delta base {base_version} was never published",
+                )
+            version = max((int(v) for v in m["versions"]), default=0) + 1
+            while os.path.exists(os.path.join(self.root,
+                                              f"v{version:06d}")):
+                version += 1
+            vdir = f"v{version:06d}"
+            os.makedirs(os.path.join(self.root, vdir))
+        base_vdir = os.path.join(self.root, base_entry["path"])
+        if not snapplane.has_plane(base_vdir):
+            raise RegistryError(
+                "delta-base-missing-plane",
+                f"version {base_version} has no snapshot plane; delta "
+                "publish copy-forwards plane columns — republish the "
+                "base with snapshot_format 'both' or 'mmap' first",
+            )
+        changed = np.unique(np.asarray(changed_rows, np.int64))
+        extras_sub = None
+        if step_sub is not None and len(changed):
+            extras_sub = {"step": np.asarray(step_sub, np.float64)}
+        snapplane.write_plane_delta(
+            os.path.join(self.root, vdir), base_vdir, changed,
+            sub_state, extras_sub=extras_sub,
+            base_version=int(base_version), data_stamp=data_stamp,
+            fingerprint=ckpt.config_fingerprint(self.config),
+            numerics_rev=self.numerics_rev,
+        )
+        with self._locked():
+            m = self._read_manifest()
+            m["versions"][str(version)] = {
+                "path": vdir,
+                "n_series": int(base_entry["n_series"]),
+                "published_unix": round(time.time(), 3),
+                "formats": ["mmap"],
+                "delta_from": int(base_version),
+                "n_changed": int(len(changed)),
+                **({"data_stamp": int(data_stamp)}
+                   if data_stamp is not None else {}),
+            }
+            if activate:
+                m["previous_version"] = m["active_version"]
+                m["active_version"] = version
+            self._write_manifest(m)
+        obs.record("registry.publish_delta", t_pub0,
+                   time.time() - t_pub0, version=version,
+                   base_version=int(base_version),
+                   n_changed=int(len(changed)),
+                   activated=bool(activate))
+        if activate:
+            self._notify(version)
+        return version
+
+    def delta_info(self, version: int) -> Optional[Dict]:
+        """Delta-publish metadata of ``version`` (base version + the
+        changed-id set), or None for a full publish.  What the engine's
+        cache carry-forward reads on a delta flip."""
+        m = self._read_manifest()
+        entry = m["versions"].get(str(int(version)))
+        if entry is None or entry.get("delta_from") is None:
+            return None
+        manifest = snapplane.read_delta_manifest(
+            os.path.join(self.root, entry["path"])
+        )
+        if manifest is None:
+            return None
+        return dict(manifest, version=int(version))
+
+    def version_stamp(self, version: int) -> int:
+        """The data-plane delta coverage stamp ``version`` was fitted
+        at (0 for pre-delta publishes — everything ever advanced is
+        then considered new)."""
+        entry = self._read_manifest()["versions"].get(str(int(version)))
+        if entry is None:
+            raise RegistryError(
+                "unknown-version",
+                f"version {version} was never published",
+            )
+        return int(entry.get("data_stamp") or 0)
 
     def activate(self, version: int) -> None:
         """Flip the active pointer to an already-published version."""

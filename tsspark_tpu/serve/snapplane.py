@@ -67,6 +67,12 @@ SNAP_SPEC = "snap_spec.json"
 SNAP_OK = "snapok.json"
 COL_PREFIX = "snapcol_"
 
+#: Delta-publish metadata (``write_plane_delta``): base version, the
+#: changed-row/id set, and the data-plane coverage stamp — what the
+#: serving side reads to carry unchanged series' cache entries forward
+#: across a delta flip instead of dropping the whole version's cache.
+DELTA_MANIFEST = "delta_manifest.json"
+
 #: CRC shard width (rows).  Shards bound what one torn write can hide
 #: behind a stale CRC and give the chaos harness a named unit to tear;
 #: 64k rows keeps the sentinel a few entries even at 1M series.
@@ -176,6 +182,136 @@ def write_plane(vdir: str, state: FitState, ids: np.ndarray,
     }
     atomic_write(os.path.join(vdir, SNAP_OK),
                  lambda fh: json.dump(sentinel, fh), mode="w")
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+    """Share ``src``'s bytes into ``dst``: hardlink (zero new snapshot
+    bytes — columns are write-once, so sharing the inode across
+    versions is safe) with a copy fallback for cross-device roots."""
+    try:
+        os.link(src, dst)
+    except OSError:
+        import shutil
+
+        shutil.copy2(src, dst)
+
+
+def write_plane_delta(vdir: str, base_vdir: str, changed_rows,
+                      sub_state: Optional[FitState], *,
+                      extras_sub: Optional[Dict[str, np.ndarray]] = None,
+                      base_version: Optional[int] = None,
+                      data_stamp: Optional[int] = None,
+                      fingerprint: Optional[str] = None,
+                      numerics_rev: Optional[int] = None) -> Dict:
+    """Copy-forward delta publish: land a NEW version's plane in
+    ``vdir`` from the base version's plane in ``base_vdir`` plus a
+    refit over only ``changed_rows`` (``sub_state`` has one row per
+    changed series, base row order).
+
+    Per column:
+
+    * a column whose rows cannot have changed (the id index triple, an
+      extra the caller did not refit) — or ANY column when the changed
+      set is empty (the zero-delta fast path) — is HARDLINKED
+      wholesale: zero new snapshot bytes, and the base sentinel's CRCs
+      are reused verbatim;
+    * a refit column is copy-forwarded: one sequential read of the base
+      memmap into a fresh buffer, a vectorized scatter of the changed
+      rows, one atomic save.  Unchanged rows are therefore BITWISE the
+      base version's — the delta-publish parity contract the refit-kill
+      chaos invariant checks — and CRCs are recomputed only for shards
+      a changed row actually lands in (untouched shards reuse the base
+      CRC: copy-forward preserved their bytes).
+
+    Protocol order is ``write_plane``'s: spec first, columns, sentinel
+    LAST, then the delta manifest (pure metadata — the registry
+    manifest referencing ``vdir`` is the real visibility gate).  The
+    ``delta_publish`` fault point is armed per column so the chaos
+    harness can kill a publisher mid-plane.  Returns the delta
+    manifest record."""
+    from tsspark_tpu.resilience import faults
+
+    base_spec = _read_json(os.path.join(base_vdir, SNAP_SPEC))
+    base_ok = _read_json(os.path.join(base_vdir, SNAP_OK))
+    if base_spec is None or base_ok is None:
+        raise SnapshotPlaneError(
+            "absent", f"{base_vdir}: delta publish needs the base "
+            "version's snapshot plane (spec + sentinel)"
+        )
+    n = int(base_spec.get("n_series", -1))
+    shard_rows = int(base_spec.get("shard_rows", DEFAULT_SHARD_ROWS))
+    changed = np.unique(np.asarray(changed_rows, np.int64))
+    if len(changed) and (changed[0] < 0 or changed[-1] >= n):
+        raise ValueError(f"changed rows outside [0, {n})")
+    sub_cols: Dict[str, np.ndarray] = {}
+    if len(changed):
+        if sub_state is None:
+            raise ValueError("sub_state required for a non-empty delta")
+        sub_cols = state_columns(sub_state, extras_sub)
+        for name in ("ids", "ids_sorted", "id_order"):
+            sub_cols.pop(name, None)
+        unknown = sorted(set(sub_cols) - set(base_spec["columns"]))
+        if unknown:
+            raise ValueError(
+                f"refit columns {unknown} not in the base plane — the "
+                "two versions' FitState layouts drifted; publish a full "
+                "snapshot instead"
+            )
+        for name, sub in sub_cols.items():
+            if sub.shape[0] != len(changed):
+                raise ValueError(
+                    f"column {name}: {sub.shape[0]} refit rows for "
+                    f"{len(changed)} changed series"
+                )
+    spec = dict(base_spec, fingerprint=fingerprint,
+                numerics_rev=numerics_rev,
+                delta_from=base_version, n_changed=int(len(changed)))
+    atomic_write(os.path.join(vdir, SNAP_SPEC),
+                 lambda fh: json.dump(spec, fh, indent=1), mode="w")
+    scattered: Dict[str, np.ndarray] = {}
+    for name in base_spec["columns"]:
+        src = _col_path(base_vdir, name)
+        dst = _col_path(vdir, name)
+        faults.inject("delta_publish")
+        if name not in sub_cols:
+            _link_or_copy(src, dst)
+            continue
+        base_mm = np.load(src, mmap_mode="r")
+        out = np.array(base_mm)        # copy-forward: one sequential read
+        del base_mm
+        out[changed] = np.asarray(sub_cols[name], out.dtype)
+        atomic_write(dst, lambda fh, a=out: np.save(fh, a))
+        scattered[name] = out
+    # Sentinel: recompute only (scattered column x touched shard) CRCs.
+    touched = set(np.unique(changed // shard_rows).tolist())
+    shards = []
+    for entry in base_ok.get("shards") or ():
+        lo, hi, crcs = int(entry[0]), int(entry[1]), dict(entry[2])
+        if lo // shard_rows in touched:
+            crcs.update(_shard_crcs(scattered, lo, hi))
+        shards.append([lo, hi, crcs])
+    sentinel = dict(base_ok, unix=round(time.time(), 3), shards=shards)
+    atomic_write(os.path.join(vdir, SNAP_OK),
+                 lambda fh: json.dump(sentinel, fh), mode="w")
+    ids_mm = np.load(_col_path(base_vdir, "ids"), mmap_mode="r")
+    manifest = {
+        "base_version": base_version,
+        "n_changed": int(len(changed)),
+        "changed_rows": [int(r) for r in changed.tolist()],
+        "changed_ids": [str(s) for s in ids_mm[changed]],
+        "data_stamp": data_stamp,
+        "unix": round(time.time(), 3),
+    }
+    del ids_mm
+    atomic_write(os.path.join(vdir, DELTA_MANIFEST),
+                 lambda fh: json.dump(manifest, fh), mode="w")
+    return manifest
+
+
+def read_delta_manifest(vdir: str) -> Optional[Dict]:
+    """The version's delta-publish metadata, or None for a full
+    (non-delta) version."""
+    return _read_json(os.path.join(vdir, DELTA_MANIFEST))
 
 
 @dataclasses.dataclass(frozen=True)
