@@ -484,6 +484,20 @@ def main() -> None:
     ap.add_argument("--churns", default=None,
                     help="comma-separated churn fractions for --delta "
                          "(default 0.01,0.1,0.3)")
+    ap.add_argument("--freshness", nargs="?", const="smoke",
+                    default=None, metavar="RUNG",
+                    help="sustained-churn freshness stream "
+                         "(tsspark_tpu.sched) at a scale rung ('smoke' "
+                         "default, or '30k'): land a hot-biased delta "
+                         "stream while the always-on scheduler runs "
+                         "serialized then pipelined cycles, measuring "
+                         "steady-state data-to-forecast freshness "
+                         "p50/p95 (docs/PERF.md \"Continuous refit & "
+                         "freshness\"); emits BENCH_freshness_*")
+    ap.add_argument("--reuse-cold", default=None, metavar="DIR",
+                    help="for --delta/--freshness: reuse (or record) "
+                         "the cold fit+publish reference under DIR so "
+                         "repeated sweeps amortize the cold fit")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for a quick pipeline check")
     ap.add_argument("--keep", action="store_true",
@@ -505,7 +519,18 @@ def main() -> None:
         from tsspark_tpu import refit
 
         reports = refit.run_delta_bench(
-            args.delta, churns=refit.parse_churns(args.churns)
+            args.delta, churns=refit.parse_churns(args.churns),
+            reuse_cold=args.reuse_cold,
+        )
+        sys.exit(0 if refit.sweep_ok(reports) else 1)
+    if args.freshness:
+        from tsspark_tpu.resident import force_virtual_host_mesh
+
+        force_virtual_host_mesh()
+        from tsspark_tpu import refit, sched
+
+        reports = sched.run_freshness_bench(
+            args.freshness, reuse_cold=args.reuse_cold,
         )
         sys.exit(0 if refit.sweep_ok(reports) else 1)
     if args.scale:

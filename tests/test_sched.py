@@ -1,0 +1,482 @@
+"""Always-on refit scheduler (tsspark_tpu.sched): pipelined loop,
+speculative warm prep, data-to-forecast freshness, crash resume, and
+the freshness SLO/history wiring."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tsspark_tpu import orchestrate, refit, resident, sched
+from tsspark_tpu.config import (
+    ProphetConfig,
+    SeasonalityConfig,
+    SolverConfig,
+)
+from tsspark_tpu.data import plane
+from tsspark_tpu.resilience import faults
+from tsspark_tpu.serve.cache import ForecastCache
+from tsspark_tpu.serve.engine import PredictionEngine
+from tsspark_tpu.serve.registry import ParamRegistry
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+    n_changepoints=3,
+)
+SOLVER = SolverConfig(max_iters=20)
+N, T, SHARD, CHUNK = 24, 64, 8, 8
+
+
+def _setup(tmp_path, seed=2):
+    """Fresh plane dataset + cold resident fit + published registry —
+    the same tiny shapes as tests/test_refit.py so the suite's compile
+    cache covers every dispatch here."""
+    spec = plane.DatasetSpec("demo_weekly", N, T, seed=seed,
+                             shard_rows=SHARD)
+    dset = plane.ensure(spec, root=str(tmp_path / "plane"))
+    ids = plane.series_ids(spec)
+    out = str(tmp_path / "cold_out")
+    os.makedirs(out, exist_ok=True)
+    orchestrate.save_run_config(out, CFG, SOLVER)
+    st = resident.run_resident(data_dir=dset, out_dir=out, series=N,
+                               chunk=CHUNK, phase1_iters=0,
+                               no_phase1_tune=True)
+    assert st["complete"]
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG)
+    v1 = orchestrate.publish_fit_state(
+        reg, out, ids, data_stamp=plane.delta_seq(dset)
+    )
+    return spec, dset, reg, ids, v1
+
+
+def _engine_loop(tmp_path, reg, ids, **kw):
+    """A scheduler wired to an in-process engine: flips go through the
+    prefetch/materialize/activate path, freshness probes are REAL
+    served requests (the metric's definition)."""
+    engine = PredictionEngine(reg, cache=ForecastCache(256))
+    hot = [str(s) for s in ids[:8]]
+    engine.materialize(hot, (7,))
+
+    def flip_fn(v):
+        engine.prefetch(v)
+        engine.materialize(hot, (7,), version=v)
+        reg.activate(v)
+
+    def probe(v):
+        return engine.forecast([hot[0]], 7).version
+
+    dset = kw.pop("dset")
+    loop = sched.RefitScheduler(
+        dset, reg, str(tmp_path / "sched"), chunk=CHUNK,
+        solver_config=SOLVER, flip_fn=flip_fn, freshness_probe=probe,
+        poll_s=0.02, debounce_s=0.02, spec_refresh_s=0.05, **kw,
+    )
+    return loop, engine
+
+
+# ---------------------------------------------------------------------------
+# idle discipline
+# ---------------------------------------------------------------------------
+
+
+def test_idle_ticks_never_publish(tmp_path, monkeypatch):
+    """Zero-delta idle ticks must not publish versions, accrue
+    RUNHISTORY rows, or grow the snapshot dir — the scheduler never
+    even enters the publish path without an advanced series."""
+    monkeypatch.chdir(tmp_path)
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    versions_before = reg.versions()
+    snap_dirs = sorted(os.listdir(reg.root))
+    loop = sched.RefitScheduler(
+        dset, reg, str(tmp_path / "sched"), chunk=CHUNK,
+        solver_config=SOLVER, poll_s=0.01, debounce_s=0.0,
+        spec_refresh_s=0.02,
+    )
+    summary = loop.run(duration_s=0.4)
+    assert summary["cycles"] == 0
+    assert reg.versions() == versions_before
+    assert sorted(os.listdir(reg.root)) == snap_dirs
+    assert not os.path.exists(str(tmp_path / "RUNHISTORY.jsonl"))
+    # The advisory state file exists and says so.
+    state = sched.read_sched_state(str(tmp_path / "sched"))
+    assert state is not None and state["cycles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the loop end to end
+# ---------------------------------------------------------------------------
+
+
+def test_pipelined_stream_serves_fresh_versions(tmp_path):
+    from tsspark_tpu.chaos import invariants as inv
+
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    loop, engine = _engine_loop(tmp_path, reg, ids, dset=dset,
+                                pipeline=True)
+    seq0 = plane.delta_seq(dset)
+
+    def lander():
+        for _ in range(3):
+            plane.land_synthetic_delta(dset, 0.2)
+            time.sleep(0.6)
+
+    t = threading.Thread(target=lander, daemon=True)
+    t.start()
+    summary = loop.run(until_stamp=seq0 + 3, duration_s=300)
+    t.join()
+    assert summary["ok"], summary
+    assert summary["cycles"] >= 1
+    assert summary["freshness"]["n"] == 3
+    assert summary["freshness"]["p95_s"] > 0
+    assert summary["wrong_version"] == 0
+    assert summary["pending_deltas"] == 0
+    v_final = summary["head_version"]
+    assert reg.active_version() == v_final
+    assert reg.version_stamp(v_final) == seq0 + 3
+    # Copy-forward parity holds on the final hop.
+    info = reg.delta_info(v_final)
+    check = inv.refit_unchanged_bitwise(
+        reg.version_dir(info["base_version"]),
+        reg.version_dir(v_final), info["changed_rows"],
+    )
+    assert check["ok"], check
+    # The engine really served the fresh version (probe path).
+    assert engine.forecast([str(ids[0])], 7).version == v_final
+
+
+def test_pipelined_and_serialized_converge_bitwise(tmp_path):
+    """The pipeline (and its carry/speculation theta cache) is a
+    latency lever, never a numerics input: the same delta stream
+    processed pipelined and serialized lands bitwise-identical
+    parameters.  Deterministic by construction — both roots share the
+    dataset seed, so land_synthetic_delta lands identical bytes."""
+    results = {}
+    for mode, sub in (("pipelined", "a"), ("serialized", "b")):
+        root = tmp_path / sub
+        root.mkdir()
+        spec, dset, reg, ids, v1 = _setup(root)
+        loop = sched.RefitScheduler(
+            dset, reg, str(root / "sched"), chunk=CHUNK,
+            solver_config=SOLVER, pipeline=(mode == "pipelined"),
+            poll_s=0.01, debounce_s=0.0, spec_refresh_s=0.02,
+        )
+        seq = plane.delta_seq(dset)
+        for i in range(2):
+            plane.land_synthetic_delta(dset, 0.2)
+            seq += 1
+            s = loop.run(until_stamp=seq, duration_s=300)
+            assert s["ok"], s
+        v = reg.active_version()
+        theta = np.array(np.load(
+            os.path.join(reg.version_dir(v), "snapcol_theta.npy"),
+            mmap_mode="r",
+        ))
+        results[mode] = theta
+    assert np.array_equal(results["pipelined"],
+                          results["serialized"])
+
+
+def test_scheduler_cli_resumes_after_flip_kill(tmp_path):
+    """The loop-storm semantic at test scale: the CLI daemon dies at an
+    armed ``sched_flip`` exit fault (version published, flip pending,
+    plan incomplete); a successor scheduler resumes the pinned plan
+    with ZERO new fit dispatches and completes the flip."""
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    plane.land_synthetic_delta(dset, 0.25)
+    scratch = str(tmp_path / "sched")
+    plan = faults.FaultPlan(state_dir=str(tmp_path / "faults"))
+    plan.fail("sched_flip", attempts=1, after=0, mode="exit", rc=29,
+              tag="loop-storm")
+    env = orchestrate._child_env()
+    env[faults.ENV_VAR] = plan.to_env()
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tsspark_tpu.sched",
+         "--data", dset, "--registry", reg.root, "--scratch", scratch,
+         "--chunk", str(CHUNK), "--max-iters", str(SOLVER.max_iters),
+         "--until-stamp", "1", "--duration", "120",
+         "--poll", "0.02", "--debounce", "0.02"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 29, proc.stderr[-2000:]
+    assert reg.active_version() == v1  # the kill never half-flipped
+    plan_rec = refit.read_refit_plan(scratch)
+    assert plan_rec is not None and not plan_rec.get("complete")
+    loop = sched.RefitScheduler(
+        dset, reg, scratch, chunk=CHUNK, solver_config=SOLVER,
+        poll_s=0.02, debounce_s=0.0,
+    )
+    summary = loop.run(until_stamp=1, duration_s=300)
+    assert summary["ok"], summary
+    assert summary["resumed_cycles"] == 1
+    # ONE cycle: the resumed publish advances the frontier, so the
+    # loop must not re-detect (and re-fit) the set it just covered.
+    assert summary["cycles"] == 1
+    assert summary["freshness"]["n"] == 1
+    v2 = summary["head_version"]
+    assert reg.active_version() == v2 and v2 != v1
+    assert reg.version_stamp(v2) == 1
+
+
+def test_resume_of_plan_based_on_unflipped_version(tmp_path):
+    """A front elsewhere owns the flip (activate=False): published
+    versions never become active, so a successor must resume a pinned
+    plan against the plan's OWN base — re-detecting from the stale
+    active pointer would re-fit already-published rows and race deltas
+    landed after the crash."""
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    scratch = str(tmp_path / "sched")
+    plane.land_synthetic_delta(dset, 0.2)
+    loop = sched.RefitScheduler(
+        dset, reg, scratch, chunk=CHUNK, solver_config=SOLVER,
+        activate=False, poll_s=0.01, debounce_s=0.0,
+    )
+    s1 = loop.run(until_stamp=1, duration_s=300)
+    assert s1["ok"], s1
+    v2 = s1["head_version"]
+    assert reg.active_version() == v1 and v2 != v1  # never flipped
+    # The next cycle pins against the unflipped head... then "dies".
+    plane.land_synthetic_delta(dset, 0.2)
+    plan = refit.draft_plan(dset, 1)
+    plan = refit.pin_drafted(scratch, plan, v2)
+    d2_rows = set(plan["changed_rows"])
+    successor = sched.RefitScheduler(
+        dset, reg, scratch, chunk=CHUNK, solver_config=SOLVER,
+        activate=False, poll_s=0.01, debounce_s=0.0,
+    )
+    s2 = successor.run(until_stamp=2, duration_s=300)
+    assert s2["ok"], s2
+    assert s2["resumed_cycles"] == 1  # the pinned plan, not a re-detect
+    v3 = s2["head_version"]
+    info = reg.delta_info(v3)
+    assert info["base_version"] == v2
+    assert set(info["changed_rows"]) == d2_rows  # delta-2 rows ONLY
+
+
+def test_publish_failure_is_retried_in_process(tmp_path):
+    """A transient publish/flip failure must be re-driven by the loop
+    itself (under backoff) — not parked until the next delta happens
+    to land or the process restarts."""
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    calls = {"n": 0}
+
+    def flaky_flip(v):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient flip outage")
+        reg.activate(v)
+
+    plane.land_synthetic_delta(dset, 0.2)
+    loop = sched.RefitScheduler(
+        dset, reg, str(tmp_path / "sched"), chunk=CHUNK,
+        solver_config=SOLVER, flip_fn=flaky_flip,
+        poll_s=0.01, debounce_s=0.0, backoff_base_s=0.05,
+    )
+    summary = loop.run(until_stamp=1, duration_s=300)
+    assert summary["ok"], summary  # the retry succeeded: streak reset
+    assert summary["failures"] == 1 and calls["n"] == 2
+    assert summary["cycles"] == 1
+    v = summary["head_version"]
+    assert reg.active_version() == v
+    assert reg.version_stamp(v) == 1
+    assert summary["pending_deltas"] == 0  # freshness resolved
+
+
+# ---------------------------------------------------------------------------
+# speculation
+# ---------------------------------------------------------------------------
+
+
+def test_arrival_model_predicts_recurring_rows():
+    model = sched.ArrivalModel(alpha=0.5)
+    hot = [3, 7, 11]
+    t0 = 1000.0
+    for seq in range(1, 6):
+        rows = hot + [17 + seq]  # hot set recurs; cold rows churn
+        model.note_delta(seq, t0 + 5.0 * seq, np.asarray(rows))
+    pred = model.predicted_rows(cap=3)
+    assert set(pred.tolist()) == set(hot)
+    # Idempotent by seq: replaying a record changes nothing.
+    tracked = model.tracked()
+    model.note_delta(5, t0 + 25.0, np.asarray(hot))
+    assert model.tracked() == tracked
+    # Bounded: the tracked set caps at max_tracked.
+    small = sched.ArrivalModel(max_tracked=4)
+    small.note_delta(1, t0, np.arange(10))
+    small.note_delta(2, t0 + 1, np.arange(10, 20))
+    assert small.tracked() <= 4
+
+
+def test_speculative_cache_hits_are_counted(tmp_path):
+    """A hot-biased stream gives the arrival model signal: the
+    speculative pre-gather must score hits against the next landed
+    delta, and a speculative init is bitwise the plane gather it
+    replaces (pinned via the theta cache path in fit_changed)."""
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    hot_rows = np.asarray([1, 5, 9, 13], np.int64)
+    # Seed the model's history: the same hot rows advance repeatedly.
+    loop, engine = _engine_loop(tmp_path, reg, ids, dset=dset)
+    seq = plane.delta_seq(dset)
+    for i in range(3):
+        plane.land_synthetic_delta(dset, 0.2, rows=hot_rows)
+        seq += 1
+        s = loop.run(until_stamp=seq, duration_s=300)
+        assert s["ok"], s
+        # Let an idle tick refresh the speculation between deltas.
+        loop.run(duration_s=0.15)
+    spec_stats = loop.spec_summary()
+    assert spec_stats["predicted"] > 0
+    assert spec_stats["hits"] > 0  # the recurring rows were predicted
+    assert spec_stats["hit_rate"] > 0
+
+
+def test_warm_theta_cache_is_bitwise_the_plane_gather(tmp_path):
+    """fit_changed with a theta cache must consume EXACTLY the bytes
+    the per-wave plane gather would produce — speculation can never
+    change an init."""
+    from tsspark_tpu.serve import snapplane
+
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    plane.land_synthetic_delta(dset, 0.25)
+    changed = plane.advanced_since(dset, 0)
+    view = snapplane.attach(reg.version_dir(v1), verify=False)
+    want = refit.warm_theta_gather(view.state.theta, changed)
+    # Cache half the rows; the consume path must merge cache + plane
+    # into the same array the pure plane gather yields.
+    half = changed[: len(changed) // 2]
+    cache = {"base_stamp": 0, "rows": half,
+             "theta": refit.warm_theta_gather(view.state.theta, half)}
+    plan = refit.draft_plan(dset, 0, base_version=v1)
+    refit.ensure_spill(dset, plan, str(tmp_path / "scr"))
+    # Exercise exactly the theta0_fn merge fit_changed builds: run the
+    # fit twice, cache on/off, and require bitwise-equal solutions.
+    r_cache = refit.fit_changed(
+        dset, reg, plan, str(tmp_path / "scr"), chunk=CHUNK,
+        solver_config=SOLVER, warm_start=True, theta_cache=cache,
+    )
+    assert r_cache["complete"] and r_cache["warm_cache_hits"] > 0
+    r_plain = refit.fit_changed(
+        dset, reg, plan, str(tmp_path / "scr2"), chunk=CHUNK,
+        solver_config=SOLVER, warm_start=True,
+    )
+    assert np.array_equal(np.asarray(r_cache["state_sub"].theta),
+                          np.asarray(r_plain["state_sub"].theta))
+    assert want.dtype == np.float32  # the gather contract held
+
+
+# ---------------------------------------------------------------------------
+# reuse-cold amortization
+# ---------------------------------------------------------------------------
+
+
+def test_reuse_cold_amortizes_the_reference(tmp_path):
+    from tsspark_tpu.bench_scale import ScaleRung
+
+    rung = ScaleRung("smoke", N, T, SOLVER.max_iters, CHUNK, 0, 8, 4,
+                     8, False)
+    base_dir = str(tmp_path / "coldbase")
+    os.makedirs(base_dir)
+    spec = plane.DatasetSpec("demo_weekly", N, T, seed=2,
+                             shard_rows=SHARD)
+    dset = plane.ensure(spec, root=os.path.join(base_dir, "plane"))
+    ids = plane.series_ids(spec)
+    reg1, cold1, catchup1 = refit.prepare_cold_registry(
+        rung, CFG, SOLVER, str(tmp_path / "run1"), dset, ids,
+        reuse_cold=base_dir,
+    )
+    assert reg1 is not None and not cold1["reused"]
+    assert catchup1 is None
+    meta = refit.load_cold_meta(base_dir, rung)
+    assert meta is not None and meta["fit_s"] == round(cold1["fit_s"], 3)
+    # Deltas land between sweeps; the reused base must CATCH UP
+    # (untimed) so measured cycles see only their own churn.
+    plane.land_synthetic_delta(dset, 0.25)
+    reg2, cold2, catchup2 = refit.prepare_cold_registry(
+        rung, CFG, SOLVER, str(tmp_path / "run2"), dset, ids,
+        reuse_cold=base_dir,
+    )
+    assert cold2["reused"] and cold2["fit_s"] == meta["fit_s"]
+    assert catchup2 is not None and catchup2["complete"]
+    active = reg2.active_version()
+    assert reg2.version_stamp(active) == plane.delta_seq(dset)
+    # A shape mismatch refuses reuse instead of serving a stale base.
+    other = ScaleRung("smoke", N + 8, T, SOLVER.max_iters, CHUNK, 0,
+                      8, 4, 8, False)
+    assert refit.load_cold_meta(base_dir, other) is None
+
+
+# ---------------------------------------------------------------------------
+# freshness metric / history / SLO wiring
+# ---------------------------------------------------------------------------
+
+
+def test_freshness_rows_get_mode_scoped_workload_keys():
+    from tsspark_tpu.obs import history
+
+    rep = {
+        "kind": "freshness-bench", "unix": 1.0, "trace_id": "t9",
+        "device": "cpu", "rung": "smoke", "mode": "pipelined",
+        "churn": 0.05, "complete": True,
+        "freshness_p50_s": 0.4, "freshness_p95_s": 0.9,
+        "freshness_vs_cold_frac": 0.2, "cycle_overhead_frac": 0.5,
+        "spec_hit_rate": 0.3, "cycles": 6, "wrong_version": 0,
+        "cold_wall_s": 4.0, "wall_s": 12.0,
+    }
+    row = history.row_from_report(rep)
+    assert row["kind"] == "freshness"
+    assert row["workload"] == "freshness_smoke_c0050+pipelined"
+    for k in ("freshness_p95_s", "cycle_overhead_frac",
+              "spec_hit_rate", "wrong_version"):
+        assert k in row["metrics"], k
+    # The serialized arm is a DIFFERENT workload — the p95 gap between
+    # the two is the bench's whole point, never baseline noise.
+    ser = history.row_from_report(dict(rep, mode="serialized"))
+    assert ser["workload"] != row["workload"]
+
+
+def test_freshness_slo_budgets_declared_everywhere():
+    from tsspark_tpu.obs import regress
+
+    for table in (regress.DEFAULT_SLO["budgets"]["freshness"],
+                  regress.load_slo()["budgets"]["freshness"]):
+        assert table["freshness_p95_s"]["direction"] == "lower"
+        assert table["cycle_overhead_frac"]["direction"] == "lower"
+        assert table["spec_hit_rate"]["direction"] == "higher"
+
+
+def test_freshness_spans_reach_obs_watch(tmp_path):
+    """The scheduler's refit.freshness spans are what `obs watch`
+    reads: live trailing-window p95 appears in the observation."""
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs import watch
+
+    scratch = tmp_path / "scr"
+    scratch.mkdir()
+    prev = obs.start_run(str(scratch / "spans.jsonl"))
+    try:
+        now = time.time()
+        for i, fr in enumerate((0.2, 0.5, 0.9)):
+            obs.record("refit.freshness", now - fr, fr, seq=i + 1,
+                       version=2, probe="serve")
+    finally:
+        obs.end_run(prev)
+    st = watch.observe_run(str(scratch), [])
+    assert st["freshness_p95_s"] == pytest.approx(0.86, abs=0.02)
+
+
+def test_cache_carried_metric_exported():
+    from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+    cache = ForecastCache(16)
+    for i in range(4):
+        cache.put((1, f"s{i}", 8, 0, 0), {"row": i})
+    before = METRICS.counter("tsspark_serve_cache_carried").value
+    moved = cache.carry_forward(1, 2, {"s0"})
+    assert moved == 3
+    assert METRICS.counter("tsspark_serve_cache_carried").value \
+        == before + 3

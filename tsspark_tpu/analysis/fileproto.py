@@ -262,6 +262,45 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "set instead of racing deltas landed after the kill",
     ),
     ArtifactSpec(
+        "refit-spill-ok", ("spillok.json",),
+        ("ensure_spill",),
+        "spill-set visibility marker inside a refit cycle dir "
+        "(tsspark_tpu.refit): each gathered spill column is "
+        "individually atomic but the SET is not — the marker, written "
+        "atomically LAST, is what lets a resumed (or pipelined-"
+        "prefetched) cycle trust the gather instead of re-spilling "
+        "against half a column set",
+    ),
+    ArtifactSpec(
+        "refit-cold-meta", ("cold_meta.json",),
+        ("save_cold_meta",),
+        "reusable cold-reference record (bench --delta/--freshness "
+        "--reuse-cold): the measured cold fit+publish walls plus the "
+        "shape/data-fingerprint identity that gates reuse; written "
+        "once atomically after the measurement, ignored whole when "
+        "stale",
+    ),
+    ArtifactSpec(
+        "sched-state", ("sched_state.json",),
+        ("RefitScheduler._write_sched_state",),
+        "always-on scheduler telemetry (tsspark_tpu.sched): cycle "
+        "counts, freshness summary, backoff state — replaced "
+        "atomically after every cycle so obs watch never parses a "
+        "torn record.  ADVISORY only: crash-recovery correctness "
+        "rides the refit-plan protocol, and a successor scheduler "
+        "tolerates this file missing entirely",
+    ),
+    ArtifactSpec(
+        "freshness-bench-report", ("BENCH_freshness_",),
+        ("_write_freshness_report",),
+        "freshness-stream report (bench --freshness; "
+        "tsspark_tpu.sched): steady-state data-to-forecast freshness "
+        "p50/p95 under a sustained churn stream, one artifact per "
+        "loop mode (serialized/pipelined), written once atomically "
+        "and judged by the regression sentinel under "
+        "[tool.tsspark.slo.freshness]",
+    ),
+    ArtifactSpec(
         "delta-bench-report", ("BENCH_delta_",),
         ("run_delta_bench",),
         "delta-refit churn-sweep report (bench --delta): one "
@@ -415,6 +454,7 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/orchestrate.py",
     "tsspark_tpu/resident.py",
     "tsspark_tpu/refit.py",
+    "tsspark_tpu/sched.py",
     "tsspark_tpu/data/plane.py",
     "tsspark_tpu/data/ingest.py",
     "tsspark_tpu/streaming/state.py",

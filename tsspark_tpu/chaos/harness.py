@@ -986,6 +986,292 @@ def _run_refit_storm(scratch: str, storm: StormPlan,
             os.environ[faults.ENV_VAR] = env_plan
 
 
+# ---------------------------------------------------------------------------
+# stage I: always-on scheduler loop under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_sched_storm(scratch: str, storm: StormPlan,
+                     mttr: Dict[str, Optional[float]],
+                     deadline_s: float) -> Tuple[Dict, Dict]:
+    """The loop-storm class: a CHAIN of scheduler (``python -m
+    tsspark_tpu.sched``) deaths, one per stage the always-on loop
+    drives — exit faults at ``sched_detect``, ``resident_flush``,
+    ``delta_publish`` and ``sched_flip``, each successor resuming the
+    SAME pinned ``refit_plan.json`` — then a raw SIGKILL of the
+    scheduler process mid-cycle, and a final in-process successor that
+    completes the backlog through the pool flip.
+
+    Invariants: every armed kill fired exactly once and killed its
+    child; the pool served ONLY the last complete version throughout
+    (zero wrong-version); successors resumed landed work (the chunk
+    flushes landed before a kill are never re-fit — pinned by mtime);
+    the final snapshot's unchanged rows are bitwise its base's; and
+    data-to-forecast freshness (delta land -> first pool-served
+    request at a covering version) recovers within the recovery
+    budget.
+
+    Runs with the STORM env plan popped, like the refit stage: each
+    child gets a PRIVATE single-point plan."""
+    import glob as glob_mod
+    import subprocess
+
+    from tsspark_tpu import orchestrate, refit, resident, sched
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.serve.pool import ReplicaPool
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    prof = storm.profile
+    base = os.path.join(scratch, "sched")
+    cfg, solver = _config(prof.max_iters)
+    t0 = time.time()
+    env_plan = os.environ.pop(faults.ENV_VAR, None)
+    pool = None
+    try:
+        # ---- setup: private plane, cold fit, publish v1, pool -------
+        spec = plane.DatasetSpec(
+            generator="demo_weekly", n_series=prof.refit_series,
+            n_timesteps=64, seed=storm.seed + 5,
+            shard_rows=prof.plane_shard_rows,
+        )
+        dset = plane.ensure(spec, root=os.path.join(base, "plane"))
+        ids = plane.series_ids(spec)
+        out_dir = os.path.join(base, "out")
+        os.makedirs(out_dir, exist_ok=True)
+        orchestrate.save_run_config(out_dir, cfg, solver)
+        resident.run_resident(
+            data_dir=dset, out_dir=out_dir, series=prof.refit_series,
+            chunk=prof.refit_chunk, phase1_iters=0,
+            no_phase1_tune=True,
+        )
+        registry = ParamRegistry(os.path.join(base, "registry"), cfg)
+        v1 = orchestrate.publish_fit_state(
+            registry, out_dir, ids, step=np.ones(prof.refit_series),
+            data_stamp=plane.delta_seq(dset),
+        )
+        pool = ReplicaPool(os.path.join(base, "pool"), registry.root,
+                           n_replicas=max(2, prof.pool_replicas),
+                           heartbeat_s=0.2, breaker_reset_s=0.3,
+                           spawn_timeout_s=180.0)
+        pool.start()
+        first = pool.forecast([str(ids[0])], 5)
+        assert first.get("ok") and first.get("version") == v1, first
+
+        delta1 = plane.land_synthetic_delta(dset, prof.refit_churn)
+        sched_scratch = os.path.join(base, "sched_scratch")
+
+        def spawn_child(point: Optional[Dict],
+                        timeout: float) -> Tuple:
+            """One scheduler child, optionally with a single armed exit
+            fault.  Returns (proc, fired_count)."""
+            env = orchestrate._child_env()
+            plan_dir = None
+            if point is not None:
+                child_plan = faults.FaultPlan(state_dir=os.path.join(
+                    base, "faults", point["point"]
+                ))
+                # Tagged distinctly from the class: the class's
+                # span-MTTR is the SIGKILL fault/recovered pair, and
+                # the chain's four armed kills must not become its
+                # "first fault" (they recover via the NEXT child, not
+                # the measured final successor).
+                child_plan.fail(point["point"], attempts=1,
+                                after=point["after"], mode="exit",
+                                rc=point["rc"], tag="loop-storm-kill")
+                env[faults.ENV_VAR] = child_plan.to_env()
+                plan_dir = child_plan
+            obs.inject_env(env)
+            cmd = [sys.executable, "-m", "tsspark_tpu.sched",
+                   "--data", dset, "--registry", registry.root,
+                   "--scratch", sched_scratch,
+                   "--chunk", str(prof.refit_chunk),
+                   "--max-iters", str(prof.max_iters),
+                   "--poll", "0.02", "--debounce", "0.02",
+                   "--until-stamp", str(plane.delta_seq(dset)),
+                   "--duration", "90", "--no-activate"]
+            proc = subprocess.Popen(cmd, env=env, stdout=sys.stderr)
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            fired = 0
+            if plan_dir is not None:
+                fired = len(inv.fault_firing_times(
+                    plan_dir.state_dir,
+                    {plan_dir.rules[0]["id"]: "loop-storm"},
+                    plan_dir.rules,
+                ).get("loop-storm", []))
+            return proc, fired
+
+        points = [i for i in storm.injections
+                  if i.cls == "loop-storm" and i.point != "sched_proc"]
+        chain: List[Dict] = []
+        landed_mtimes: Dict[str, float] = {}
+        served_v1_throughout = True
+        for inj in points:
+            proc, fired = spawn_child(
+                {"point": inj.point, "after": inj.after,
+                 "rc": inj.rc},
+                timeout=min(120.0, deadline_s),
+            )
+            probe = pool.forecast([str(ids[0])], 5)
+            ok_v1 = bool(probe.get("ok")
+                         and probe.get("version") == v1)
+            served_v1_throughout &= ok_v1
+            rec = {"point": inj.point, "rc": proc.returncode,
+                   "rc_armed": inj.rc, "fired": fired,
+                   "served_v1": ok_v1,
+                   "active": registry.active_version()}
+            plan_rec = refit.read_refit_plan(sched_scratch)
+            rec["plan_pinned"] = bool(plan_rec is not None
+                                      and not plan_rec.get("complete"))
+            if inj.point == "resident_flush" and plan_rec is not None:
+                _c, _d, chain_out = refit.cycle_paths(sched_scratch,
+                                                      plan_rec)
+                for p in sorted(glob_mod.glob(
+                        os.path.join(chain_out, "chunk_*.npz"))):
+                    landed_mtimes[p] = os.path.getmtime(p)
+                rec["landed_chunks"] = len(landed_mtimes)
+            chain.append(rec)
+        # Landed flushes survive the chain untouched: later successors
+        # resumed them rather than re-fitting (mtime-stable).
+        resumed_landed = all(
+            os.path.exists(p) and os.path.getmtime(p) == m
+            for p, m in landed_mtimes.items()
+        )
+
+        # ---- the raw SIGKILL: mid-cycle on a fresh delta ------------
+        delta2 = plane.land_synthetic_delta(dset, prof.refit_churn)
+        env = orchestrate._child_env()
+        obs.inject_env(env)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tsspark_tpu.sched",
+             "--data", dset, "--registry", registry.root,
+             "--scratch", sched_scratch,
+             "--chunk", str(prof.refit_chunk),
+             "--max-iters", str(prof.max_iters),
+             "--poll", "0.02", "--debounce", "0.02",
+             "--duration", "120", "--no-activate"],
+            env=env, stdout=sys.stderr,
+        )
+        # Kill once the delta-2 cycle is pinned (mid-cycle, not idle).
+        kill_deadline = time.time() + 90.0
+        while time.time() < kill_deadline:
+            plan_rec = refit.read_refit_plan(sched_scratch)
+            if (plan_rec is not None
+                    and plan_rec.get("plan_stamp") == delta2["seq"]):
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.02)
+        t_fault = time.time()
+        obs.event("fault", tag="loop-storm", mode="direct",
+                  pid=proc.pid)
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+
+        # ---- final successor: in-process, pool-flipped --------------
+        def pool_probe(version):
+            resp = pool.forecast([str(ids[1])], 5)
+            return resp.get("version") if resp.get("ok") else None
+
+        successor = sched.RefitScheduler(
+            dset, registry, sched_scratch,
+            chunk=prof.refit_chunk, solver_config=solver,
+            warm_start=True, pool=pool,
+            hot_series=[str(s) for s in ids[:8]], horizons=(5, 7),
+            poll_s=0.02, debounce_s=0.02,
+            freshness_probe=pool_probe,
+        )
+        summary = successor.run(until_stamp=delta2["seq"],
+                                duration_s=min(180.0, deadline_s))
+        v_final = summary.get("head_version")
+        recovered = None
+        if v_final is not None and summary["pending_deltas"] == 0:
+            recovered = time.time() - t_fault
+            obs.event("recovered", tag="loop-storm")
+        mttr["loop-storm"] = recovered
+
+        # ---- invariants ---------------------------------------------
+        if v_final is not None:
+            info = registry.delta_info(int(v_final)) or {}
+            base_v = info.get("base_version")
+            if base_v is not None:
+                bitwise = inv.refit_unchanged_bitwise(
+                    registry.version_dir(int(base_v)),
+                    registry.version_dir(int(v_final)),
+                    info.get("changed_rows") or (),
+                )
+            else:
+                bitwise = {"ok": False,
+                           "errors": ["final version is not a delta "
+                                      "publish"]}
+        else:
+            bitwise = {"ok": False,
+                       "errors": ["successor published no version"]}
+        fresh = summary["freshness"]
+        fresh_ok = (fresh["n"] >= 2 and fresh["max_s"] is not None
+                    and fresh["max_s"] <= prof.recovery_budget_s)
+        kills_ok = all(
+            r["fired"] == 1 and r["rc"] == r["rc_armed"]
+            for r in chain
+        )
+        wrong_version = pool.wrong_version
+        inv_sched = {
+            "ok": (kills_ok and served_v1_throughout
+                   and resumed_landed and wrong_version == 0
+                   and bool(summary.get("ok"))
+                   and recovered is not None and fresh_ok
+                   and bitwise["ok"]),
+            "kill_chain": chain,
+            "resumed_landed_chunks": resumed_landed,
+            "served_v1_throughout": served_v1_throughout,
+            "wrong_version": wrong_version,
+            "successor_ok": bool(summary.get("ok")),
+            "freshness": fresh,
+            "freshness_within_budget": fresh_ok,
+            "unchanged_bitwise": bitwise,
+        }
+        errs = []
+        if not kills_ok:
+            errs.append("a scheduler kill never fired (or the child "
+                        "survived it)")
+        if not served_v1_throughout or wrong_version:
+            errs.append("pool served something other than the last "
+                        "complete version during the kill chain")
+        if not resumed_landed:
+            errs.append("a successor re-fit chunk flushes that were "
+                        "already landed (resume broke)")
+        if not fresh_ok:
+            errs.append("freshness did not recover within the "
+                        "recovery budget")
+        if errs:
+            inv_sched["errors"] = errs
+        stage = {
+            "wall_s": round(time.time() - t0, 3),
+            "v1": v1, "v_final": v_final,
+            "delta_seqs": [delta1["seq"], delta2["seq"]],
+            "kill_chain": [
+                {k: r[k] for k in ("point", "rc", "fired")}
+                for r in chain
+            ],
+            "successor": {
+                k: summary.get(k)
+                for k in ("cycles", "resumed_cycles", "failures",
+                          "wall_s", "cycle_overhead_frac")
+            },
+            "freshness": fresh,
+        }
+        return stage, {"sched_loop_storm": inv_sched}
+    finally:
+        if pool is not None:
+            pool.stop()
+        if env_plan is not None:
+            os.environ[faults.ENV_VAR] = env_plan
+
+
 def run_storm(seed: int = 0, profile: str = "full",
               scratch: Optional[str] = None,
               keep_scratch: bool = False,
@@ -1249,6 +1535,14 @@ def run_storm(seed: int = 0, profile: str = "full",
                 )
             invariants.update(refit_inv)
 
+        # ---- stage I: always-on scheduler loop under storm -----------
+        if prof.sched_storm and prof.refit_series:
+            with obs.span("stage.sched", series=prof.refit_series):
+                stages["sched"], sched_inv = _run_sched_storm(
+                    scratch, storm, mttr, deadline_s
+                )
+            invariants.update(sched_inv)
+
         # ---- cross-stage invariants ----------------------------------
         if out_dir is not None:
             corrupt_injected = sum(
@@ -1375,6 +1669,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "plane_series": prof.plane_series,
                 "resident_series": prof.resident_series,
                 "refit_series": prof.refit_series,
+                "sched_storm": prof.sched_storm,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),

@@ -79,6 +79,23 @@ Delta-refit class (tsspark_tpu.refit, profiles with ``refit_series``
                      the landed chunk flushes (zero refit dispatches)
                      and re-publish, and the final snapshot's UNCHANGED
                      rows must be bitwise the prior active version's
+
+Loop-storm class (the always-on scheduler, ``tsspark_tpu.sched``;
+profiles with ``sched_storm``):
+
+  loop-storm         a CHAIN of scheduler deaths, one per stage the
+                     loop drives: exit faults at ``sched_detect``
+                     (detect pinned, nothing fit), ``resident_flush``
+                     (mid warm wave), ``delta_publish`` (copy-forward
+                     half-written), ``sched_flip`` (published, not yet
+                     flipped) — each successor scheduler must resume
+                     the SAME pinned ``refit_plan.json`` — plus one
+                     raw SIGKILL of the scheduler process mid-cycle.
+                     Invariants: the pool serves only complete
+                     versions throughout (zero wrong-version), the
+                     final snapshot's unchanged rows are bitwise its
+                     base's, and data-to-forecast freshness recovers
+                     within the recovery budget after the storm.
 """
 
 from __future__ import annotations
@@ -148,6 +165,9 @@ class StormProfile:
     refit_series: int = 0
     refit_chunk: int = 8
     refit_churn: float = 0.25
+    # Loop-storm (the always-on scheduler): reuses refit_series/
+    # refit_chunk/refit_churn sizing; the flag arms the kill chain.
+    sched_storm: bool = False
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -184,6 +204,7 @@ PROFILES: Dict[str, StormProfile] = {
         plane_series=64, plane_shard_rows=16,
         resident_series=32, resident_chunk=8,
         refit_series=32, refit_chunk=8, refit_churn=0.25,
+        sched_storm=True,
     ),
 }
 
@@ -361,6 +382,34 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
             cls="refit-kill", stage="refit", point="delta_publish",
             mode="direct", after=rng.randrange(2, 8),
             rc=rng.choice((17, 23, 29)),
+        ))
+
+    # -- loop-storm stage (the harness arms each kill in the scheduler
+    # -- child's PRIVATE plan; the chain resumes ONE pinned plan
+    # -- through every stage, then a raw SIGKILL lands mid-cycle) -----
+    if prof.sched_storm and prof.refit_series:
+        # Wave count of the CHANGED set, not the fleet: a scheduler
+        # cycle fits only round(churn * series) rows, so an `after`
+        # drawn from the full-fleet wave count would usually outlive
+        # the cycle and the armed kill would never fire.
+        n_changed = max(1, int(round(prof.refit_churn
+                                     * prof.refit_series)))
+        churn_waves = max(1, -(-n_changed // prof.refit_chunk))
+        for point, after_hi in (("sched_detect", 1),
+                                ("resident_flush", churn_waves),
+                                ("delta_publish", 8),
+                                ("sched_flip", 1)):
+            inj.append(Injection(
+                cls="loop-storm", stage="sched", point=point,
+                mode="direct",
+                after=rng.randrange(0, after_hi)
+                if point != "delta_publish"
+                else rng.randrange(2, after_hi),
+                rc=rng.choice((17, 23, 29)),
+            ))
+        inj.append(Injection(
+            cls="loop-storm", stage="sched", point="sched_proc",
+            mode="direct",
         ))
 
     # -- data-plane stage ---------------------------------------------

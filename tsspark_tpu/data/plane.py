@@ -648,6 +648,20 @@ def delta_seq(dset_dir: str) -> int:
     return recs[-1]["seq"] if recs else 0
 
 
+def delta_seq_since(dset_dir: str, after: int) -> int:
+    """The coverage stamp by INCREMENTAL probe: walk the visibility
+    records upward from a stamp already known landed.  Seqs are
+    contiguous (allocation is serialized under the delta flock), so an
+    always-on poller pays O(new deltas) per poll instead of the full
+    glob+parse of every historical record ``delta_seq`` does — the
+    difference between an idle daemon stat-ing one missing file per
+    tick and re-reading a 10k-record history 20 times a second."""
+    seq = max(0, int(after))
+    while os.path.exists(_delta_ok_path(dset_dir, seq + 1)):
+        seq += 1
+    return seq
+
+
 def _load_patch(dset_dir: str, seq: int) -> Optional[Dict]:
     """One delta's patch payload (CRC-verified), or None when absent or
     corrupt — a visible delta whose patch cannot be read is treated as
@@ -672,6 +686,16 @@ def _load_patch(dset_dir: str, seq: int) -> Optional[Dict]:
         return None  # truncated member mid-read: same as corrupt
     finally:
         z.close()
+
+
+def delta_rows(dset_dir: str, seq: int) -> Optional[np.ndarray]:
+    """The changed-row set of ONE visible delta (the arrival-model feed
+    for the always-on scheduler's speculation), or None when the patch
+    is unreadable — callers wanting claim-set semantics must use
+    :func:`advanced_since`, which widens unreadable patches instead of
+    dropping them."""
+    patch = _load_patch(dset_dir, int(seq))
+    return None if patch is None else patch["rows"]
 
 
 def advanced_since(dset_dir: str, coverage_stamp: int) -> np.ndarray:
@@ -865,27 +889,36 @@ def land_delta(data_dir: str, rows, y_tail,
 
 def land_synthetic_delta(data_dir: str, frac: float,
                          window: int = DELTA_WINDOW,
-                         seed: int = 0) -> Dict:
+                         seed: int = 0,
+                         rows=None) -> Dict:
     """Synthesize one advance event: a seeded ``frac`` of the fleet
     gains a revised trailing window (current values + a small seeded
     drift — the warm-start-friendly shape of real late-arriving data).
     The changed-row choice and the perturbation are deterministic in
     (dataset key, next seq, seed); the landed patch file is the
-    replayable record either way."""
+    replayable record either way.  ``rows`` pins the advancing series
+    explicitly (``frac`` is then ignored) — the freshness bench uses a
+    hot-biased row stream so the scheduler's arrival model has a real
+    per-series cadence to learn."""
     rec = read_spec(data_dir)
     if rec is None:
         raise ValueError(f"{data_dir} is not a plane dataset")
     n, t_len = int(rec["n_series"]), int(rec["n_timesteps"])
     w = min(int(window), t_len)
-    k = max(1, int(round(float(frac) * n))) if frac > 0 else 0
-    if k == 0:
-        raise ValueError("frac too small: no series would advance")
     seq = delta_seq(data_dir) + 1
     key = zlib.crc32(
         f"{rec.get('generator')}:{rec.get('seed')}:{seq}:{seed}".encode()
     )
     rng = np.random.default_rng([int(rec.get("seed", 0)), seq, seed, key])
-    rows = np.sort(rng.choice(n, size=min(k, n), replace=False))
+    if rows is not None:
+        rows = np.unique(np.asarray(rows, np.int64))
+        if not len(rows):
+            raise ValueError("explicit rows must be non-empty")
+    else:
+        k = max(1, int(round(float(frac) * n))) if frac > 0 else 0
+        if k == 0:
+            raise ValueError("frac too small: no series would advance")
+        rows = np.sort(rng.choice(n, size=min(k, n), replace=False))
     y_mm = np.load(os.path.join(data_dir, "y.npy"), mmap_mode="r")
     cur = np.asarray(y_mm[rows, t_len - w:], np.float32)
     del y_mm

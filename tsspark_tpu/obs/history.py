@@ -279,6 +279,35 @@ def _scale_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _freshness_row(rep: Dict[str, Any]) -> Dict[str, Any]:
+    """Freshness-stream rows (bench --freshness; tsspark_tpu.sched).
+    The workload key carries the rung, churn, AND loop mode: a
+    pipelined stream must never baseline a serialized one — the p95
+    gap between them is exactly the metric the bench exists to show."""
+    m: Dict[str, float] = {}
+    for k in ("freshness_p50_s", "freshness_p95_s",
+              "freshness_mean_s", "freshness_vs_cold_frac",
+              "cycle_overhead_frac", "spec_hit_rate", "cycles",
+              "wrong_version", "probe_failures", "cold_wall_s",
+              "complete", "wall_s"):
+        _put(m, k, rep.get(k))
+    churn = rep.get("churn")
+    churn_key = (f"c{int(round(float(churn) * 1000)):04d}"
+                 if isinstance(churn, (int, float)) else "c?")
+    return {
+        "kind": "freshness",
+        "trace_id": rep.get("trace_id"),
+        "unix": rep.get("unix"),
+        "workload": (f"freshness_{rep.get('rung')}_{churn_key}"
+                     f"+{rep.get('mode')}"),
+        "device": rep.get("device"),
+        "numerics_rev": rep.get("numerics_rev"),
+        "config_fingerprint": rep.get("config_fingerprint"),
+        "git_rev": rep.get("git_rev"),
+        "metrics": m,
+    }
+
+
 def _chaos_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     m: Dict[str, float] = {}
     _put(m, "ok", rep.get("ok"))
@@ -356,6 +385,8 @@ def classify(rep: Dict[str, Any]) -> Optional[str]:
         return "serve"
     if kind == "scale-ladder":
         return "scale"
+    if kind == "freshness-bench":
+        return "freshness"
     if kind == "chaos-storm":
         return "chaos"
     if kind == "run-ledger":
@@ -375,6 +406,7 @@ _ROW_BUILDERS = {
     "bench": _bench_row,
     "serve": _serve_row,
     "scale": _scale_row,
+    "freshness": _freshness_row,
     "chaos": _chaos_row,
     "eval": _eval_row,
     "ledger": _ledger_row,
@@ -531,6 +563,9 @@ _TRAJECTORY_COLUMNS = {
     "scale": ("series_per_s", "agg_requests_per_s",
               "time_to_first_request_s", "flip_p99_ms",
               "rss_mb_per_replica", "rss_reduction_x", "complete"),
+    "freshness": ("freshness_p50_s", "freshness_p95_s",
+                  "freshness_vs_cold_frac", "cycle_overhead_frac",
+                  "spec_hit_rate", "wrong_version", "complete"),
     "chaos": ("ok", "invariant_fails"),
     "eval": ("config3_m5.smape_holdout_cpu",
              "config3_m5.delta_holdout_p50",
@@ -569,8 +604,8 @@ def trajectory(rows: Sequence[Dict[str, Any]]) -> List[str]:
     """Human-readable trajectory: one line per row, grouped by family
     in ingest order (the roadmap's 'bench trajectory' block)."""
     lines: List[str] = []
-    for kind in ("bench", "eval", "serve", "scale", "chaos",
-                 "ledger"):
+    for kind in ("bench", "eval", "serve", "scale", "freshness",
+                 "chaos", "ledger"):
         group = [r for r in rows if r.get("kind") == kind]
         if not group:
             continue

@@ -102,6 +102,16 @@ DEFAULT_SLO: Dict[str, Any] = {
             "series_per_s": {"direction": "higher",
                              "max_drop_frac": 0.5},
         },
+        "freshness": {
+            "freshness_p95_s": {"direction": "lower",
+                                "max_rise_frac": 1.0,
+                                "slack_abs": 2.0},
+            "cycle_overhead_frac": {"direction": "lower",
+                                    "max_rise_abs": 0.25,
+                                    "slack_abs": 0.05},
+            "spec_hit_rate": {"direction": "higher",
+                              "max_drop_abs": 0.5},
+        },
         "chaos": {
             "ok": {"direction": "higher", "max_drop_abs": 0.5},
             "mttr_*": {"direction": "lower", "max_rise_frac": 1.0,

@@ -169,6 +169,7 @@ def observe_run(scratch: str,
     flat = _metric_lookup(snap)
     queue_depth = flat.get("tsspark_serve_queue_depth")
     breaker_open = flat.get("tsspark_serve_breaker_open")
+    carried = flat.get("tsspark_serve_cache_carried")
     shed = flat.get("tsspark_serve_requests_total/result=shed", 0)
     done = flat.get("tsspark_serve_requests_total/result=completed", 0)
     total = shed + done
@@ -184,6 +185,24 @@ def observe_run(scratch: str,
         t_last = max(e for e, _s in req)
         recent = [s for e, s in req if e >= t_last - RATE_WINDOW_S]
         p99_ms = ledger.red_summary(recent)["serve.request"]["p99_ms"]
+
+    # Live data-to-forecast freshness off the scheduler's
+    # refit.freshness spans (t0 = the delta's land time, dur = land ->
+    # first-served): trailing-window p95, same discipline as the p99.
+    fr = [(ledger._span_end(s), s.get("dur_s")) for s in spans
+          if s.get("name") == "refit.freshness"
+          and ledger._span_end(s) is not None
+          and isinstance(s.get("dur_s"), (int, float))]
+    freshness_p95_s = None
+    if fr:
+        t_last = max(e for e, _d in fr)
+        recent_fr = [d for e, d in fr if e >= t_last - RATE_WINDOW_S]
+        if recent_fr:
+            import numpy as _np
+
+            freshness_p95_s = round(
+                float(_np.percentile(_np.asarray(recent_fr), 95)), 4
+            )
 
     # The live row(s), judged by the same sentinel machinery the
     # post-run gate uses — one pseudo-row per family so bench budgets
@@ -205,6 +224,12 @@ def observe_run(scratch: str,
         live_rows.append({"kind": "serve", "row_id": "live:serve",
                           "device_class": dev_class,
                           "metrics": serve_metrics})
+    if freshness_p95_s is not None:
+        live_rows.append({
+            "kind": "freshness", "row_id": "live:freshness",
+            "device_class": dev_class,
+            "metrics": {"freshness_p95_s": freshness_p95_s},
+        })
     verdicts = []
     for live in live_rows:
         v = regress.evaluate(live, history_rows, slo=slo)
@@ -224,6 +249,8 @@ def observe_run(scratch: str,
         "breaker": (None if breaker_open is None
                     else ("open" if breaker_open >= 1.0 else "closed")),
         "p99_ms": p99_ms,
+        "carried": carried,
+        "freshness_p95_s": freshness_p95_s,
         "breaches": breaches,
         "verdicts": verdicts,
     }
@@ -271,6 +298,10 @@ def format_line(st: Dict[str, Any]) -> str:
         bits.append(f"breaker={st['breaker']}")
     if st["p99_ms"] is not None:
         bits.append(f"p99={st['p99_ms']}ms")
+    if st.get("carried") is not None:
+        bits.append(f"carried={int(st['carried'])}")
+    if st.get("freshness_p95_s") is not None:
+        bits.append(f"fresh_p95={st['freshness_p95_s']}s")
     if st["breaches"]:
         worst = ", ".join(
             f"{c['metric']}={c['value']} vs bound {c['bound']}"

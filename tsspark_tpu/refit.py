@@ -69,6 +69,19 @@ from tsspark_tpu.utils.atomic import atomic_write
 #: deltas landed after the kill).
 REFIT_PLAN_FILE = "refit_plan.json"
 
+#: Spill-set visibility marker inside a cycle dir: each spill column is
+#: individually atomic but the SET is not — a kill between columns
+#: would leave ds.npy without mask.npy, and a presence check would
+#: resume against half a gather.  The marker (atomic, written LAST) is
+#: the unit of visibility; re-spilling before it lands is safe because
+#: no chunk file can exist until the fit stage starts.
+SPILL_OK_FILE = "spillok.json"
+
+#: Reused cold-reference record (``bench --delta --reuse-cold`` /
+#: ``bench --freshness``): the measured cold fit+publish walls plus the
+#: shape/fingerprint identity that makes reuse safe.
+COLD_META_FILE = "cold_meta.json"
+
 
 def warm_theta_gather(theta, idx):
     """Warm-start gather: rows ``idx`` of the active snapshot's theta,
@@ -108,6 +121,307 @@ def _write_refit_plan(scratch: str, plan: Dict) -> None:
     )
 
 
+# ---------------------------------------------------------------------------
+# cycle stages (the scheduler pipelines these; run_refit composes them)
+# ---------------------------------------------------------------------------
+
+
+def draft_plan(data_dir: str, base_stamp: int,
+               base_version: Optional[int] = None) -> Dict:
+    """An IN-MEMORY cycle plan against a coverage stamp.  The pipelined
+    scheduler drafts cycle N+1's plan against cycle N's ``plan_stamp``
+    while N is still publishing — N's version number does not exist
+    yet, so ``base_version`` stays None until :func:`pin_drafted`
+    adopts it at fit time."""
+    from tsspark_tpu.data import plane
+
+    plan_stamp = plane.delta_seq(data_dir)
+    changed = plane.advanced_since(data_dir, int(base_stamp))
+    return {
+        "base_version": (None if base_version is None
+                         else int(base_version)),
+        "base_stamp": int(base_stamp),
+        "plan_stamp": int(plan_stamp),
+        "n_changed": int(len(changed)),
+        "changed_rows": [int(r) for r in changed.tolist()],
+        "complete": False,
+    }
+
+
+def pin_drafted(scratch: str, plan: Dict, base_version: int) -> Dict:
+    """Adopt a drafted plan's base version and pin it to disk — the
+    point a speculative draft becomes THE cycle a successor resumes."""
+    plan = dict(plan, base_version=int(base_version))
+    _write_refit_plan(scratch, plan)
+    return plan
+
+
+def resolve_plan(data_dir: str, registry, scratch: str,
+                 base_version: int) -> tuple:
+    """(plan, resumed): resume the pinned plan when it is incomplete
+    and its base is usable — the active version, a draft pinned before
+    its base version number existed (matched by base STAMP — the
+    pipelined scheduler's prefetch), or a PUBLISHED but not-yet-active
+    version (a front elsewhere owns the flip and the publisher died
+    after publish, before the flip: the plan must be resumed against
+    its own base, never re-detected from the stale active pointer —
+    that fresh detect racing deltas landed after the kill is exactly
+    what the pin exists to prevent).  The published-base resume is
+    gated on the plan covering at least the ACTIVE version's data
+    stamp, so a plan orphaned behind a newer out-of-band flip can
+    never publish a version that would regress coverage.  Else pin a
+    fresh detect against ``base_version`` (the active version)."""
+    plan = read_refit_plan(scratch)
+    active_stamp = int(registry.version_stamp(int(base_version)))
+    if plan is not None and not plan.get("complete"):
+        pv = plan.get("base_version")
+        if pv == int(base_version):
+            return plan, True
+        if pv is None and plan.get("base_stamp") == active_stamp:
+            return pin_drafted(scratch, plan, base_version), True
+        if pv is not None:
+            try:
+                pv_stamp = int(registry.version_stamp(int(pv)))
+            except Exception:
+                pv_stamp = None  # base vanished: fall through to detect
+            if (pv_stamp == plan.get("base_stamp")
+                    and int(plan.get("plan_stamp", -1))
+                    >= active_stamp):
+                return plan, True
+    plan = draft_plan(data_dir, active_stamp,
+                      base_version=int(base_version))
+    _write_refit_plan(scratch, plan)
+    return plan, False
+
+
+def cycle_paths(scratch: str, plan: Dict) -> tuple:
+    """(cycle_dir, spill data dir, fit out dir) for a plan.  Keyed by
+    the STAMP pair, not the base version: a draft's paths must not move
+    when :func:`pin_drafted` later fills the version in, or a prefetched
+    spill would be orphaned."""
+    cycle_dir = os.path.join(
+        scratch,
+        f"cycle_b{plan['base_stamp']:06d}_s{plan['plan_stamp']:06d}",
+    )
+    return (cycle_dir, os.path.join(cycle_dir, "delta_data"),
+            os.path.join(cycle_dir, "out"))
+
+
+def ensure_spill(data_dir: str, plan: Dict, scratch: str) -> str:
+    """Gather the plan's changed rows into the cycle's spill dir
+    (idempotent — the ``SPILL_OK_FILE`` marker is the unit of
+    visibility for the spill SET; see its docstring).  Pure mmap reads:
+    this is the stage the scheduler overlaps with the previous cycle's
+    publish.  Returns the spill dir."""
+    from tsspark_tpu.data import plane
+
+    cycle_dir, ddir, _out = cycle_paths(scratch, plan)
+    marker = os.path.join(cycle_dir, SPILL_OK_FILE)
+    if os.path.exists(marker):
+        return ddir
+    os.makedirs(cycle_dir, exist_ok=True)
+    changed = np.asarray(plan["changed_rows"], np.int64)
+    batch = plane.open_batch(data_dir)
+    sub = lambda a: (None if a is None
+                     else np.ascontiguousarray(a[changed]))
+    orchestrate.spill_data(
+        ddir, np.asarray(batch.ds), sub(batch.y),
+        mask=sub(batch.mask), regressors=sub(batch.regressors),
+        cap=sub(batch.cap),
+    )
+    atomic_write(
+        marker,
+        lambda fh: json.dump({"n_changed": int(plan["n_changed"]),
+                              "unix": round(time.time(), 3)}, fh),
+        mode="w",
+    )
+    return ddir
+
+
+def fit_changed(
+    data_dir: str,
+    registry,
+    plan: Dict,
+    scratch: str,
+    *,
+    chunk: int,
+    solver_config,
+    phase1_iters: int = 0,
+    no_phase1_tune: bool = True,
+    warm_start: bool = True,
+    theta_cache: Optional[Dict] = None,
+    deadline: Optional[float] = None,
+) -> Dict:
+    """The exclusive stage: spill (if not prefetched), warm-gather, and
+    run the changed set through the resident path.  Returns a dict with
+    ``complete``, ``fit_s``, ``fit_dispatches``, ``fit_path``,
+    ``state_sub``, ``step_sub``, and ``warm_cache_hits``.
+
+    ``theta_cache``: pre-gathered warm-init rows (the scheduler's
+    speculative/carry-forward prep) — ``{"base_stamp": int, "rows":
+    sorted int64 array, "theta": float32 (k, P)}``.  Consulted only
+    when its ``base_stamp`` matches the plan's (a cache gathered
+    against an older plane is stale); rows it covers skip the plane
+    gather entirely, rows it misses fall back to the per-wave mmap
+    gather.  Cache values are bitwise what the base plane holds for
+    those rows, so a hit changes no numerics — it only saves the page
+    reads."""
+    from tsspark_tpu.serve import snapplane
+
+    changed = np.asarray(plan["changed_rows"], np.int64)
+    n_changed = int(plan["n_changed"])
+    ddir = ensure_spill(data_dir, plan, scratch)
+    _cycle_dir, _ddir, out_dir = cycle_paths(scratch, plan)
+    os.makedirs(out_dir, exist_ok=True)
+    orchestrate.save_run_config(out_dir, registry.config, solver_config)
+
+    cache_rows = cache_theta = None
+    if (warm_start and theta_cache is not None
+            and int(theta_cache.get("base_stamp", -1))
+            == int(plan["base_stamp"])
+            and len(theta_cache.get("rows", ()))):
+        cache_rows = np.asarray(theta_cache["rows"], np.int64)
+        cache_theta = np.asarray(theta_cache["theta"], np.float32)
+    hits = {"n": 0}
+
+    theta0_fn = None
+    base_view = None
+    theta_mm = None
+    if warm_start:
+        base_vdir = registry.version_dir(int(plan["base_version"]))
+        try:
+            # verify=False: the registry CRC-swept this plane when it
+            # was loaded for serving; a warm INIT cannot affect
+            # correctness (warm_theta_gather scrubs non-finite values),
+            # so the refit skips a second full sweep.
+            base_view = snapplane.attach(base_vdir, verify=False)
+            theta_mm = base_view.state.theta
+        except snapplane.SnapshotPlaneError:
+            import warnings
+
+            warnings.warn(
+                f"refit: base version {plan['base_version']} has no "
+                "readable snapshot plane; warm start disabled for "
+                "this cycle (cold ridge init — results stay "
+                "correct, the warm-start perf lever is lost)",
+                RuntimeWarning,
+            )
+    if theta_mm is not None:
+        def theta0_fn(lo, hi):
+            # Per-wave gather: base rows of this wave's slice of the
+            # compacted changed set — cache rows from memory, the rest
+            # as touched-pages-only mmap reads.
+            rows = changed[lo:hi]
+            if cache_rows is None:
+                return warm_theta_gather(theta_mm, rows)
+            pos = np.minimum(np.searchsorted(cache_rows, rows),
+                             len(cache_rows) - 1)
+            hit = cache_rows[pos] == rows
+            if not hit.any():
+                return warm_theta_gather(theta_mm, rows)
+            out = np.empty((len(rows), cache_theta.shape[1]),
+                           np.float32)
+            out[hit] = cache_theta[pos[hit]]
+            if not hit.all():
+                out[~hit] = warm_theta_gather(theta_mm, rows[~hit])
+            hits["n"] += int(hit.sum())
+            return np.nan_to_num(out)
+
+    from tsspark_tpu import resident
+
+    chunks_before = len(orchestrate.completed_ranges(out_dir))
+    t0 = time.time()
+    fit_state = resident.run_resident(
+        data_dir=ddir, out_dir=out_dir, series=n_changed,
+        chunk=int(chunk), phase1_iters=phase1_iters,
+        no_phase1_tune=no_phase1_tune, autotune=False,
+        deadline=deadline, theta0_fn=theta0_fn,
+    )
+    out: Dict = {
+        "complete": bool(fit_state.get("complete")),
+        "fit_s": round(time.time() - t0, 3),
+        "fit_path": fit_state.get("fit_path"),
+        "fit_dispatches": (len(orchestrate.completed_ranges(out_dir))
+                           - chunks_before),
+        "warm_cache_hits": hits["n"],
+        "state_sub": None,
+        "step_sub": None,
+    }
+    if not out["complete"]:
+        return out
+    out["state_sub"] = orchestrate.load_fit_state(out_dir, n_changed)
+    if base_view is not None and "step" in base_view.extras:
+        out["step_sub"] = np.asarray(
+            base_view.extras["step"][changed], np.float64
+        )
+    return out
+
+
+def reap_cycles(scratch: str, keep: Sequence[str] = ()) -> None:
+    """Remove completed cycle dirs (dead weight once their plan is
+    done), sparing any in-flight dirs the pipelined scheduler names."""
+    keep_abs = {os.path.abspath(k) for k in keep}
+    try:
+        names = os.listdir(scratch)
+    except OSError:
+        return
+    for name in names:
+        d = os.path.join(scratch, name)
+        if (name.startswith("cycle_")
+                and os.path.abspath(d) not in keep_abs):
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def publish_plan(
+    registry,
+    plan: Dict,
+    state_sub,
+    step_sub,
+    scratch: str,
+    *,
+    pool=None,
+    flip_fn: Optional[Callable[[int], None]] = None,
+    activate: bool = True,
+    hot_series: Optional[Sequence[str]] = None,
+    horizons: Sequence[int] = (7, 14),
+    reap: bool = True,
+) -> Dict:
+    """Copy-forward delta publish + flip + mark the plan complete.
+    Everything here is mmap reads and atomic writes — the stage the
+    scheduler overlaps with the NEXT cycle's detect and spill.  Returns
+    ``{"version", "publish_s", "flip_s", "flipped"}``."""
+    changed = np.asarray(plan["changed_rows"], np.int64)
+    t0 = time.time()
+    v_new = registry.publish_delta(
+        state_sub, changed, base_version=int(plan["base_version"]),
+        step_sub=step_sub, data_stamp=plan["plan_stamp"],
+        activate=False,
+    )
+    publish_s = round(time.time() - t0, 3)
+
+    t0 = time.time()
+    if pool is not None:
+        pool.activate(v_new, hot_series=list(hot_series or ()),
+                      horizons=tuple(horizons))
+    elif flip_fn is not None:
+        flip_fn(int(v_new))
+    elif activate:
+        registry.activate(int(v_new))
+    flip_s = round(time.time() - t0, 3)
+
+    _write_refit_plan(scratch, dict(plan, complete=True,
+                                    published_version=int(v_new)))
+    if reap:
+        reap_cycles(scratch)
+    return {
+        "version": int(v_new),
+        "publish_s": publish_s,
+        "flip_s": flip_s,
+        "flipped": bool(pool is not None or flip_fn is not None
+                        or activate),
+    }
+
+
 def run_refit(
     *,
     data_dir: str,
@@ -124,6 +438,7 @@ def run_refit(
     activate: bool = True,
     flip_fn: Optional[Callable[[int], None]] = None,
     deadline: Optional[float] = None,
+    theta_cache: Optional[Dict] = None,
 ) -> Dict:
     """One delta-refit cycle: detect -> warm resident fit over the
     changed set -> copy-forward delta publish -> flip.  Returns the
@@ -132,20 +447,27 @@ def run_refit(
     ``registry`` is an attached ``ParamRegistry`` with an ACTIVE
     version whose snapshot plane exists (the warm-start source and the
     copy-forward base).  ``scratch`` persists across cycles: the
-    current plan plus a per-(base-version, stamp) cycle dir whose chunk
-    files make a killed cycle resumable.  The flip goes through
+    current plan plus a per-(stamp pair) cycle dir whose chunk files
+    make a killed cycle resumable.  The flip goes through
     ``pool.activate`` (the PR 10 materialize/drain path) when a pool is
     attached, else ``flip_fn`` when given, else ``registry.activate``;
     ``activate=False`` publishes without flipping (the chaos child —
-    the harness's front owns the flip).
+    the harness's front owns the flip).  ``theta_cache``: pre-gathered
+    warm-init rows (see :func:`fit_changed` — the scheduler's
+    speculative prep; a plain cycle never needs it).
 
     Zero-delta fast path: no advanced series -> zero fit dispatches,
     a fully-hardlinked version (zero new snapshot bytes), and the
     serving side keeps returning bitwise-identical forecasts.
+
+    The stages are the module-level :func:`resolve_plan` /
+    :func:`ensure_spill` / :func:`fit_changed` / :func:`publish_plan`
+    — the always-on scheduler (``tsspark_tpu.sched``) pipelines those
+    directly so cycle N+1's detect and spill overlap cycle N's publish
+    and flip; this function is their serial composition, ONE cycle as
+    one call (the CLI/chaos/bench unit).
     """
     from tsspark_tpu.config import SolverConfig
-    from tsspark_tpu.data import plane
-    from tsspark_tpu.serve import snapplane
 
     t_cycle0 = time.time()
     os.makedirs(scratch, exist_ok=True)
@@ -160,34 +482,18 @@ def run_refit(
 
     # ---- detect: pin (or resume) the plan ---------------------------
     t0 = time.time()
-    plan = read_refit_plan(scratch)
-    resumed = bool(plan is not None and not plan.get("complete")
-                   and plan.get("base_version") == int(base_version))
-    if not resumed:
-        base_stamp = registry.version_stamp(int(base_version))
-        plan_stamp = plane.delta_seq(data_dir)
-        changed = plane.advanced_since(data_dir, base_stamp)
-        plan = {
-            "base_version": int(base_version),
-            "base_stamp": int(base_stamp),
-            "plan_stamp": int(plan_stamp),
-            "n_changed": int(len(changed)),
-            "changed_rows": [int(r) for r in changed.tolist()],
-            "complete": False,
-        }
-        _write_refit_plan(scratch, plan)
-    changed = np.asarray(plan["changed_rows"], np.int64)
+    plan, resumed = resolve_plan(data_dir, registry, scratch,
+                                 int(base_version))
     n_changed = int(plan["n_changed"])
     detect_s = time.time() - t0
     obs.record("refit.detect", t0, detect_s, n_changed=n_changed,
                base_version=int(base_version), resumed=resumed)
 
-    cycle_dir = os.path.join(
-        scratch,
-        f"cycle_v{plan['base_version']:06d}_s{plan['plan_stamp']:06d}",
-    )
     result: Dict = {
-        "base_version": int(base_version),
+        # The plan's base, not the active pointer: a resumed plan whose
+        # publish landed but whose flip did not may legitimately base
+        # on a published, not-yet-active version (see resolve_plan).
+        "base_version": int(plan["base_version"]),
         "base_stamp": plan["base_stamp"],
         "plan_stamp": plan["plan_stamp"],
         "n_changed": n_changed,
@@ -202,119 +508,125 @@ def run_refit(
     step_sub = None
     if n_changed:
         # ---- fit: compacted claim space through the resident path ---
-        ddir = os.path.join(cycle_dir, "delta_data")
-        out_dir = os.path.join(cycle_dir, "out")
-        os.makedirs(out_dir, exist_ok=True)
-        # Gate on the PLAN's spilled flag, not file presence: each spill
-        # file is individually atomic but the set is not — a kill
-        # between columns would leave ds.npy without mask.npy, and a
-        # presence check would resume against half a gather.  Re-spilling
-        # before the flag is safe (no chunk file can exist yet).
-        if not plan.get("spilled"):
-            batch = plane.open_batch(data_dir)
-            sub = lambda a: (None if a is None
-                             else np.ascontiguousarray(a[changed]))
-            orchestrate.spill_data(
-                ddir, np.asarray(batch.ds), sub(batch.y),
-                mask=sub(batch.mask), regressors=sub(batch.regressors),
-                cap=sub(batch.cap),
-            )
-            plan = dict(plan, spilled=True)
-            _write_refit_plan(scratch, plan)
-        orchestrate.save_run_config(out_dir, registry.config,
-                                    solver_config)
-
-        theta0_fn = None
-        base_view = None
-        base_vdir = registry.version_dir(int(base_version))
-        if warm_start:
-            try:
-                # verify=False: the registry CRC-swept this plane when
-                # it was loaded for serving; a warm INIT cannot affect
-                # correctness (warm_theta_gather scrubs non-finite
-                # values), so the refit skips a second full sweep.
-                base_view = snapplane.attach(base_vdir, verify=False)
-            except snapplane.SnapshotPlaneError:
-                import warnings
-
-                warnings.warn(
-                    f"refit: base version {base_version} has no "
-                    "readable snapshot plane; warm start disabled for "
-                    "this cycle (cold ridge init — results stay "
-                    "correct, the warm-start perf lever is lost)",
-                    RuntimeWarning,
-                )
-        if base_view is not None:
-            theta_mm = base_view.state.theta
-
-            def theta0_fn(lo, hi):
-                # Per-wave mmap gather: base rows of this wave's slice
-                # of the compacted changed set — touched pages only.
-                return warm_theta_gather(theta_mm, changed[lo:hi])
-
-        from tsspark_tpu import resident
-
-        chunks_before = len(orchestrate.completed_ranges(out_dir))
-        t0 = time.time()
-        fit_state = resident.run_resident(
-            data_dir=ddir, out_dir=out_dir, series=n_changed,
-            chunk=int(chunk), phase1_iters=phase1_iters,
-            no_phase1_tune=no_phase1_tune, autotune=False,
-            deadline=deadline, theta0_fn=theta0_fn,
+        fit_res = fit_changed(
+            data_dir, registry, plan, scratch, chunk=int(chunk),
+            solver_config=solver_config, phase1_iters=phase1_iters,
+            no_phase1_tune=no_phase1_tune, warm_start=warm_start,
+            theta_cache=theta_cache, deadline=deadline,
         )
-        result["fit_s"] = round(time.time() - t0, 3)
-        result["fit_path"] = fit_state.get("fit_path")
-        result["fit_dispatches"] = (
-            len(orchestrate.completed_ranges(out_dir)) - chunks_before
-        )
-        if not fit_state.get("complete"):
+        result["fit_s"] = fit_res["fit_s"]
+        result["fit_path"] = fit_res["fit_path"]
+        result["fit_dispatches"] = fit_res["fit_dispatches"]
+        if fit_res["warm_cache_hits"]:
+            result["warm_cache_hits"] = fit_res["warm_cache_hits"]
+        if not fit_res["complete"]:
             result["complete"] = False
             result["wall_s"] = round(time.time() - t_cycle0, 3)
             return result
-        state_sub = orchestrate.load_fit_state(out_dir, n_changed)
-        if base_view is not None and "step" in base_view.extras:
-            step_sub = np.asarray(
-                base_view.extras["step"][changed], np.float64
-            )
+        state_sub = fit_res["state_sub"]
+        step_sub = fit_res["step_sub"]
 
-    # ---- delta publish: copy-forward + scatter ----------------------
-    t0 = time.time()
-    v_new = registry.publish_delta(
-        state_sub, changed, base_version=int(base_version),
-        step_sub=step_sub, data_stamp=plan["plan_stamp"],
-        activate=False,
+    # ---- delta publish + flip (copy-forward; PR 10 drain path) ------
+    pub = publish_plan(
+        registry, plan, state_sub, step_sub, scratch,
+        pool=pool, flip_fn=flip_fn, activate=activate,
+        hot_series=hot_series, horizons=horizons,
     )
-    result["version"] = int(v_new)
-    result["publish_s"] = round(time.time() - t0, 3)
-
-    # ---- flip: PR 10 materialize/drain ------------------------------
-    t0 = time.time()
-    if pool is not None:
-        pool.activate(v_new, hot_series=list(hot_series or ()),
-                      horizons=tuple(horizons))
-    elif flip_fn is not None:
-        flip_fn(int(v_new))
-    elif activate:
-        registry.activate(int(v_new))
-    result["flip_s"] = round(time.time() - t0, 3)
-    result["flipped"] = bool(pool is not None or flip_fn is not None
-                             or activate)
-
-    plan = dict(plan, complete=True, published_version=int(v_new))
-    _write_refit_plan(scratch, plan)
-    # Completed cycle dirs are dead weight (the plan is done); reap
-    # every cycle dir, including this one — the next cycle keys a new
-    # one off its own (base version, stamp).
-    for name in os.listdir(scratch):
-        if name.startswith("cycle_"):
-            shutil.rmtree(os.path.join(scratch, name),
-                          ignore_errors=True)
+    result.update(pub)
     result["complete"] = True
     result["wall_s"] = round(time.time() - t_cycle0, 3)
     obs.record("refit.cycle", t_cycle0, result["wall_s"],
                n_changed=n_changed, version=result.get("version"),
                warm_start=bool(warm_start))
     return result
+
+
+# ---------------------------------------------------------------------------
+# reusable cold reference (bench --delta/--freshness --reuse-cold)
+# ---------------------------------------------------------------------------
+
+
+def load_cold_meta(base_dir: str, rung) -> Optional[Dict]:
+    """The recorded cold fit+publish reference under ``base_dir``, or
+    None when absent or not reusable for this rung (shape or data
+    fingerprint mismatch, or the cold out dir lost its coverage)."""
+    from tsspark_tpu.data import plane
+
+    try:
+        with open(os.path.join(base_dir, COLD_META_FILE)) as fh:
+            meta = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(meta, dict):
+        return None
+    if (meta.get("series") != rung.series
+            or meta.get("timesteps") != rung.timesteps
+            or meta.get("fingerprint") != plane.dataset_fingerprint()):
+        return None
+    out_dir = os.path.join(base_dir, "cold_out")
+    done = sum(hi - lo for lo, hi
+               in orchestrate.completed_ranges(out_dir))
+    if done < rung.series:
+        return None
+    return dict(meta, out_dir=out_dir)
+
+
+def save_cold_meta(base_dir: str, meta: Dict) -> None:
+    atomic_write(
+        os.path.join(base_dir, COLD_META_FILE),
+        lambda fh: json.dump(meta, fh, indent=1), mode="w",
+    )
+
+
+def cold_base(rung, cfg, solver, run_dir: str, dset_dir: str,
+              reuse_cold: Optional[str] = None) -> Dict:
+    """The sweep's cold reference: a complete resident fit of the rung
+    plus the measured fit wall.  With ``reuse_cold`` pointing at a
+    prior run's base dir, the recorded measurement (and the fitted
+    chunk files) are reused instead of re-fitting the whole rung on
+    every invocation — the amortization churn sweeps and the freshness
+    bench ride.  Returns ``{"out_dir", "fit_s", "publish_s" (None when
+    the caller must measure its own publish), "fit_path", "reused"}``.
+    """
+    from tsspark_tpu import resident
+    from tsspark_tpu.data import plane
+
+    if reuse_cold:
+        meta = load_cold_meta(reuse_cold, rung)
+        if meta is not None:
+            return {"out_dir": meta["out_dir"],
+                    "fit_s": float(meta["fit_s"]),
+                    "publish_s": float(meta["publish_s"]),
+                    "fit_path": meta.get("fit_path"),
+                    "data_stamp": int(meta.get("data_stamp") or 0),
+                    "reused": True}
+    base_dir = reuse_cold or run_dir
+    out_dir = os.path.join(base_dir, "cold_out")
+    # No (valid) meta means whatever lives in cold_out is NOT a
+    # reusable fit for THIS rung/dataset — a different shape, or a
+    # rotated data fingerprint.  Clear it: run_resident resumes from
+    # completed chunk files, so stale coverage would silently publish
+    # parameters fit against different data AND record a near-zero
+    # "cold" wall into the meta (poisoning every *_vs_cold metric).
+    shutil.rmtree(out_dir, ignore_errors=True)
+    os.makedirs(out_dir, exist_ok=True)
+    orchestrate.save_run_config(out_dir, cfg, solver)
+    data_stamp = plane.delta_seq(dset_dir)
+    t0 = time.time()
+    cold_state = resident.run_resident(
+        data_dir=dset_dir, out_dir=out_dir, series=rung.series,
+        chunk=rung.chunk, phase1_iters=0, no_phase1_tune=True,
+    )
+    fit_s = time.time() - t0
+    if not cold_state.get("complete"):
+        return {"out_dir": out_dir, "fit_s": fit_s, "publish_s": None,
+                "fit_path": cold_state.get("fit_path"),
+                "data_stamp": data_stamp,
+                "reused": False, "complete": False}
+    return {"out_dir": out_dir, "fit_s": fit_s, "publish_s": None,
+            "fit_path": cold_state.get("fit_path"),
+            "data_stamp": data_stamp, "reused": False,
+            "complete": True}
 
 
 # ---------------------------------------------------------------------------
@@ -420,6 +732,7 @@ def _delta_report(rung, churn: float, cold: Dict, res: Dict,
         "cold_fit_s": round(cold["fit_s"], 3),
         "cold_publish_s": round(cold["publish_s"], 3),
         "cold_wall_s": round(cold_wall, 3),
+        "cold_reused": bool(cold.get("reused")),
         "detect_s": res.get("detect_s"),
         "fit_s": round(fit_s, 3),
         "publish_s": res.get("publish_s"),
@@ -439,11 +752,65 @@ def _delta_report(rung, churn: float, cold: Dict, res: Dict,
     }
 
 
+def prepare_cold_registry(rung, cfg, solver, run_dir: str,
+                          dset_dir: str, ids,
+                          reuse_cold: Optional[str] = None) -> tuple:
+    """(registry, cold, catchup) shared by ``bench --delta`` and
+    ``bench --freshness``: the cold reference via :func:`cold_base`
+    (measured fresh, or reused from ``reuse_cold``), published into a
+    fresh registry under ``run_dir``.  When the reused base predates
+    deltas already landed on the plane, one UNTIMED warm catch-up
+    cycle brings the registry current so the measured sweep starts
+    from a warm, current base — the reuse must amortize the cold fit,
+    never skew the measured cycles with a backlog."""
+    from tsspark_tpu.config import SolverConfig
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    cold = cold_base(rung, cfg, solver, run_dir, dset_dir,
+                     reuse_cold=reuse_cold)
+    if cold.get("complete") is False:
+        return None, cold, None
+    registry = ParamRegistry(os.path.join(run_dir, "registry"), cfg)
+    t0 = time.time()
+    orchestrate.publish_fit_state(
+        registry, cold["out_dir"], ids,
+        data_stamp=int(cold.get("data_stamp") or 0),
+    )
+    publish_s = time.time() - t0
+    if cold["publish_s"] is None:
+        cold["publish_s"] = publish_s
+        if reuse_cold:
+            save_cold_meta(reuse_cold, {
+                "rung": rung.name, "series": rung.series,
+                "timesteps": rung.timesteps,
+                "fingerprint": plane.dataset_fingerprint(),
+                "fit_s": round(cold["fit_s"], 3),
+                "publish_s": round(cold["publish_s"], 3),
+                "fit_path": cold.get("fit_path"),
+                "data_stamp": int(cold.get("data_stamp") or 0),
+                "unix": round(time.time(), 3),
+            })
+    catchup = None
+    if plane.delta_seq(dset_dir) > int(cold.get("data_stamp") or 0):
+        # Prior sweeps' deltas: refit them untimed so measured cycles
+        # see only their own churn.
+        catchup = run_refit(
+            data_dir=dset_dir, registry=registry,
+            scratch=os.path.join(run_dir, "catchup"),
+            chunk=rung.chunk,
+            solver_config=SolverConfig(max_iters=rung.max_iters),
+            warm_start=True,
+        )
+    return registry, cold, catchup
+
+
 def run_delta_bench(rung="smoke",
                     churns: Sequence[float] = DEFAULT_CHURNS,
                     data_root: Optional[str] = None,
                     scratch_root: Optional[str] = None,
-                    sentinel: Optional[bool] = None) -> List[Dict]:
+                    sentinel: Optional[bool] = None,
+                    reuse_cold: Optional[str] = None) -> List[Dict]:
     """``bench --delta``: cold-fit one scale-ladder rung, then sweep
     ``churns`` — land a synthetic advance, run one warm delta-refit
     cycle (detect -> fit -> delta publish -> engine-materialized flip),
@@ -455,15 +822,16 @@ def run_delta_bench(rung="smoke",
     mutate landed rows in place; the shared cache's bytes must stay
     bitwise-stable for every other bench).  The cold fit runs in a
     fresh out dir each invocation so ``cold_wall`` is always a real
-    measured fit, never a warm resume."""
+    measured fit, never a warm resume — UNLESS ``reuse_cold`` names a
+    base dir, in which case the recorded cold measurement (and warm
+    base) is reused so repeated churn sweeps amortize the cold fit."""
     import tempfile
 
-    from tsspark_tpu import bench_scale, resident
+    from tsspark_tpu import bench_scale
     from tsspark_tpu.config import SolverConfig
     from tsspark_tpu.data import plane
     from tsspark_tpu.serve.cache import ForecastCache
     from tsspark_tpu.serve.engine import PredictionEngine
-    from tsspark_tpu.serve.registry import ParamRegistry
 
     if isinstance(rung, str):
         rung = bench_scale.RUNGS[rung]
@@ -478,7 +846,9 @@ def run_delta_bench(rung="smoke",
     prev_run = obs.start_run(os.path.join(scratch, "spans.jsonl"))
     reports: List[Dict] = []
     try:
-        droot = data_root or os.path.join(scratch, "plane")
+        droot = data_root or (os.path.join(reuse_cold, "plane")
+                              if reuse_cold
+                              else os.path.join(scratch, "plane"))
         spec = plane.DatasetSpec(
             generator="demo_weekly", n_series=rung.series,
             n_timesteps=rung.timesteps, seed=2,
@@ -486,33 +856,21 @@ def run_delta_bench(rung="smoke",
         dset_dir = plane.ensure(spec, root=droot)
         ids = plane.series_ids(spec)
 
-        # ---- cold reference: resident fit + publish, fresh out dir --
+        # ---- cold reference: resident fit + publish (or reuse) ------
         run_dir = os.path.join(scratch, f"run_{int(time.time())}")
         _sweep_stale_runs(scratch, keep=run_dir)
-        out_dir = os.path.join(run_dir, "cold_out")
-        os.makedirs(out_dir, exist_ok=True)
-        orchestrate.save_run_config(out_dir, cfg, solver)
-        t0 = time.time()
-        cold_state = resident.run_resident(
-            data_dir=dset_dir, out_dir=out_dir, series=rung.series,
-            chunk=rung.chunk, phase1_iters=0, no_phase1_tune=True,
+        registry, cold, _catchup = prepare_cold_registry(
+            rung, cfg, solver, run_dir, dset_dir, ids,
+            reuse_cold=reuse_cold,
         )
-        cold_fit_s = time.time() - t0
-        if not cold_state.get("complete"):
+        if registry is None:
             print("[delta] cold fit incomplete; aborting the sweep",
                   file=sys.stderr)
             return [{"complete": False, "stage": "cold-fit"}]
-        registry = ParamRegistry(os.path.join(run_dir, "registry"), cfg)
-        t0 = time.time()
-        orchestrate.publish_fit_state(
-            registry, out_dir, ids,
-            data_stamp=plane.delta_seq(dset_dir),
-        )
-        cold = {"fit_s": cold_fit_s, "publish_s": time.time() - t0,
-                "fit_path": cold_state.get("fit_path")}
         print(json.dumps({"delta_bench": rung.name,
-                          "cold_fit_s": round(cold_fit_s, 3),
+                          "cold_fit_s": round(cold["fit_s"], 3),
                           "cold_publish_s": round(cold["publish_s"], 3),
+                          "cold_reused": bool(cold.get("reused")),
                           "fit_path": cold["fit_path"]}), flush=True)
 
         # ---- serving side: in-process engine, warm hot set ----------
@@ -652,11 +1010,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--churns", default=None,
                     help="comma-separated churn fractions for "
                          "--delta-bench")
+    ap.add_argument("--reuse-cold", default=None, metavar="DIR",
+                    help="reuse (or record) the cold fit+publish "
+                         "reference under DIR so repeated sweeps "
+                         "amortize the cold fit")
     args = ap.parse_args(argv)
     obs.adopt_env()
     if args.delta_bench:
         reports = run_delta_bench(args.delta_bench,
-                                  churns=parse_churns(args.churns))
+                                  churns=parse_churns(args.churns),
+                                  reuse_cold=args.reuse_cold)
         return 0 if sweep_ok(reports) else 1
     if not (args.data and args.registry and args.scratch):
         ap.error("--data, --registry and --scratch are required for a "

@@ -165,9 +165,15 @@ class ForecastCache:
                 evictions += 1
             self.carried += moved
             self.evicted += evictions
-        if evictions:
-            from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+        # Metrics resolved outside the cache lock, per event (same
+        # discipline as put's eviction counter): carried is how obs
+        # watch sees carry-forward health during a delta flip without
+        # polling engine internals.
+        from tsspark_tpu.obs.metrics import DEFAULT as METRICS
 
+        if moved:
+            METRICS.counter("tsspark_serve_cache_carried").inc(moved)
+        if evictions:
             METRICS.counter("tsspark_serve_cache_evicted").inc(
                 evictions
             )
