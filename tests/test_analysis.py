@@ -15,7 +15,14 @@ import numpy as np
 import pytest
 
 from tsspark_tpu import analysis
-from tsspark_tpu.analysis import contracts, fileproto, tracelint
+from tsspark_tpu.analysis import (
+    concur,
+    contracts,
+    fileproto,
+    protomodel,
+    tracelint,
+)
+from tsspark_tpu.analysis import report as analysis_report
 from tsspark_tpu.analysis.config import (
     AnalysisSettings, KernelMatrix, load_settings, repo_root,
 )
@@ -149,12 +156,20 @@ def test_tracelint_static_params_not_flagged(tmp_path):
 def test_baseline_suppression_applies():
     f = Finding("host-sync", "tsspark_tpu/x.py", 12, "fn", "msg")
     settings = AnalysisSettings(
-        suppressions=("host-sync @ tsspark_tpu/x.py::fn",)
+        suppressions=(
+            "host-sync @ tsspark_tpu/x.py::fn -- fixture justification",
+        )
     )
     kept, suppressed = apply_suppressions((f,), settings)
     assert not kept and suppressed == (f,)
     with pytest.raises(ValueError):
         AnalysisSettings(suppressions=("garbage",)).suppression_keys()
+    # A baseline entry WITHOUT its justification clause is rejected at
+    # load: an exception with no recorded reason is a rubber stamp.
+    with pytest.raises(ValueError, match="justification"):
+        AnalysisSettings(
+            suppressions=("host-sync @ tsspark_tpu/x.py::fn",)
+        ).suppression_keys()
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +329,478 @@ def test_claim_model_catches_hole_leaving_planner():
 def test_real_claim_protocol_is_clean():
     assert not fileproto.check_claim_invariants()
     assert not fileproto.check_completed_ranges_order()
+
+
+# ---------------------------------------------------------------------------
+# tracelint closure precision: the qualified-callee join
+# ---------------------------------------------------------------------------
+
+def test_tracelint_qualified_callees_no_name_collision(tmp_path):
+    """Two same-named functions in different modules: only the one the
+    jit root actually imports is traced (the DatasetSpec.key ->
+    cache_key rename class — a simple-name join would lint BOTH and
+    flag host code as traced)."""
+    (tmp_path / "mod_a.py").write_text(
+        "def helper(x):\n    return x + 1.0\n"
+    )
+    (tmp_path / "mod_b.py").write_text(
+        # A host-sync IF traced; it must stay out of the closure.
+        "def helper(x):\n    return float(x)\n"
+    )
+    (tmp_path / "rootmod.py").write_text(textwrap.dedent(
+        """
+        import jax
+        from mod_a import helper
+
+        @jax.jit
+        def kernel(x):
+            return helper(x)
+        """
+    ))
+    paths = sorted(str(p) for p in tmp_path.glob("*.py"))
+    assert not tracelint.lint_paths(paths, str(tmp_path))
+    # Control: the QUALIFIED callee is still traced — a violation in
+    # the imported module's helper IS caught.
+    (tmp_path / "mod_a.py").write_text(
+        "def helper(x):\n    return float(x)\n"
+    )
+    found = tracelint.lint_paths(paths, str(tmp_path))
+    assert {(f.path, f.rule) for f in found} == {
+        ("mod_a.py", "host-sync")
+    }
+
+
+def test_tracelint_reexported_callee_still_traced(tmp_path):
+    """A from-import through a re-exporting package __init__ must fall
+    back to the simple-name join, not silently drop the edge — the
+    qualified-callee precision must never UN-lint traced code."""
+    pkg = tmp_path / "mypkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(
+        "from mypkg.impl import helper\n"
+    )
+    (pkg / "impl.py").write_text(
+        "def helper(x):\n    return float(x)\n"
+    )
+    (tmp_path / "rootmod.py").write_text(textwrap.dedent(
+        """
+        import jax
+        from mypkg import helper
+
+        @jax.jit
+        def kernel(x):
+            return helper(x)
+        """
+    ))
+    paths = sorted(
+        str(p) for p in tmp_path.rglob("*.py")
+    )
+    found = tracelint.lint_paths(paths, str(tmp_path))
+    assert {(f.path, f.rule) for f in found} == {
+        (os.path.join("mypkg", "impl.py"), "host-sync")
+    }
+
+
+def test_count_inline_waivers_ignores_doc_mentions(tmp_path):
+    """A docstring MENTIONING the waiver syntax is documentation, not a
+    waiver — only comment tokens count toward the creep metric."""
+    (tmp_path / "mod.py").write_text(textwrap.dedent(
+        '''
+        """Docs: use ``# lint-ok[rule]: reason`` to waive."""
+
+        def f(x):
+            return x  # lint-ok[host-sync]: a real waiver
+        '''
+    ))
+    counts = analysis_report.count_inline_waivers(str(tmp_path))
+    assert counts == {"host-sync": 1}
+
+
+def test_tracelint_local_variable_not_a_callee_reference(tmp_path):
+    """A local DATA variable passed as an argument must not join a
+    same-named package function into the traced closure (`span = t1 -
+    t0` once pulled obs.context.span under the lint)."""
+    (tmp_path / "obsmod.py").write_text(
+        "def span(x):\n    return float(x)\n"
+    )
+    (tmp_path / "kern.py").write_text(textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(t0, t1):
+            span = t1 - t0
+            return jnp.maximum(span, 1e-6)
+        """
+    ))
+    paths = sorted(str(p) for p in tmp_path.glob("*.py"))
+    assert not tracelint.lint_paths(paths, str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# concurrency gate: seeded violations (one rule per fixture)
+# ---------------------------------------------------------------------------
+
+_RACY_COUNTER = textwrap.dedent(
+    '''
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.wrong_version = 0
+            self._watch = None
+
+        def start(self):
+            self._watch = threading.Thread(target=self._loop,
+                                           daemon=True)
+            self._watch.start()
+
+        def _loop(self):
+            try:
+                with self._lock:
+                    self.wrong_version += 1
+            except Exception:
+                pass
+
+        def note(self):
+            self.wrong_version += 1   # racy: no lock
+
+        def stop(self):
+            self._watch.join()
+    '''
+)
+
+_BLOCKING_UNDER_LOCK = textwrap.dedent(
+    '''
+    import threading
+    import time
+
+    class Front:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def respawn(self):
+            with self._lock:
+                time.sleep(2.0)
+    '''
+)
+
+_UNJOINED_THREAD = textwrap.dedent(
+    '''
+    import threading
+
+    def worker():
+        try:
+            work()
+        except Exception:
+            pass
+
+    def spawn():
+        threading.Thread(target=worker).start()
+    '''
+)
+
+_ESCAPING_TARGET = textwrap.dedent(
+    '''
+    import threading
+
+    def worker():
+        raise RuntimeError("boom")
+
+    def spawn():
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    '''
+)
+
+_MMAP_SCATTER = textwrap.dedent(
+    '''
+    import numpy as np
+
+    def bad(path, rows, vals):
+        mm = np.load(path, mmap_mode="r")
+        mm[rows] = vals
+        return mm
+
+    def good(path, rows, vals):
+        out = np.array(np.load(path, mmap_mode="r"))
+        out[rows] = vals
+        return out
+    '''
+)
+
+
+def _concur_on(tmp_path, src: str):
+    p = tmp_path / "fixture.py"
+    p.write_text(src)
+    return concur.check_paths([str(p)], str(tmp_path))
+
+
+def test_concur_catches_racy_counter(tmp_path):
+    found = _concur_on(tmp_path, _RACY_COUNTER)
+    assert _rules(found) == {"lock-guard"}
+    assert len(found) == 1
+    assert found[0].qualname == "Pool.note"
+    assert "wrong_version" in found[0].message
+
+
+def test_concur_catches_blocking_call_under_lock(tmp_path):
+    found = _concur_on(tmp_path, _BLOCKING_UNDER_LOCK)
+    assert _rules(found) == {"lock-blocking"}
+    assert len(found) == 1
+    assert "time.sleep" in found[0].message
+
+
+def test_concur_catches_unjoined_thread(tmp_path):
+    found = _concur_on(tmp_path, _UNJOINED_THREAD)
+    assert _rules(found) == {"thread-join"}
+    assert len(found) == 1
+
+
+def test_concur_catches_escaping_thread_target(tmp_path):
+    found = _concur_on(tmp_path, _ESCAPING_TARGET)
+    assert _rules(found) == {"thread-exc"}
+    assert len(found) == 1
+    assert found[0].qualname == "worker"
+
+
+def test_concur_catches_mmap_view_scatter(tmp_path):
+    found = _concur_on(tmp_path, _MMAP_SCATTER)
+    assert _rules(found) == {"mmap-alias"}
+    assert len(found) == 1
+    assert found[0].qualname == "bad"   # the laundered copy is clean
+
+
+def test_concur_inline_waiver(tmp_path):
+    waived = _MMAP_SCATTER.replace(
+        "mm[rows] = vals",
+        "mm[rows] = vals  # lint-ok[mmap-alias]: fixture justification",
+    )
+    assert not _concur_on(tmp_path, waived)
+
+
+def test_concur_condition_guarded_counter_still_linted(tmp_path):
+    # A Condition IS a mutex when held via `with`: a racy counter in a
+    # Condition-only producer/consumer class must not slip the gate.
+    src = _RACY_COUNTER.replace("threading.Lock()",
+                                "threading.Condition()")
+    found = _concur_on(tmp_path, src)
+    assert _rules(found) == {"lock-guard"}
+    assert found[0].qualname == "Pool.note"
+
+
+def test_concur_path_join_under_lock_not_flagged(tmp_path):
+    src = textwrap.dedent(
+        '''
+        import os
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def path_of(self, d):
+                with self._lock:
+                    return os.path.join(d, "state.json")
+        '''
+    )
+    assert not _concur_on(tmp_path, src)
+
+
+def test_concur_unbounded_event_wait_under_lock_flagged(tmp_path):
+    # Bare .wait() on a known non-Condition self attr is an UNBOUNDED
+    # block under the lock — strictly worse than a timed one.
+    src = textwrap.dedent(
+        '''
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._done = threading.Event()
+
+            def drain(self):
+                with self._lock:
+                    self._done.wait()
+        '''
+    )
+    found = _concur_on(tmp_path, src)
+    assert _rules(found) == {"lock-blocking"}
+
+
+def test_concur_condition_wait_not_flagged(tmp_path):
+    # Condition.wait RELEASES the lock — the canonical producer/
+    # consumer idiom must stay quiet or the rule is unusable.
+    src = textwrap.dedent(
+        '''
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._cond_lock = threading.Condition()
+
+            def take(self):
+                with self._cond_lock:
+                    self._cond_lock.wait(0.2)
+        '''
+    )
+    assert not _concur_on(tmp_path, src)
+
+
+# ---------------------------------------------------------------------------
+# happens-before model checker: seeded violations
+# ---------------------------------------------------------------------------
+
+_SENTINEL_FIRST = textwrap.dedent(
+    '''
+    from tsspark_tpu.utils.atomic import atomic_write
+
+    def land(out_dir, data):
+        atomic_write(out_dir + "/ok.json", lambda fh: fh.write("{}"))
+        atomic_write(out_dir + "/payload.bin",
+                     lambda fh: fh.write(data))
+    '''
+)
+
+_PAYLOAD_FIRST = textwrap.dedent(
+    '''
+    from tsspark_tpu.utils.atomic import atomic_write
+
+    def land(out_dir, data):
+        atomic_write(out_dir + "/payload.bin",
+                     lambda fh: fh.write(data))
+        atomic_write(out_dir + "/ok.json", lambda fh: fh.write("{}"))
+    '''
+)
+
+
+def _fixture_protocol(edges=()):
+    return protomodel.ProtocolSpec(
+        "fixture", "mod.py", "land",
+        steps=(
+            protomodel.StepSpec("payload", "tok:payload.bin",
+                                reader="resumer redoes it"),
+            protomodel.StepSpec("ok", "tok:ok.json", role="gate",
+                                certifies=("payload",)),
+        ),
+        edges=edges,
+    )
+
+
+def test_protomodel_catches_sentinel_before_payload(tmp_path):
+    (tmp_path / "mod.py").write_text(_SENTINEL_FIRST)
+    found = protomodel.check_protocols(str(tmp_path),
+                                       [_fixture_protocol()])
+    assert _rules(found) == {"hb-order"}
+    # The correct order is clean.
+    (tmp_path / "mod.py").write_text(_PAYLOAD_FIRST)
+    assert not protomodel.check_protocols(str(tmp_path),
+                                          [_fixture_protocol()])
+
+
+def test_protomodel_killpoint_sweep_catches_weak_edges(tmp_path):
+    """Edges that leave the gate unordered against its payload admit a
+    linearization where a kill right after the gate exposes a payload
+    that never landed — the sweep must find it statically."""
+    (tmp_path / "mod.py").write_text(_PAYLOAD_FIRST)
+    loose = protomodel.ProtocolSpec(
+        "fixture-loose", "mod.py", "land",
+        steps=(
+            protomodel.StepSpec("payload", "tok:payload.bin",
+                                reader="resumer redoes it"),
+            protomodel.StepSpec("extra", "tok:payload.bin",
+                                reader="resumer redoes it"),
+            protomodel.StepSpec("ok", "tok:ok.json", role="gate",
+                                certifies=("payload", "extra")),
+        ),
+        # Only payload<extra declared: the gate floats freely.
+        edges=(("payload", "extra"),),
+    )
+    found = protomodel.check_protocols(str(tmp_path), [loose])
+    assert "hb-unsafe" in _rules(found)
+
+
+def test_protomodel_rejects_inconsistent_model(tmp_path):
+    (tmp_path / "mod.py").write_text(_PAYLOAD_FIRST)
+    bad = protomodel.ProtocolSpec(
+        "fixture-bad", "mod.py", "land",
+        steps=(
+            protomodel.StepSpec("payload", "tok:payload.bin",
+                                reader=""),  # no resumer story
+            protomodel.StepSpec("ok", "tok:ok.json", role="gate",
+                                certifies=("ghost",)),
+        ),
+    )
+    found = protomodel.check_protocols(str(tmp_path), [bad])
+    assert _rules(found) == {"hb-model"}
+    msgs = "\n".join(f.message for f in found)
+    assert "ghost" in msgs and "reader" in msgs
+
+
+def test_protomodel_live_registry_is_clean():
+    assert not protomodel.check_protocols(repo_root())
+
+
+def test_protomodel_detects_model_drift(tmp_path):
+    # A declared step that matches nothing in the writer is drift, not
+    # silence: the model must fail loudly when the code moves on.
+    (tmp_path / "mod.py").write_text(_PAYLOAD_FIRST)
+    drifted = protomodel.ProtocolSpec(
+        "fixture-drift", "mod.py", "land",
+        steps=(
+            protomodel.StepSpec("payload", "tok:renamed.bin",
+                                reader="r"),
+            protomodel.StepSpec("ok", "tok:ok.json", role="gate",
+                                certifies=("payload",)),
+        ),
+    )
+    found = protomodel.check_protocols(str(tmp_path), [drifted])
+    assert _rules(found) == {"hb-missing"}
+
+
+# ---------------------------------------------------------------------------
+# the ANALYSIS_* gate artifact + history row
+# ---------------------------------------------------------------------------
+
+def test_analysis_report_roundtrip_and_history_row(tmp_path):
+    import json as json_mod
+
+    from tsspark_tpu.obs import history
+
+    rep_obj = analysis.AnalysisReport((), (), (("trace", 0),
+                                              ("concur", 2)))
+    rep = analysis_report.build_report(
+        rep_obj, AnalysisSettings(), repo_root(), 1.5
+    )
+    assert rep["kind"] == "analysis-gate" and rep["ok"]
+    assert rep["checkers"] == {"trace": 0, "concur": 2}
+    # The live tree carries real inline waivers (each with a reason).
+    assert rep["waivers_inline"] >= 1
+    path = analysis_report.write_report(rep, out_dir=str(tmp_path))
+    with open(path) as fh:
+        d = json_mod.load(fh)
+    hp = str(tmp_path / "RUNHISTORY.jsonl")
+    row, appended = history.ingest(d, hp, source=path)
+    assert appended and row["kind"] == "analysis"
+    assert row["workload"] == "analysis_full"
+    assert row["metrics"]["raw_concur"] == 2
+    assert row["metrics"]["ok"] == 1
+    # Idempotent by content identity: re-ingesting is a no-op.
+    _row2, appended2 = history.ingest(d, hp, source=path)
+    assert not appended2
+
+
+def test_changed_scope_helper():
+    from tsspark_tpu.analysis.__main__ import changed_package_paths
+
+    paths = changed_package_paths(repo_root(), "HEAD")
+    assert isinstance(paths, list)
+    assert all(p.endswith(".py") and os.path.exists(p) for p in paths)
+    with pytest.raises(SystemExit):
+        changed_package_paths(repo_root(), "no-such-ref-xyz")
 
 
 # ---------------------------------------------------------------------------

@@ -1,6 +1,6 @@
 """JAX/TPU-aware static analysis gating every PR (``docs/ANALYSIS.md``).
 
-Three checkers, all device-free:
+Six checkers, all device-free:
 
 * ``tracelint``  — AST trace-safety lint over the package (tracer
   branching, host syncs in jitted scopes, f64 drift, silent-recompile
@@ -10,6 +10,15 @@ Three checkers, all device-free:
 * ``fileproto``  — static model of the orchestrator/streaming/
   checkpoint artifact lifecycle: atomic-write enforcement plus a
   small-model check that range claims can never overlap.
+* ``concur``     — concurrency gate: lock-discipline lint (guarded
+  attributes, blocking calls under a lock), thread-lifecycle lint
+  (join-on-exit, no silently-swallowed target failures), and the
+  mmap-aliasing check (read-only plane attaches must never flow into
+  in-place mutation).
+* ``proto``      — happens-before model checker: the sentinel
+  protocols' declared ordering edges verified against each writer's
+  call graph, plus an exhaustive kill-point sweep over the lifecycle
+  DAG.
 * ``hygiene``    — repo hygiene: no committed bytecode
   (``__pycache__``/``.pyc`` in the git index) and the root
   ``.gitignore`` keeps covering interpreter-generated dirs.
@@ -17,6 +26,8 @@ Three checkers, all device-free:
 Run locally with ``python -m tsspark_tpu.analysis``; the same pass runs
 as a default-on tier-1 test (``tests/test_analysis.py``), so a PR that
 introduces a hazard fails CI before it ever touches a TPU.
+``--changed <git-ref>`` scopes the per-file passes (trace, concur) to
+modules touched since the ref — the pre-commit fast path.
 
 Importing this package stays light (stdlib + tomli); JAX loads only
 when the contract checker actually runs.
@@ -26,7 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 from tsspark_tpu.analysis.config import (
     AnalysisSettings,
@@ -53,18 +64,28 @@ class AnalysisReport:
         return not self.findings
 
 
+DEFAULT_CHECKERS: Tuple[str, ...] = (
+    "trace", "contracts", "fileproto", "concur", "proto", "hygiene",
+)
+
+
 def run_all(
     root: Optional[str] = None,
     settings: Optional[AnalysisSettings] = None,
-    checkers: Tuple[str, ...] = ("trace", "contracts", "fileproto",
-                                 "hygiene"),
+    checkers: Tuple[str, ...] = DEFAULT_CHECKERS,
+    scope_paths: Optional[Sequence[str]] = None,
 ) -> AnalysisReport:
     """The full pass over the repo at ``root`` (default: the installed
-    package's parent)."""
+    package's parent).  ``scope_paths`` narrows the per-file passes
+    (trace, concur) to the given files — the ``--changed`` fast path;
+    the whole-repo models (contracts, fileproto, proto, hygiene) always
+    run over their full closure."""
     from tsspark_tpu.analysis import (
+        concur,
         contracts,
         fileproto,
         hygiene,
+        protomodel,
         tracelint,
     )
 
@@ -74,7 +95,15 @@ def run_all(
     raw = []
     counts = []
     if "trace" in checkers:
-        found = tracelint.lint_package(root, package_dir)
+        if scope_paths is not None:
+            found = tracelint.lint_paths(
+                list(scope_paths), root,
+                package_static=tracelint.package_static_names(
+                    package_dir
+                ),
+            )
+        else:
+            found = tracelint.lint_package(root, package_dir)
         counts.append(("trace", len(found)))
         raw += found
     if "contracts" in checkers:
@@ -84,6 +113,17 @@ def run_all(
     if "fileproto" in checkers:
         found = fileproto.check_fileproto(root)
         counts.append(("fileproto", len(found)))
+        raw += found
+    if "concur" in checkers:
+        if scope_paths is not None:
+            found = concur.check_paths(list(scope_paths), root)
+        else:
+            found = concur.check_package(root, package_dir)
+        counts.append(("concur", len(found)))
+        raw += found
+    if "proto" in checkers:
+        found = protomodel.check_protocols(root)
+        counts.append(("proto", len(found)))
         raw += found
     if "hygiene" in checkers:
         found = hygiene.check_hygiene(root)

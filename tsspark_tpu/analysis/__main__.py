@@ -2,7 +2,12 @@
 
 Exit code 0 when every checker is clean (after the committed
 suppression baseline), 1 otherwise.  ``--checker`` narrows to one pass;
-``-v`` also prints what the baseline suppressed.
+``-v`` also prints what the baseline suppressed.  ``--changed
+<git-ref>`` is the pre-commit fast path: the per-file passes (trace,
+concur) run only over package modules touched since the ref, while the
+whole-repo models (contracts, fileproto, proto, hygiene) keep their
+full closure.  A full run writes an ``ANALYSIS_*.json`` artifact and
+self-ingests it into RUNHISTORY (``--no-report`` skips both).
 
 The contract checker needs a JAX backend with enough devices for the
 mesh matrix: like the test suite's conftest, this entry point pins
@@ -14,7 +19,49 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
+import time
+
+
+def changed_package_paths(root: str, ref: str):
+    """Package ``.py`` files touched since ``ref`` — tracked changes
+    PLUS untracked new files (``git diff`` never lists those, and
+    brand-new modules are exactly where fresh violations live).
+    Absolute paths; deleted files excluded.  Raises on a bad ref — a
+    typo'd ref silently scoping to nothing would pass vacuously."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--", "tsspark_tpu"],
+        cwd=root, capture_output=True, text=True, timeout=30,
+    )
+    if out.returncode != 0:
+        raise SystemExit(
+            f"--changed {ref!r}: git diff failed: "
+            f"{out.stderr.strip() or out.stdout.strip()}"
+        )
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard", "--",
+         "tsspark_tpu"],
+        cwd=root, capture_output=True, text=True, timeout=30,
+    )
+    if untracked.returncode != 0:
+        # Same policy as a failed diff: silently dropping untracked
+        # modules would let a brand-new file's violations pass the
+        # scoped gate unseen.
+        raise SystemExit(
+            f"--changed {ref!r}: git ls-files failed: "
+            f"{untracked.stderr.strip() or untracked.stdout.strip()}"
+        )
+    listed = out.stdout.splitlines() + untracked.stdout.splitlines()
+    paths = []
+    for rel in listed:
+        rel = rel.strip()
+        if not rel.endswith(".py"):
+            continue
+        path = os.path.join(root, rel)
+        if os.path.exists(path) and path not in paths:
+            paths.append(path)
+    return paths
 
 
 def main(argv=None) -> int:
@@ -30,30 +77,55 @@ def main(argv=None) -> int:
     )
     ap.add_argument(
         "--checker",
-        choices=("trace", "contracts", "fileproto", "hygiene"),
+        choices=("trace", "contracts", "fileproto", "concur", "proto",
+                 "hygiene"),
         action="append",
         help="run only this checker (repeatable; default: all)",
     )
     ap.add_argument("--root", default=None,
                     help="repo root (default: the package's parent)")
+    ap.add_argument("--changed", default=None, metavar="GIT_REF",
+                    help="fast mode: scope trace+concur to package "
+                         "modules touched since this ref (contracts/"
+                         "fileproto/proto/hygiene still run whole)")
+    ap.add_argument("--no-report", action="store_true",
+                    help="skip the ANALYSIS_* artifact + RUNHISTORY "
+                         "ingest (fast/scoped runs skip it anyway)")
     ap.add_argument("-v", "--verbose", action="store_true",
                     help="also print baseline-suppressed findings")
     args = ap.parse_args(argv)
 
     from tsspark_tpu import analysis
 
+    checkers = (tuple(args.checker) if args.checker
+                else analysis.DEFAULT_CHECKERS)
+
     # The machine image may pre-register a TPU plugin at interpreter
     # start; pin the config level too (same defense as tests/conftest).
-    if any("contracts" in c for c in (args.checker or ["contracts"])):
+    if "contracts" in checkers:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
 
+    from tsspark_tpu.analysis.config import repo_root
+
+    root = args.root or repo_root()
+    scope = None
+    if args.changed:
+        scope = changed_package_paths(root, args.changed)
+        if not scope:
+            print(f"--changed {args.changed}: no package modules "
+                  "touched; per-file passes are vacuous")
+
+    t0 = time.monotonic()
+    from tsspark_tpu.analysis.config import load_settings
+
+    settings = load_settings(root)
     report = analysis.run_all(
-        root=args.root,
-        checkers=tuple(args.checker) if args.checker
-        else ("trace", "contracts", "fileproto", "hygiene"),
+        root=root, settings=settings, checkers=checkers,
+        scope_paths=scope,
     )
+    wall_s = time.monotonic() - t0
     for f in report.findings:
         print(f)
     if args.verbose:
@@ -65,6 +137,17 @@ def main(argv=None) -> int:
         f"tsspark_tpu.analysis: {kept} finding(s) "
         f"({len(report.suppressed)} baselined; raw per checker: {per})"
     )
+    # The artifact records FULL gate runs only: a scoped/partial run's
+    # counts are not comparable points on the trajectory.
+    if (not args.no_report and scope is None
+            and set(checkers) == set(analysis.DEFAULT_CHECKERS)):
+        from tsspark_tpu.analysis import report as report_mod
+
+        rep = report_mod.build_report(report, settings, root, wall_s)
+        path = report_mod.write_report(rep, out_dir=root)
+        ingested = report_mod.ingest_report(rep, path, root=root)
+        print(f"report: {os.path.basename(path)}"
+              f"{' (ingested)' if ingested else ''}")
     return 1 if kept else 0
 
 

@@ -1,0 +1,792 @@
+"""Concurrency lint: lock discipline, thread lifecycle, mmap aliasing.
+
+The review-hardening lists of PRs 10/13/14 were dominated by bug
+classes a machine can find mechanically: counters bumped without the
+lock that guards them elsewhere (the ``wrong_version`` fix), locks held
+across multi-second blocking calls (the respawn-under-``_lock`` fix),
+thread targets that swallow failures (the publisher-join fix), and
+in-place mutation of shared mmap planes (the PR 13 shared-plane
+hazard).  This module turns each into a tier-1 gate rule:
+
+* ``lock-guard`` — per-class inference of guarded attributes.  An
+  attribute WRITTEN under ``with self.<lock>:`` in one method is
+  "guarded by that lock"; in a class that runs code on more than one
+  thread (it spawns via ``Thread(target=...)``/``Timer`` — external
+  caller threads cannot be seen statically), any other write to that
+  attribute outside the lock is a finding, ``__init__`` excepted (the
+  constructor runs before any thread exists).  Methods whose every
+  intra-class call site already holds the lock are treated as entered
+  with it held (the ``refresh``-under-``pump`` pattern).  Reads are
+  deliberately not linted — stats/snapshot reads of monotonic counters
+  are benign and would bury the signal.
+* ``lock-blocking`` — a blocking call directly inside a ``with
+  <lock>:`` body: ``time.sleep`` at/over 100 ms (or a non-constant
+  delay), ``subprocess.run``/``check_call``/``check_output``,
+  ``select.select``, socket ``recv``/``sendall``/``accept``/
+  ``connect``, ``.wait(...)``/``.join(...)`` on things that are not a
+  Condition (Condition.wait releases the lock; ``str.join`` is
+  excluded by argument shape).  Only DIRECT calls in the ``with`` body
+  are flagged — serializing one slow I/O op behind a dedicated lock is
+  a legitimate idiom, so the rule targets locks that also guard state.
+* ``thread-join`` — every ``threading.Thread``/``Timer`` spawned must
+  be joined somewhere in its module (matched through the names/attrs
+  the thread object flows to), or be ``daemon=True`` WITH an inline
+  waiver explaining why abandonment is safe.
+* ``thread-exc`` — a thread target (resolved intra-module) must
+  contain a broad exception handler (``except Exception``/
+  ``BaseException``/bare) that stashes, counts, or reports the
+  failure.  A target whose only handlers are narrow lets an unexpected
+  failure kill the thread silently — the publisher-thread bug class
+  PR 14 fixed by hand.
+* ``mmap-alias`` — arrays originating from READ-ONLY attaches
+  (``np.load(..., mmap_mode="r")``, ``open_memmap(..., mode="r")``,
+  ``snapplane.attach``, ``plane.open_batch``) must never flow into an
+  in-place mutation site (``x[...] = ``, ``x += ``, ``np.copyto``
+  dst, ``.sort()``/``.fill()``/``.partition()``) within the function.
+  Taint propagates through assignment, attribute/subscript access and
+  ``np.asarray`` (the one numpy entry point that does NOT copy); any
+  other call (``np.array``, ``.copy()``, ``.astype()``, ...) is
+  assumed to return fresh memory and launders the view — conservative
+  against false positives, and the sanctioned copy-first fix is
+  exactly such a call.
+
+All rules honor the inline ``# lint-ok[rule]: reason`` waiver on the
+flagged line (for ``lock-blocking``, also on the enclosing ``with``
+line, so one justified lifecycle lock does not need a waiver per
+statement) and the pyproject baseline.  Like every static pass here,
+the margins are heuristic BY DESIGN: the contract is zero unexplained
+findings, not zero waivers.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tsspark_tpu.analysis.findings import Finding
+from tsspark_tpu.analysis.tracelint import _ModuleScan, _walk_functions
+
+#: time.sleep at or over this many seconds inside a lock is a finding.
+SLEEP_THRESHOLD_S = 0.1
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTORS = {"Condition"}
+_THREAD_CTORS = {"Thread", "Timer"}
+_BLOCKING_SUBPROCESS = {"run", "check_call", "check_output", "call"}
+_SOCKET_BLOCKING = {"recv", "sendall", "accept", "connect"}
+# In-place ndarray mutators (beyond subscript/augmented assignment).
+_INPLACE_METHODS = {"sort", "fill", "partition", "put"}
+_TAINT_SOURCES = {"attach", "open_batch"}
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _is_threading_ctor(node: ast.Call, ctors: Set[str]) -> bool:
+    """``threading.Thread(...)`` / bare ``Thread(...)`` etc."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id == "threading" and f.attr in ctors
+    return isinstance(f, ast.Name) and f.id in ctors
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``X`` (None otherwise)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    """Plain names (and self-attrs, prefixed ``self.``) a value is
+    assigned to."""
+    out: List[str] = []
+    if isinstance(target, ast.Name):
+        out.append(target.id)
+    elif isinstance(target, ast.Tuple):
+        for e in target.elts:
+            out += _target_names(e)
+    else:
+        sa = _self_attr(target)
+        if sa is not None:
+            out.append(f"self.{sa}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-class lock model
+# ---------------------------------------------------------------------------
+
+
+class _ClassModel:
+    """Lock/thread facts for one class definition."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.lock_attrs: Set[str] = set()      # threading.Lock/RLock
+        self.cond_attrs: Set[str] = set()      # threading.Condition
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        #: method -> simple names of intra-class methods it calls
+        self.calls: Dict[str, Set[str]] = {}
+        #: method -> locks held at EVERY intra-class call site (None =
+        #: never called intra-class)
+        self.entry_locks: Dict[str, Optional[Set[str]]] = {}
+        #: methods used as Thread(target=self.m) entry points
+        self.thread_entries: Set[str] = set()
+        #: methods containing a Thread(...) spawn (their nested targets
+        #: run on the new thread)
+        self.spawner_methods: Set[str] = set()
+
+
+def _collect_classes(tree: ast.Module) -> List[Tuple[str, ast.ClassDef]]:
+    out = []
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                out.append((f"{prefix}{child.name}", child))
+                visit(child, f"{prefix}{child.name}.")
+            elif not isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                visit(child, prefix)
+
+    visit(tree, "")
+    return out
+
+
+def _build_class_model(qual: str, cls: ast.ClassDef) -> _ClassModel:
+    model = _ClassModel(qual)
+    for stmt in cls.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            model.methods[stmt.name] = stmt
+    # Lock attribute discovery: self.X = threading.Lock()/RLock()/
+    # Condition() anywhere in any method (usually __init__).
+    for m in model.methods.values():
+        for sub in ast.walk(m):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value,
+                                                          ast.Call):
+                for t in sub.targets:
+                    sa = _self_attr(t)
+                    if sa is None:
+                        continue
+                    if _is_threading_ctor(sub.value, _LOCK_CTORS):
+                        model.lock_attrs.add(sa)
+                    elif _is_threading_ctor(sub.value, _COND_CTORS):
+                        model.cond_attrs.add(sa)
+    # Intra-class call graph + thread entry points.
+    for name, m in model.methods.items():
+        calls: Set[str] = set()
+        for sub in ast.walk(m):
+            if not isinstance(sub, ast.Call):
+                continue
+            sa = _self_attr(sub.func)
+            if sa is not None and sa in model.methods:
+                calls.add(sa)
+            if _is_threading_ctor(sub, _THREAD_CTORS):
+                model.spawner_methods.add(name)
+                for kw in sub.keywords:
+                    if kw.arg == "target":
+                        tsa = _self_attr(kw.value)
+                        if tsa is not None and tsa in model.methods:
+                            model.thread_entries.add(tsa)
+                        elif isinstance(kw.value, ast.Name):
+                            # Thread(target=local_fn): the nested def's
+                            # own self-method calls run on the thread.
+                            for nd in ast.walk(m):
+                                if isinstance(nd, ast.FunctionDef) \
+                                        and nd.name == kw.value.id:
+                                    for c in ast.walk(nd):
+                                        if isinstance(c, ast.Call):
+                                            csa = _self_attr(c.func)
+                                            if csa in model.methods:
+                                                model.thread_entries \
+                                                    .add(csa)
+                # Timer(delay, fn): positional callback.
+                if (_is_threading_ctor(sub, {"Timer"})
+                        and len(sub.args) > 1):
+                    tsa = _self_attr(sub.args[1])
+                    if tsa is not None and tsa in model.methods:
+                        model.thread_entries.add(tsa)
+        model.calls[name] = calls
+    return model
+
+
+def _held_locks_at_calls(model: _ClassModel) -> None:
+    """Fill ``entry_locks``: for each method, the set of lock attrs held
+    at EVERY intra-class call site (so a method only ever invoked under
+    a lock is analyzed as entered with it held)."""
+    sites: Dict[str, List[Set[str]]] = {m: [] for m in model.methods}
+
+    def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            add: List[str] = []
+            for item in node.items:
+                sa = _self_attr(item.context_expr)
+                if sa is not None and sa in (model.lock_attrs
+                                             | model.cond_attrs):
+                    add.append(sa)
+            inner = held + tuple(add)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            sa = _self_attr(node.func)
+            if sa is not None and sa in model.methods:
+                sites[sa].append(set(held))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested defs execute later (often on another thread):
+            # locks held at definition are NOT held at run time.
+            held = ()
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for m in model.methods.values():
+        for stmt in m.body:
+            visit(stmt, ())
+    for name, call_sites in sites.items():
+        if not call_sites:
+            model.entry_locks[name] = None
+        else:
+            common = set(call_sites[0])
+            for s in call_sites[1:]:
+                common &= s
+            model.entry_locks[name] = common
+
+
+def _multi_thread_class(model: _ClassModel) -> bool:
+    """Does this class run code on more than one thread?  True when it
+    spawns any thread — once it does, every non-constructor method is
+    potentially concurrent with the spawned ones (and external caller
+    threads cannot be seen statically anyway).  A class that never
+    spawns has no intra-class concurrency: defensive API locking in a
+    single-threaded class is not linted."""
+    return bool(model.thread_entries or model.spawner_methods)
+
+
+def _guarded_writes(model: _ClassModel) -> Dict[str, Set[str]]:
+    """attr -> lock names it is written under somewhere in the class.
+    Conditions count as locks here: ``with self._cond:`` holds the
+    condition's underlying mutex, so writes under it are guarded by it
+    exactly like a plain Lock."""
+    guarded: Dict[str, Set[str]] = {}
+    mutexes = model.lock_attrs | model.cond_attrs
+
+    def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            add = [sa for item in node.items
+                   for sa in [_self_attr(item.context_expr)]
+                   if sa is not None and sa in mutexes]
+            inner = held + tuple(add)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if held and isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                sa = _self_attr(t)
+                if sa is not None and sa not in mutexes:
+                    guarded.setdefault(sa, set()).update(held)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            held = ()
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for name, m in model.methods.items():
+        entry = model.entry_locks.get(name) or set()
+        for stmt in m.body:
+            visit(stmt, tuple(sorted(entry)))
+    return guarded
+
+
+def _check_lock_guard(scan: _ModuleScan, qual: str, model: _ClassModel,
+                      findings: List[Finding]) -> None:
+    _held_locks_at_calls(model)
+    guarded = _guarded_writes(model)
+    if not guarded or not _multi_thread_class(model):
+        return
+
+    mutexes = model.lock_attrs | model.cond_attrs
+
+    def visit(name: str, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, ast.With):
+            add = [sa for item in node.items
+                   for sa in [_self_attr(item.context_expr)]
+                   if sa is not None and sa in mutexes]
+            inner = held + tuple(add)
+            for stmt in node.body:
+                visit(name, stmt, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                sa = _self_attr(t)
+                if (sa is not None and sa in guarded
+                        and not (set(held) & guarded[sa])
+                        and not scan.line_ok(node.lineno, "lock-guard")):
+                    locks = "/".join(sorted(guarded[sa]))
+                    findings.append(Finding(
+                        "lock-guard", scan.relpath, node.lineno,
+                        f"{qual}.{name}",
+                        f"write to self.{sa} without {locks} (held at "
+                        "other writes of this attribute; this class "
+                        "runs on multiple threads, so the unguarded "
+                        "write can interleave with — or hide — a "
+                        "guarded one)",
+                    ))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            held = ()
+        for child in ast.iter_child_nodes(node):
+            visit(name, child, held)
+
+    for name, m in sorted(model.methods.items()):
+        if name == "__init__":
+            continue  # constructor runs before any thread exists
+        entry = model.entry_locks.get(name) or set()
+        for stmt in m.body:
+            visit(name, stmt, tuple(sorted(entry)))
+
+
+# ---------------------------------------------------------------------------
+# blocking calls under a lock
+# ---------------------------------------------------------------------------
+
+
+def _blocking_reason(node: ast.Call,
+                     cond_attrs: Set[str]) -> Optional[str]:
+    """Why this call blocks (None when it does not / cannot be told)."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        mod, attr = f.value.id, f.attr
+        if mod == "time" and attr == "sleep":
+            if node.args and isinstance(node.args[0], ast.Constant):
+                try:
+                    if float(node.args[0].value) < SLEEP_THRESHOLD_S:
+                        return None
+                except (TypeError, ValueError):
+                    pass
+            return "time.sleep"
+        if mod == "subprocess" and attr in _BLOCKING_SUBPROCESS:
+            return f"subprocess.{attr}"
+        if mod == "select" and attr == "select":
+            return "select.select"
+    if isinstance(f, ast.Attribute):
+        if f.attr in _SOCKET_BLOCKING:
+            return f".{f.attr}()"
+        if f.attr == "join":
+            # str.join: a string-literal receiver, an argument that is
+            # clearly an iterable CONSTRUCTION, or more than one
+            # positional argument (Thread/Process.join takes at most
+            # one — os.path.join(a, b) must never flag).  An `os.path`
+            # receiver is exempt outright.  Everything else — bare
+            # t.join(), t.join(5.0), t.join(self.grace_s),
+            # join(timeout=...) — is treated as a thread/process join
+            # (the multi-second-block-under-lock class); a genuine
+            # sep.join(parts) under a lock takes a waiver.
+            if isinstance(f.value, ast.Constant) \
+                    and isinstance(f.value.value, str):
+                return None
+            if isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "path":
+                return None
+            if len(node.args) >= 2:
+                return None
+            if (len(node.args) == 1 and not node.keywords
+                    and isinstance(node.args[0],
+                                   (ast.ListComp, ast.GeneratorExp,
+                                    ast.List, ast.Tuple, ast.Set,
+                                    ast.Call, ast.Starred))):
+                return None
+            return ".join(...)"
+        if f.attr == "wait":
+            # Condition.wait RELEASES the lock — never a finding.
+            sa = _self_attr(f.value)
+            if sa is not None and sa in cond_attrs:
+                return None
+            if sa is not None:
+                # A known NON-Condition self attribute: bare .wait()
+                # is an UNBOUNDED block under the lock — worse than a
+                # timed one, flag it too.
+                return ".wait(...)"
+            # Plain x.wait() on a LOCAL name can't be told from a
+            # Condition statically; only flag when a delay/timeout is
+            # requested (Event.wait(t), proc.wait(timeout=...)).
+            if node.args or any(kw.arg == "timeout"
+                                for kw in node.keywords):
+                return ".wait(...)"
+    return None
+
+
+def _check_lock_blocking(scan: _ModuleScan,
+                         findings: List[Finding]) -> None:
+    tree = scan.tree
+    # self.<attr> Condition registry per class (to exempt cond.wait).
+    cond_attrs: Set[str] = set()
+    module_locks: Set[str] = set()
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call):
+            if _is_threading_ctor(sub.value, _COND_CTORS):
+                for t in sub.targets:
+                    sa = _self_attr(t)
+                    if sa is not None:
+                        cond_attrs.add(sa)
+                    elif isinstance(t, ast.Name):
+                        cond_attrs.add(t.id)
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value,
+                                                       ast.Call):
+            if _is_threading_ctor(stmt.value, _LOCK_CTORS):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        module_locks.add(t.id)
+
+    def lockish(expr: ast.AST, local_locks: Set[str]) -> Optional[str]:
+        # A mutex held by NAME: self._lock / pool._lock / a local or
+        # module-level threading.Lock().  `self._locked()` (a Call) is
+        # deliberately excluded — the flock-based file locks serialize
+        # PROCESSES, where blocking the peer is the whole point.
+        if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+            return expr.attr
+        if isinstance(expr, ast.Name) and (
+            expr.id in local_locks or expr.id in module_locks
+        ):
+            return expr.id
+        if (isinstance(expr, ast.Call)
+                and _is_threading_ctor(expr, _LOCK_CTORS)):
+            return "anonymous lock"
+        return None
+
+    def visit_fn(fn: ast.AST, qual: str) -> None:
+        local_locks: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and isinstance(sub.value,
+                                                          ast.Call):
+                if _is_threading_ctor(sub.value, _LOCK_CTORS):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            local_locks.add(t.id)
+
+        def walk_with(node: ast.AST, lock_name: Optional[str],
+                      with_line: int) -> None:
+            if isinstance(node, ast.With):
+                found = None
+                for item in node.items:
+                    found = found or lockish(item.context_expr,
+                                             local_locks)
+                if found is not None:
+                    for stmt in node.body:
+                        walk_with(stmt, found, node.lineno)
+                    return
+            if (lock_name is not None and isinstance(node, ast.Call)):
+                why = _blocking_reason(node, cond_attrs)
+                if why is not None \
+                        and not scan.line_ok(node.lineno,
+                                             "lock-blocking") \
+                        and not scan.line_ok(with_line, "lock-blocking"):
+                    findings.append(Finding(
+                        "lock-blocking", scan.relpath, node.lineno,
+                        qual,
+                        f"{why} while holding {lock_name}: every other "
+                        "thread contending this lock stalls for the "
+                        "full blocking window (move the call outside "
+                        "the critical section, or waive with the "
+                        "reason the stall is acceptable)",
+                    ))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return  # nested defs run later, lock not held
+            for child in ast.iter_child_nodes(node):
+                walk_with(child, lock_name, with_line)
+
+        for stmt in fn.body:
+            walk_with(stmt, None, fn.lineno)
+
+    for qual, info in scan.functions.items():
+        visit_fn(info.node, qual)
+
+
+# ---------------------------------------------------------------------------
+# thread lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _broad_handler(fn: ast.AST) -> bool:
+    """Does the function ITSELF contain a broad except (Exception /
+    BaseException / bare) — the minimum bar for 'failures cannot escape
+    this thread target silently'?  Nested defs are excluded: a handler
+    inside a helper the target spawns does not protect the target."""
+    nested = {
+        id(s) for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not fn
+        for s in ast.walk(n)
+    }
+    for sub in ast.walk(fn):
+        if id(sub) in nested:
+            continue
+        if isinstance(sub, ast.ExceptHandler):
+            t = sub.type
+            if t is None:
+                return True
+            names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+            for n in names:
+                base = n.attr if isinstance(n, ast.Attribute) else (
+                    n.id if isinstance(n, ast.Name) else None
+                )
+                if base in ("Exception", "BaseException"):
+                    return True
+    return False
+
+
+def _check_threads(scan: _ModuleScan, findings: List[Finding]) -> None:
+    tree = scan.tree
+    qualnames: Dict[int, str] = {
+        id(info.node): qual for qual, info in scan.functions.items()
+    }
+    # All join targets in the module: X.join(...) / self.X.join(...).
+    join_names: Set[str] = set()
+    for sub in ast.walk(tree):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "join"):
+            recv = sub.func.value
+            if isinstance(recv, ast.Name):
+                join_names.add(recv.id)
+            else:
+                sa = _self_attr(recv)
+                if sa is not None:
+                    join_names.add(f"self.{sa}")
+
+    for qual, info in scan.functions.items():
+        fn = info.node
+        nested = {
+            id(s) for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not fn
+            for s in ast.walk(n)
+        }
+        for stmt in ast.walk(fn):
+            if id(stmt) in nested:
+                continue
+            spawn: Optional[ast.Call] = None
+            aliases: List[str] = []
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Call) \
+                    and _is_threading_ctor(stmt.value, _THREAD_CTORS):
+                spawn = stmt.value
+                for t in stmt.targets:
+                    aliases += _target_names(t)
+            elif isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call):
+                # Thread(...).start() fire-and-forget (no alias at all).
+                call = stmt.value
+                if (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "start"
+                        and isinstance(call.func.value, ast.Call)
+                        and _is_threading_ctor(call.func.value,
+                                               _THREAD_CTORS)):
+                    spawn = call.func.value
+                elif _is_threading_ctor(call, _THREAD_CTORS):
+                    spawn = call
+            if spawn is None:
+                continue
+            # Follow one level of aliasing: t = Thread(...); self.x = t.
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) \
+                        and isinstance(sub.value, ast.Name) \
+                        and sub.value.id in aliases:
+                    for t in sub.targets:
+                        aliases += _target_names(t)
+            daemon = any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True for kw in spawn.keywords
+            )
+            joined = any(a in join_names for a in aliases)
+            if not joined and not scan.line_ok(spawn.lineno,
+                                               "thread-join"):
+                what = ("daemon thread" if daemon
+                        else "non-daemon thread")
+                findings.append(Finding(
+                    "thread-join", scan.relpath, spawn.lineno, qual,
+                    f"{what} spawned here is never joined in this "
+                    "module: its failure (and its in-flight work) is "
+                    "invisible to every exit path of the owner — join "
+                    "it, or waive with the reason abandonment is safe",
+                ))
+            # Resolve the target for the exception-escape rule.
+            target_fn: Optional[ast.AST] = None
+            target_name = None
+            for kw in spawn.keywords:
+                if kw.arg == "target":
+                    sa = _self_attr(kw.value)
+                    if sa is not None:
+                        target_name = sa
+                    elif isinstance(kw.value, ast.Name):
+                        target_name = kw.value.id
+            if target_name is not None:
+                for tqual, tinfo in scan.functions.items():
+                    if tqual == target_name or tqual.endswith(
+                        "." + target_name
+                    ):
+                        target_fn = tinfo.node
+                        target_qual = tqual
+                        break
+            if target_fn is not None and not _broad_handler(target_fn) \
+                    and not scan.line_ok(target_fn.lineno,
+                                         "thread-exc") \
+                    and not scan.line_ok(spawn.lineno, "thread-exc"):
+                findings.append(Finding(
+                    "thread-exc", scan.relpath, target_fn.lineno,
+                    target_qual,
+                    "thread target has no broad exception handler: an "
+                    "unexpected failure kills the thread with only a "
+                    "stderr traceback — stash the error for the "
+                    "joiner, count it, or flip the owner's stop/fenced "
+                    "state so the failure is observable",
+                ))
+
+
+# ---------------------------------------------------------------------------
+# mmap aliasing
+# ---------------------------------------------------------------------------
+
+
+def _is_readonly_attach(node: ast.Call) -> bool:
+    name = _call_name(node)
+    if name in _TAINT_SOURCES:
+        return True
+    if name in ("load", "open_memmap"):
+        for kw in node.keywords:
+            if kw.arg in ("mmap_mode", "mode") \
+                    and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value == "r":
+                return True
+    return False
+
+
+def _expr_tainted(node: ast.AST, tainted: Set[str]) -> bool:
+    """Is this expression a view of a read-only attach?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        sa = _self_attr(node)
+        if sa is not None:
+            return f"self.{sa}" in tainted
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Subscript):
+        # A basic slice of an mmap is a view; fancy indexing copies,
+        # but conservatively treat both as views (cleansing calls are
+        # the sanctioned way out).
+        return _expr_tainted(node.value, tainted)
+    if isinstance(node, ast.Call):
+        if _is_readonly_attach(node):
+            return True
+        name = _call_name(node)
+        if name == "asarray":
+            # np.asarray does NOT copy: taint flows through.
+            return bool(node.args) and _expr_tainted(node.args[0],
+                                                     tainted)
+        # Every other call is assumed to return fresh memory
+        # (np.array/.copy()/.astype()/...): launders the view.
+        return False
+    return False
+
+
+def _check_mmap_alias(scan: _ModuleScan,
+                      findings: List[Finding]) -> None:
+    for qual, info in scan.functions.items():
+        fn = info.node
+        tainted: Set[str] = set()
+
+        def emit(node: ast.AST, what: str) -> None:
+            if not scan.line_ok(node.lineno, "mmap-alias"):
+                findings.append(Finding(
+                    "mmap-alias", scan.relpath, node.lineno, qual,
+                    f"{what} on an array attached read-only "
+                    "(np.load mmap_mode='r' / plane attach): in-place "
+                    "mutation of a shared mapped plane either raises "
+                    "at runtime or corrupts every concurrent reader — "
+                    "copy first (np.array / .copy() / .astype())",
+                ))
+
+        def visit(node: ast.AST) -> None:
+            # In-order traversal: taint state is sequential (an `out =
+            # np.array(mm)` must launder BEFORE `out[rows] = v` runs).
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return  # nested defs are checked as their own function
+            if isinstance(node, ast.Assign):
+                is_src = _expr_tainted(node.value, tainted)
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and _expr_tainted(t.value, tainted):
+                        emit(node, "subscript assignment")
+                    for name in _target_names(t):
+                        if is_src:
+                            tainted.add(name)
+                        else:
+                            tainted.discard(name)
+            elif isinstance(node, ast.AugAssign):
+                t = node.target
+                base = t.value if isinstance(t, ast.Subscript) else t
+                if _expr_tainted(base, tainted):
+                    emit(node, "augmented assignment")
+            elif isinstance(node, ast.Call):
+                name = _call_name(node)
+                if name == "copyto" and node.args \
+                        and _expr_tainted(node.args[0], tainted):
+                    emit(node, "np.copyto destination")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _INPLACE_METHODS
+                        and _expr_tainted(node.func.value, tainted)):
+                    emit(node, f".{node.func.attr}()")
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def check_paths(paths: Sequence[str], root: str) -> List[Finding]:
+    """All five concurrency rules over the given files."""
+    findings: List[Finding] = []
+    for path in paths:
+        with open(path, "r") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue  # tracelint owns the parse-error finding
+        scan = _ModuleScan(os.path.relpath(path, root), tree, source)
+        _walk_functions(scan)
+        for qual, cls in _collect_classes(tree):
+            model = _build_class_model(qual, cls)
+            if model.lock_attrs or model.cond_attrs:
+                _check_lock_guard(scan, qual, model, findings)
+        _check_lock_blocking(scan, findings)
+        _check_threads(scan, findings)
+        _check_mmap_alias(scan, findings)
+    return findings
+
+
+def check_package(root: str, package_dir: str) -> List[Finding]:
+    paths = []
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    return check_paths(sorted(paths), root)

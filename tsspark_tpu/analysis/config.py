@@ -7,13 +7,17 @@ suppression shows it in the diff.
 
 Suppression entry format (one string per finding)::
 
-    "<rule> @ <relpath>::<qualname>"
+    "<rule> @ <relpath>::<qualname> -- <justification>"
 
-e.g. ``"host-sync @ tsspark_tpu/models/prophet/model.py::select_better_state"``.
-A suppression matches every finding of that rule inside that function
-(line numbers churn; rule+symbol identity does not).  Inline
-suppressions use a ``# lint-ok[<rule>]: <reason>`` comment on the
-flagged line; the reason is mandatory — a bare marker does not count.
+e.g. ``"host-sync @ tsspark_tpu/models/prophet/model.py::select_better_state
+-- selection runs host-side between dispatches"``.  A suppression
+matches every finding of that rule inside that function (line numbers
+churn; rule+symbol identity does not).  The justification is MANDATORY
+— a baseline entry is a reviewed exception, and an exception without
+its reason is indistinguishable from a rubber stamp; entries missing
+the `` -- `` clause raise at load.  Inline suppressions use a
+``# lint-ok[<rule>]: <reason>`` comment on the flagged line; the reason
+is mandatory there too — a bare marker does not count.
 """
 
 from __future__ import annotations
@@ -48,16 +52,25 @@ class AnalysisSettings:
     def suppression_keys(self) -> Tuple[Tuple[str, str, str], ...]:
         """Parsed (rule, relpath, qualname) triples; malformed entries
         raise (a typo'd suppression silently matching nothing would
-        quietly re-open the finding it was meant to justify)."""
+        quietly re-open the finding it was meant to justify), and so
+        does a missing ``-- justification`` clause — every baseline
+        waiver must carry its reason in the committed diff."""
         out = []
         for s in self.suppressions:
+            body, sep, justification = s.partition(" -- ")
+            if not sep or not justification.strip():
+                raise ValueError(
+                    f"analysis suppression {s!r} carries no "
+                    "justification; expected '<rule> @ <relpath>::"
+                    "<qualname> -- <why this exception is sound>'"
+                )
             try:
-                rule, rest = s.split("@", 1)
+                rule, rest = body.split("@", 1)
                 relpath, qualname = rest.strip().split("::", 1)
             except ValueError:
                 raise ValueError(
                     f"malformed analysis suppression {s!r}; expected "
-                    "'<rule> @ <relpath>::<qualname>'"
+                    "'<rule> @ <relpath>::<qualname> -- <justification>'"
                 )
             out.append((rule.strip(), relpath.strip(), qualname.strip()))
         return tuple(out)

@@ -441,6 +441,16 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "never see a torn file",
     ),
     ArtifactSpec(
+        "analysis-report", ("ANALYSIS_",),
+        ("write_report",),
+        "static-analysis gate result (tsspark_tpu.analysis.report): "
+        "findings per checker, waiver counts, wall time — written once "
+        "atomically at the end of a CLI gate run and ingested into "
+        "RUNHISTORY as the `analysis` row family, so waiver creep and "
+        "gate-runtime growth are visible (and sentinel-gateable) on "
+        "the trajectory",
+    ),
+    ArtifactSpec(
         "fault-injection", (),
         ("corrupt_file", "FaultPlan.corrupt_file", "inject"),
         "deterministic test-only corruption/sentinels (resilience."
@@ -485,6 +495,7 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/obs/regress.py",
     "tsspark_tpu/obs/watch.py",
     "tsspark_tpu/obs/__main__.py",
+    "tsspark_tpu/analysis/report.py",
 )
 
 _WRITE_FNS = {"save", "savez", "savez_compressed", "dump"}
@@ -502,11 +513,36 @@ class WriteSite:
     via_helper: bool           # the call IS atomic_write(...)
 
 
-def _string_constants(node: ast.AST) -> Tuple[str, ...]:
-    return tuple(
-        n.value for n in ast.walk(node)
-        if isinstance(n, ast.Constant) and isinstance(n.value, str)
-    )
+def _string_constants(
+    node: ast.AST,
+    const_map: Optional[Dict[str, str]] = None,
+) -> Tuple[str, ...]:
+    """String constants in a path expression.  ``const_map`` resolves
+    module-level ``NAME = "literal"`` references too, so a write site
+    built as ``os.path.join(d, SNAP_SPEC)`` classifies by its marker
+    instead of falling through to the writer-name fallback."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append(n.value)
+        elif (const_map and isinstance(n, ast.Name)
+              and n.id in const_map):
+            out.append(const_map[n.id])
+    return tuple(out)
+
+
+def module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments (the artifact
+    filename constants every protocol module declares at the top)."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, str):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value.value
+    return out
 
 
 def _fn_qualname_map(tree: ast.Module):
@@ -530,6 +566,7 @@ def _fn_qualname_map(tree: ast.Module):
 def _collect_write_sites(relpath: str, source: str) -> List[WriteSite]:
     tree = ast.parse(source, filename=relpath)
     qualnames = _fn_qualname_map(tree)
+    mod_consts = module_str_constants(tree)
     sites: List[WriteSite] = []
 
     def fn_has_replace(fn: ast.AST) -> bool:
@@ -570,8 +607,8 @@ def _collect_write_sites(relpath: str, source: str) -> List[WriteSite]:
                 if any(c in mode for c in "wax+?"):
                     sites.append(WriteSite(
                         relpath, sub.lineno, qual, mode,
-                        _string_constants(sub.args[0]) if sub.args
-                        else (),
+                        _string_constants(sub.args[0], mod_consts)
+                        if sub.args else (),
                         atomic_fn, False,
                     ))
             # np.save/np.savez/json.dump/pickle.dump with a PATH (not an
@@ -580,7 +617,7 @@ def _collect_write_sites(relpath: str, source: str) -> List[WriteSite]:
                     and func.attr in _WRITE_FNS and sub.args):
                 target = (sub.args[1] if func.attr == "dump"
                           and len(sub.args) > 1 else sub.args[0])
-                consts = _string_constants(target)
+                consts = _string_constants(target, mod_consts)
                 # Heuristic: writes to a bare Name with no path-ish
                 # constants are almost always file handles from an
                 # enclosing open()/atomic_write (already checked).
@@ -594,7 +631,8 @@ def _collect_write_sites(relpath: str, source: str) -> List[WriteSite]:
                     and func.id in _ATOMIC_FNS):
                 sites.append(WriteSite(
                     relpath, sub.lineno, qual, "w",
-                    _string_constants(sub.args[0]) if sub.args else (),
+                    _string_constants(sub.args[0], mod_consts)
+                    if sub.args else (),
                     atomic_fn, True,
                 ))
 
@@ -613,12 +651,20 @@ def _collect_write_sites(relpath: str, source: str) -> List[WriteSite]:
 
 
 def _classify(site: WriteSite) -> Optional[ArtifactSpec]:
+    # Most-specific (longest) matching marker wins, so a generic
+    # fragment ("spec.json", ".json") never swallows a specific one
+    # ("snap_spec.json", "plane_manifest.json"); registry order is the
+    # tiebreak.
+    best: Optional[ArtifactSpec] = None
+    best_len = -1
     for spec in ARTIFACTS:
-        if any(
-            marker in const
-            for marker in spec.markers for const in site.constants
-        ):
-            return spec
+        for marker in spec.markers:
+            if len(marker) > best_len and any(
+                marker in const for const in site.constants
+            ):
+                best, best_len = spec, len(marker)
+    if best is not None:
+        return best
     # Variable path with no literal fragment: attribute by the writing
     # function itself — the registry maps owners to artifacts, so a
     # registered owner's writes classify even when the path is computed

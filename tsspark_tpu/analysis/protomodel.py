@@ -1,0 +1,630 @@
+"""Happens-before model checker for the sentinel protocols.
+
+Every crash-safety story in this package has the same shape: a writer
+lands PAYLOAD artifacts first, then an atomic GATE artifact last — the
+sentinel whose presence is the unit of visibility (``fileproto``'s
+ArtifactSpec lifecycles tell the story in prose).  The chaos harness
+samples kill-points inside those windows at runtime; this module makes
+the order itself a static gate:
+
+1. **Declared ordering edges** — each :class:`ProtocolSpec` extends the
+   ``fileproto`` registry with the write ORDER a protocol's owner must
+   emit: spec-first → payload → sentinel-LAST (plane land), patch file
+   before memmap scatter before the visibility record (delta land),
+   plan pin before fit before publish before flip (refit cycle),
+   snapshot files before the manifest (registry publish).
+
+2. **Static order verification** — the writer's call graph is walked in
+   program order (same-module callees inlined), producing the linear
+   EVENT sequence of write sites (classified against the artifact
+   registry, with module-constant and one-level local resolution so
+   ``os.path.join(d, SNAP_OK)`` is recognizable) and call markers.  The
+   declared step chain must embed into that sequence (greedy
+   subsequence), and a gate's first emission must follow every payload
+   it certifies — the ``hb-order`` finding is a sentinel written before
+   its payload.
+
+3. **Kill-point sweep** — a small-model enumerator walks every
+   linearization the declared partial order admits and inserts a
+   kill-point after each write: a prefix is SAFE iff every gate present
+   certifies only payloads already present (killed-before-gate ⇒ the
+   state is invisible or resumable per the step's declared reader;
+   killed-after ⇒ complete).  This turns the chaos harness's sampled
+   kill-points into an exhaustive static sweep over the lifecycle DAG:
+   a registry edit that weakens the edges until a gate may precede its
+   payload fails here (``hb-unsafe``) before any storm runs.
+
+Findings: ``hb-order`` (writer emits events out of declared order),
+``hb-missing`` (a declared step never appears in the writer's closure —
+the model drifted from the code), ``hb-unsafe`` (the declared DAG
+admits an unsafe prefix), ``hb-model`` (an inconsistent spec: a gate
+certifying an unknown step, a payload with no reader story).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tsspark_tpu.analysis.findings import Finding
+from tsspark_tpu.analysis import fileproto
+
+#: Inlining bound for the writer call-graph walk (protocol writers are
+#: shallow; the bound only guards against pathological recursion).
+_MAX_DEPTH = 8
+
+
+# ---------------------------------------------------------------------------
+# the model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSpec:
+    """One write step of a protocol lifecycle.
+
+    ``pattern`` locates the step in the writer's extracted event
+    sequence: ``art:<name>`` matches a write site classified as that
+    ArtifactSpec; ``tok:<fragment>`` matches a write site whose path
+    expression carries the fragment (string constant, resolved module
+    constant, or the name of the path-building helper); ``call:<fn>``
+    matches a call event.  ``role`` is ``payload`` / ``gate`` /
+    ``advisory``; a gate's ``certifies`` names the payload steps its
+    landing makes visible.  ``reader`` is the resumer that classifies a
+    prefix ending at this step as invisible-or-resumable — required for
+    payloads (a payload nobody knows how to tolerate mid-crash is a
+    model hole, not a formality)."""
+
+    name: str
+    pattern: str
+    role: str = "payload"
+    certifies: Tuple[str, ...] = ()
+    reader: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    """One protocol: an owning writer plus its ordered steps.
+
+    ``edges`` is the declared happens-before partial order as
+    ``(before, after)`` step-name pairs; empty means the full chain in
+    ``steps`` order.  The static verification checks the writer's real
+    emission order embeds the chain; the kill-point sweep checks every
+    linearization the edges admit."""
+
+    name: str
+    writer_module: str   # repo-relative path
+    writer_root: str     # qualname of the function whose closure writes
+    steps: Tuple[StepSpec, ...]
+    edges: Tuple[Tuple[str, str], ...] = ()
+    resume: str = ""
+
+    def edge_pairs(self) -> Tuple[Tuple[str, str], ...]:
+        if self.edges:
+            return self.edges
+        names = [s.name for s in self.steps]
+        return tuple(zip(names[:-1], names[1:]))
+
+
+PROTOCOLS: Tuple[ProtocolSpec, ...] = (
+    ProtocolSpec(
+        "plane-base-land",
+        "tsspark_tpu/data/plane.py", "write_shard",
+        steps=(
+            StepSpec("spec", "art:plane-spec",
+                     reader="ready_coverage ignores dirs without "
+                            "spec.json; create_columns re-lands it"),
+            StepSpec("scatter", "call:open_memmap",
+                     reader="readers trust only sentinel-covered rows; "
+                            "unsentineled column bytes are invisible"),
+            StepSpec("sentinel", "art:plane-shard-ok", role="gate",
+                     certifies=("spec", "scatter")),
+        ),
+        resume="a producer killed mid-shard leaves no sentinel; any "
+               "successor regenerates the block-seeded rows bitwise "
+               "and re-lands",
+    ),
+    ProtocolSpec(
+        "plane-delta-land",
+        "tsspark_tpu/data/plane.py", "land_delta",
+        steps=(
+            StepSpec("patch", "tok:_delta_patch_path",
+                     reader="a patch without its deltaok record is "
+                            "never unioned by advanced_since; "
+                            "write_shard replays only visible deltas"),
+            StepSpec("scatter", "call:_apply_patch",
+                     reader="absolute-value scatter is bitwise "
+                            "idempotent; repair rolls a torn shard "
+                            "back to base + visible patches"),
+            StepSpec("reland", "art:plane-shard-ok",
+                     reader="re-landed sentinel carries post-delta "
+                            "CRCs; a kill before it reads as shard "
+                            "corruption and repair() re-lands"),
+            StepSpec("ok", "tok:_delta_ok_path", role="gate",
+                     certifies=("patch", "scatter", "reland")),
+        ),
+        resume="advanced_since unions only deltaok_ records, so a "
+               "lander killed anywhere earlier leaves the delta "
+               "invisible; the flock serializes racing landers",
+    ),
+    ProtocolSpec(
+        "snap-plane-publish",
+        "tsspark_tpu/serve/snapplane.py", "write_plane",
+        steps=(
+            StepSpec("spec", "tok:SNAP_SPEC",
+                     reader="attach() requires spec + sentinel; a "
+                            "spec-only dir is rejected whole"),
+            StepSpec("columns", "tok:_col_path",
+                     reader="columns are invisible until the CRC "
+                            "sentinel lands; attach rejects mismatches "
+                            "and falls back down the version chain"),
+            StepSpec("sentinel", "tok:SNAP_OK", role="gate",
+                     certifies=("spec", "columns")),
+        ),
+        resume="the version dir is publisher-private until the registry "
+               "manifest references it; a publisher killed mid-plane "
+               "leaves an orphan dir the allocator skips",
+    ),
+    ProtocolSpec(
+        "snap-plane-delta",
+        "tsspark_tpu/serve/snapplane.py", "write_plane_delta",
+        steps=(
+            StepSpec("spec", "tok:SNAP_SPEC",
+                     reader="same attach() gate as the full plane"),
+            StepSpec("columns", "tok:_col_path",
+                     reader="hardlinked or copy-forwarded columns are "
+                            "invisible until the sentinel lands"),
+            StepSpec("sentinel", "tok:SNAP_OK", role="gate",
+                     certifies=("spec", "columns")),
+            StepSpec("delta-manifest", "tok:DELTA_MANIFEST",
+                     role="advisory",
+                     reader="pure metadata: the registry manifest "
+                            "referencing the dir is the visibility "
+                            "gate; carry-forward degrades to a full "
+                            "cache drop when it is absent"),
+        ),
+        resume="orphan version dirs are skipped by the allocator; the "
+               "registry manifest is the real flip",
+    ),
+    ProtocolSpec(
+        "registry-publish",
+        "tsspark_tpu/serve/registry.py", "ParamRegistry.publish",
+        steps=(
+            StepSpec("snapshot", "call:save_state",
+                     reader="an unreferenced version dir is invisible "
+                            "to load(); sweep_stale_temps bounds the "
+                            "orphans"),
+            StepSpec("plane", "call:write_plane",
+                     reader="same: publisher-private until referenced"),
+            StepSpec("manifest", "art:registry-manifest", role="gate",
+                     certifies=("snapshot", "plane")),
+        ),
+        resume="readers see the old or the new manifest, never a "
+               "dangling reference: the manifest is replaced atomically "
+               "AFTER the snapshot files land",
+    ),
+    ProtocolSpec(
+        "registry-delta-publish",
+        "tsspark_tpu/serve/registry.py", "ParamRegistry.publish_delta",
+        steps=(
+            StepSpec("plane", "call:write_plane_delta",
+                     reader="publisher-private until the manifest "
+                            "references the version dir"),
+            StepSpec("manifest", "art:registry-manifest", role="gate",
+                     certifies=("plane",)),
+        ),
+        resume="a publisher killed mid-delta leaves an orphan vdir; "
+               "the refit plan stays pinned and the successor "
+               "re-publishes",
+    ),
+    ProtocolSpec(
+        "refit-cycle",
+        "tsspark_tpu/refit.py", "run_refit",
+        steps=(
+            StepSpec("pin", "art:refit-plan",
+                     reader="resolve_plan resumes the pinned plan on "
+                            "any successor — the pin is what stops a "
+                            "fresh detect racing deltas landed after "
+                            "a kill"),
+            StepSpec("fit", "call:fit_changed",
+                     reader="chunk flushes land under leases in the "
+                            "cycle dir; a resumed cycle re-claims only "
+                            "missing coverage"),
+            StepSpec("publish", "call:publish_delta",
+                     reader="registry-delta-publish protocol: orphan "
+                            "vdir until the manifest lands"),
+            StepSpec("flip", "call:activate",
+                     reader="publish_plan routes pool.activate / "
+                            "flip_fn / registry.activate after the "
+                            "publish; a kill between publish and flip "
+                            "resumes via the published-base branch of "
+                            "resolve_plan"),
+            StepSpec("complete", "art:refit-plan", role="gate",
+                     certifies=("pin", "fit", "publish", "flip")),
+        ),
+        resume="the plan file is the cycle's record: complete=false "
+               "resumes, complete=true lets the reaper collect the "
+               "cycle dir",
+    ),
+)
+
+
+# ---------------------------------------------------------------------------
+# event extraction (program-order write/call sequence of a writer)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    kind: str                 # "write" | "call"
+    name: str                 # artifact spec name or callee name
+    tokens: Tuple[str, ...]   # path tokens for writes
+    line: int
+
+
+class _ModuleIndex:
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.tree = ast.parse(source, filename=relpath)
+        self.consts = fileproto.module_str_constants(self.tree)
+        self.functions: Dict[str, ast.AST] = {}
+        self._build()
+
+    def _build(self) -> None:
+        qualnames = fileproto._fn_qualname_map(self.tree)
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    self.functions[qualnames[id(child)]] = child
+                visit(child)
+
+        visit(self.tree)
+
+    def resolve(self, callee: str,
+                caller_qual: str) -> Optional[Tuple[str, ast.AST]]:
+        """Same-module function for a simple callee name: a sibling
+        method of the caller's class first, then a module-level def."""
+        if "." in caller_qual:
+            cls_prefix = caller_qual.rsplit(".", 1)[0]
+            qual = f"{cls_prefix}.{callee}"
+            if qual in self.functions:
+                return qual, self.functions[qual]
+        if callee in self.functions:
+            return callee, self.functions[callee]
+        return None
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _path_tokens(node: ast.AST, consts: Dict[str, str],
+                 local_map: Dict[str, ast.AST]) -> Tuple[str, ...]:
+    """Tokens identifying a write site's target path: string constants,
+    resolved module constants, referenced constant NAMES, and the names
+    of path-building helper calls — with one level of local-variable
+    substitution (``dst = _col_path(...); atomic_write(dst, ...)``)."""
+    toks: List[str] = []
+
+    def walk(n: ast.AST, depth: int) -> None:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value,
+                                                            str):
+                toks.append(sub.value)
+            elif isinstance(sub, ast.Name):
+                if sub.id in consts:
+                    toks.append(sub.id)
+                    toks.append(consts[sub.id])
+                elif depth == 0 and sub.id in local_map:
+                    walk(local_map[sub.id], 1)
+            elif isinstance(sub, ast.Call):
+                name = _callee_name(sub)
+                if name:
+                    toks.append(name)
+
+    walk(node, 0)
+    return tuple(toks)
+
+
+def _write_event(call: ast.Call, qual: str,
+                 consts: Dict[str, str],
+                 local_map: Dict[str, ast.AST]) -> Optional[Event]:
+    """An Event for a write-site call (open-for-write / np.save / dump /
+    atomic_write), classified against the artifact registry."""
+    func = call.func
+    target: Optional[ast.AST] = None
+    if isinstance(func, ast.Name) and func.id in fileproto._ATOMIC_FNS:
+        target = call.args[0] if call.args else None
+    elif isinstance(func, ast.Name) and func.id == "open":
+        mode = ""
+        if len(call.args) > 1 and isinstance(call.args[1], ast.Constant):
+            mode = str(call.args[1].value)
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = str(kw.value.value)
+        if not any(c in mode for c in "wax+"):
+            return None
+        target = call.args[0] if call.args else None
+    elif isinstance(func, ast.Attribute) \
+            and func.attr in fileproto._WRITE_FNS and call.args:
+        target = (call.args[1] if func.attr == "dump"
+                  and len(call.args) > 1 else call.args[0])
+    if target is None:
+        return None
+    tokens = _path_tokens(target, consts, local_map)
+    site = fileproto.WriteSite(
+        "", call.lineno, qual, "w",
+        tuple(t for t in tokens), False, False,
+    )
+    spec = fileproto._classify(site)
+    return Event("write", spec.name if spec else "?", tokens,
+                 call.lineno)
+
+
+def extract_events(index: _ModuleIndex, root_qual: str) -> List[Event]:
+    """The writer's program-order event sequence, same-module callees
+    inlined (depth-capped, cycle-guarded)."""
+    events: List[Event] = []
+
+    def local_assigns(fn: ast.AST) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                out[sub.targets[0].id] = sub.value
+        return out
+
+    def walk_fn(qual: str, fn: ast.AST, depth: int,
+                stack: Tuple[str, ...]) -> None:
+        locals_map = local_assigns(fn)
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                return  # nested defs run when called, not here
+            if isinstance(node, ast.Call):
+                # Arguments evaluate before the call itself.
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                ev = _write_event(node, qual, index.consts, locals_map)
+                if ev is not None:
+                    events.append(ev)
+                callee = _callee_name(node)
+                if callee:
+                    events.append(Event("call", callee, (),
+                                        node.lineno))
+                    resolved = index.resolve(callee, qual)
+                    if (resolved is not None and depth < _MAX_DEPTH
+                            and resolved[0] not in stack):
+                        walk_fn(resolved[0], resolved[1], depth + 1,
+                                stack + (resolved[0],))
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for stmt in fn.body:
+            visit(stmt)
+
+    fn = index.functions.get(root_qual)
+    if fn is None:
+        return events
+    walk_fn(root_qual, fn, 0, (root_qual,))
+    return events
+
+
+def _matches(event: Event, pattern: str) -> bool:
+    kind, _, arg = pattern.partition(":")
+    if kind == "art":
+        return event.kind == "write" and event.name == arg
+    if kind == "tok":
+        return event.kind == "write" and any(
+            arg in t or t == arg for t in event.tokens
+        )
+    if kind == "call":
+        return event.kind == "call" and event.name == arg
+    return False
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+
+def _check_model(proto: ProtocolSpec,
+                 findings: List[Finding]) -> bool:
+    """Internal consistency of one spec; False stops further checks."""
+    names = [s.name for s in proto.steps]
+    ok = True
+
+    def emit(msg: str) -> None:
+        findings.append(Finding(
+            "hb-model", proto.writer_module, 0, proto.writer_root,
+            f"protocol {proto.name}: {msg}",
+        ))
+
+    if len(set(names)) != len(names):
+        emit("duplicate step names")
+        ok = False
+    for s in proto.steps:
+        if s.role not in ("payload", "gate", "advisory"):
+            emit(f"step {s.name} has unknown role {s.role!r}")
+            ok = False
+        if s.role == "gate" and not s.certifies:
+            emit(f"gate {s.name} certifies nothing — a gate that "
+                 "gates nothing is a payload mislabeled as a sentinel")
+            ok = False
+        for c in s.certifies:
+            if c not in names:
+                emit(f"gate {s.name} certifies unknown step {c!r}")
+                ok = False
+        if s.role == "payload" and not s.reader.strip():
+            emit(f"payload step {s.name} declares no reader/resumer "
+                 "story — who tolerates a crash right after it?")
+            ok = False
+    for a, b in proto.edge_pairs():
+        if a not in names or b not in names:
+            emit(f"edge ({a!r}, {b!r}) names an unknown step")
+            ok = False
+    return ok
+
+
+def _check_writer_order(proto: ProtocolSpec, root: str,
+                        findings: List[Finding]) -> None:
+    path = os.path.join(root, proto.writer_module)
+    if not os.path.exists(path):
+        findings.append(Finding(
+            "hb-missing", proto.writer_module, 0, proto.writer_root,
+            f"protocol {proto.name}: writer module is gone — delete "
+            "or update the ProtocolSpec",
+        ))
+        return
+    with open(path, "r") as fh:
+        source = fh.read()
+    index = _ModuleIndex(proto.writer_module, source)
+    if proto.writer_root not in index.functions:
+        findings.append(Finding(
+            "hb-missing", proto.writer_module, 0, proto.writer_root,
+            f"protocol {proto.name}: writer {proto.writer_root} not "
+            "found — the model drifted from the code",
+        ))
+        return
+    events = extract_events(index, proto.writer_root)
+    # Greedy subsequence embedding of the declared chain.
+    pos = 0
+    matched: Dict[str, int] = {}
+    for step in proto.steps:
+        found = None
+        for i in range(pos, len(events)):
+            if _matches(events[i], step.pattern):
+                found = i
+                break
+        if found is None:
+            # Distinguish "never emitted at all" (model drift) from
+            # "emitted, but before an earlier step" (order violation).
+            anywhere = any(_matches(e, step.pattern) for e in events)
+            rule = "hb-order" if anywhere else "hb-missing"
+            line = next((e.line for e in events
+                         if _matches(e, step.pattern)), 0)
+            findings.append(Finding(
+                rule, proto.writer_module, line, proto.writer_root,
+                f"protocol {proto.name}: step {step.name!r} "
+                f"({step.pattern}) "
+                + ("is emitted BEFORE its declared predecessor — the "
+                   "sentinel order the crash story depends on is "
+                   "violated" if anywhere else
+                   "never appears in the writer's call graph — update "
+                   "the model or the writer"),
+            ))
+            return
+        matched[step.name] = found
+        pos = found + 1
+    # A gate must not have an occurrence earlier than a certified
+    # payload's matched position (only meaningful when the gate's
+    # pattern is unique among the declared steps).
+    for step in proto.steps:
+        if step.role != "gate":
+            continue
+        shared = any(s.pattern == step.pattern and s.name != step.name
+                     for s in proto.steps)
+        if shared:
+            continue
+        first = next((i for i, e in enumerate(events)
+                      if _matches(e, step.pattern)), None)
+        for c in step.certifies:
+            if first is not None and first < matched[c]:
+                findings.append(Finding(
+                    "hb-order", proto.writer_module,
+                    events[first].line, proto.writer_root,
+                    f"protocol {proto.name}: gate {step.name!r} is "
+                    f"first written before payload {c!r} — a reader "
+                    "observing the gate would trust payload bytes "
+                    "that may not exist yet",
+                ))
+
+
+def _linearizations(
+    names: Sequence[str],
+    edges: Sequence[Tuple[str, str]],
+    cap: int = 2048,
+) -> Tuple[List[Tuple[str, ...]], bool]:
+    """(orders, truncated): all topological orders the partial order
+    admits, up to ``cap``.  ``truncated`` True means the enumeration
+    was cut — the caller must surface that loudly, or the 'exhaustive'
+    sweep silently degrades to a sample."""
+    out: List[Tuple[str, ...]] = []
+    truncated = False
+    after: Dict[str, Set[str]] = {n: set() for n in names}
+    for a, b in edges:
+        after[b].add(a)
+
+    def rec(placed: Tuple[str, ...], remaining: Set[str]) -> None:
+        nonlocal truncated
+        if len(out) >= cap:
+            truncated = True
+            return
+        if not remaining:
+            out.append(placed)
+            return
+        for n in sorted(remaining):
+            if after[n] <= set(placed):
+                rec(placed + (n,), remaining - {n})
+
+    rec((), set(names))
+    return out, truncated
+
+
+def _check_killpoints(proto: ProtocolSpec,
+                      findings: List[Finding]) -> None:
+    """Exhaustive kill-point sweep over the declared lifecycle DAG."""
+    names = [s.name for s in proto.steps]
+    by_name = {s.name: s for s in proto.steps}
+    orders, truncated = _linearizations(names, proto.edge_pairs())
+    if truncated:
+        findings.append(Finding(
+            "hb-model", proto.writer_module, 0, proto.writer_root,
+            f"protocol {proto.name}: the declared edges admit more "
+            "linearizations than the sweep cap — the kill-point sweep "
+            "is no longer exhaustive; add ordering edges (a protocol "
+            "this unconstrained has no crash story anyway)",
+        ))
+        return
+    for order in orders:
+        for k in range(len(order) + 1):
+            prefix = set(order[:k])
+            for g in prefix:
+                step = by_name[g]
+                if step.role != "gate":
+                    continue
+                missing = [c for c in step.certifies
+                           if c not in prefix]
+                if missing:
+                    findings.append(Finding(
+                        "hb-unsafe", proto.writer_module, 0,
+                        proto.writer_root,
+                        f"protocol {proto.name}: the declared edges "
+                        f"admit order {order} — killed after "
+                        f"{g!r} lands, payload(s) {missing} are "
+                        "missing while the gate says they are "
+                        "visible; add the ordering edge(s)",
+                    ))
+                    return  # one counterexample per protocol is enough
+
+
+def check_protocols(root: str,
+                    protocols: Sequence[ProtocolSpec] = PROTOCOLS
+                    ) -> List[Finding]:
+    findings: List[Finding] = []
+    for proto in protocols:
+        if _check_model(proto, findings):
+            _check_writer_order(proto, root, findings)
+            _check_killpoints(proto, findings)
+    return findings

@@ -1,0 +1,111 @@
+"""ANALYSIS_* gate artifact: the static-analysis result as a run row.
+
+Every other gate in this repo leaves a judged artifact on the
+trajectory (BENCH/SERVE/CHAOS/...); the analysis gate did not — so
+waiver creep and gate-runtime growth were invisible between PRs.  The
+CLI (``python -m tsspark_tpu.analysis``) writes one
+``ANALYSIS_<unix>.json`` per full run: findings per checker, kept vs
+baselined counts, inline + baseline waiver counts, wall time — atomic
+(a watcher never parses a torn JSON; the ``analysis-report``
+ArtifactSpec in ``fileproto`` owns the lifecycle) and self-ingested
+into ``RUNHISTORY.jsonl`` as the ``analysis`` row family, so the
+regression sentinel machinery can budget waiver growth like any other
+metric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict
+
+from tsspark_tpu.utils.atomic import atomic_write
+
+# THE inline-waiver pattern — imported, not copied: the counted waiver
+# surface must never drift from the surface the checkers honor.
+from tsspark_tpu.analysis.tracelint import _INLINE_OK
+
+
+def count_inline_waivers(package_dir: str) -> Dict[str, int]:
+    """``{rule: count}`` of inline ``# lint-ok[rule]: reason`` waivers
+    under ``package_dir`` — the other half of the suppression surface
+    (the pyproject baseline is the committed half).  Counted over
+    COMMENT tokens only: a docstring *mentioning* the marker syntax is
+    documentation, not a waiver, and must not move the waiver-creep
+    metric this count feeds."""
+    import io
+    import tokenize
+
+    out: Dict[str, int] = {}
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn), "r") as fh:
+                    source = fh.read()
+                tokens = tokenize.generate_tokens(
+                    io.StringIO(source).readline
+                )
+                for tok in tokens:
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = _INLINE_OK.search(tok.string)
+                    if m:
+                        rule = m.group("rule")
+                        out[rule] = out.get(rule, 0) + 1
+            except (OSError, tokenize.TokenError, SyntaxError,
+                    IndentationError):
+                continue
+    return out
+
+
+def build_report(analysis_report, settings, root: str,
+                 wall_s: float) -> Dict[str, Any]:
+    """The artifact dict for one FULL gate run (the CLI never writes
+    one for --changed/partial runs — their counts are not comparable
+    trajectory points)."""
+    from tsspark_tpu.obs import context as obs
+    from tsspark_tpu.obs.history import git_rev
+
+    inline = count_inline_waivers(os.path.join(root, "tsspark_tpu"))
+    return {
+        "kind": "analysis-gate",
+        "unix": round(time.time(), 3),
+        "trace_id": obs.trace_id(),
+        "git_rev": git_rev(root),
+        "wall_s": round(wall_s, 3),
+        "ok": analysis_report.ok,
+        "findings": len(analysis_report.findings),
+        "suppressed": len(analysis_report.suppressed),
+        "checkers": {name: n for name, n in analysis_report.counts},
+        "waivers_inline": sum(inline.values()),
+        "waivers_inline_by_rule": dict(sorted(inline.items())),
+        "waivers_baseline": len(settings.suppressions),
+    }
+
+
+def write_report(rep: Dict[str, Any],
+                 out_dir: str = ".") -> str:
+    """Write the artifact atomically; returns its path."""
+    path = os.path.join(out_dir, f"ANALYSIS_{int(rep['unix'])}.json")
+    atomic_write(path, lambda fh: json.dump(rep, fh, indent=1),
+                 mode="w")
+    return path
+
+
+def ingest_report(rep: Dict[str, Any], path: str,
+                  root: str = ".") -> bool:
+    """Self-ingest into RUNHISTORY (idempotent by trace id); never
+    raises — the gate's exit code must reflect findings, not the
+    trajectory plumbing."""
+    try:
+        from tsspark_tpu.obs import history
+
+        _row, appended = history.ingest(
+            rep, os.path.join(root, history.HISTORY_FILE), source=path
+        )
+        return appended
+    except Exception:
+        return False

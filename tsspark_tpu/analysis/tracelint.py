@@ -140,6 +140,13 @@ class _FnInfo:
         self.node = node
         self.jit_call = jit_call
         self.calls: Set[str] = set()   # local names this function calls
+        # Callees resolved by QUALIFIED name through the module's
+        # imports: (dotted module path, function name).  These join
+        # precisely in the traced closure instead of by simple name —
+        # the DatasetSpec.key -> cache_key rename class: two functions
+        # sharing a simple name in different modules must not pull each
+        # other into (or keep each other in) the traced set.
+        self.qual_calls: Set[Tuple[str, str]] = set()
         args = node.args
         self.param_names = [a.arg for a in args.posonlyargs + args.args
                             + args.kwonlyargs]
@@ -157,7 +164,33 @@ class _ModuleScan:
         self.tree = tree
         self.lines = source.splitlines()
         self.functions: Dict[str, _FnInfo] = {}
-        self.imports: Dict[str, str] = {}  # local name -> module path
+        #: local name -> dotted module path (``import x.y as z``)
+        self.imports: Dict[str, str] = {}
+        #: local name -> (dotted module path, original name) for
+        #: ``from x.y import f [as g]``
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self._collect_imports()
+
+    def _collect_imports(self) -> None:
+        pkg_parts = self.relpath.replace(os.sep, "/").split("/")[:-1]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # Relative import: resolve against this module's
+                    # package path (level 1 = the current package).
+                    base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = node.module or ""
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = (
+                        mod, alias.name
+                    )
 
     def line_ok(self, lineno: int, rule: str) -> bool:
         if 1 <= lineno <= len(self.lines):
@@ -178,13 +211,51 @@ def _walk_functions(scan: _ModuleScan) -> None:
                 for dec in child.decorator_list:
                     jit_call = jit_call or _jit_call_of(dec)
                 info = _FnInfo(qual, child, jit_call)
+                # Locally-bound names (params + any Store target):
+                # a local passed as an argument is DATA, not a function
+                # reference — it must not manufacture a simple-name
+                # edge to an unrelated package function (`span = t1 -
+                # t0` joining obs.context.span was exactly this).
+                local_names: Set[str] = set(info.param_names)
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Name) \
+                            and isinstance(sub.ctx, ast.Store):
+                        local_names.add(sub.id)
                 for sub in ast.walk(child):
                     if isinstance(sub, ast.Call):
                         if isinstance(sub.func, ast.Name):
-                            info.calls.add(sub.func.id)
+                            name = sub.func.id
+                            if name in scan.from_imports:
+                                # ``from mod import f``: resolve to mod
+                                # precisely, never by simple name.
+                                info.qual_calls.add(
+                                    scan.from_imports[name]
+                                )
+                            else:
+                                info.calls.add(name)
                         elif isinstance(sub.func, ast.Attribute) \
                                 and sub.func.attr not in _GENERIC_METHODS:
-                            info.calls.add(sub.func.attr)
+                            recv = sub.func.value
+                            if isinstance(recv, ast.Name) \
+                                    and recv.id in scan.imports:
+                                # ``mod.f(...)``: qualified edge into
+                                # the imported module only (and no edge
+                                # at all into the package when the
+                                # module is external — np.argsort must
+                                # not join a package fn named argsort).
+                                info.qual_calls.add(
+                                    (scan.imports[recv.id],
+                                     sub.func.attr)
+                                )
+                            elif isinstance(recv, ast.Subscript):
+                                # ``x.at[i].set(v)`` — JAX's functional
+                                # update; a subscripted receiver is
+                                # never a package module, so a simple-
+                                # name edge here only manufactures
+                                # collisions (Gauge.set et al.).
+                                pass
+                            else:
+                                info.calls.add(sub.func.attr)
                         # Function REFERENCES passed as arguments — the
                         # lax.while_loop(cond, body, ...) callback idiom;
                         # those callees run traced just like direct calls.
@@ -192,7 +263,12 @@ def _walk_functions(scan: _ModuleScan) -> None:
                             kw.value for kw in sub.keywords
                         ]:
                             if isinstance(a, ast.Name):
-                                info.calls.add(a.id)
+                                if a.id in scan.from_imports:
+                                    info.qual_calls.add(
+                                        scan.from_imports[a.id]
+                                    )
+                                elif a.id not in local_names:
+                                    info.calls.add(a.id)
                 scan.functions[qual] = info
                 visit(child, qual + ".")
             elif isinstance(child, ast.ClassDef):
@@ -219,17 +295,47 @@ def _walk_functions(scan: _ModuleScan) -> None:
 
 def _traced_closure(scans: List[_ModuleScan]) -> Set[Tuple[str, str]]:
     """(relpath, qualname) of every function statically reachable from a
-    jit root by simple-name calls — the set whose bodies run traced."""
+    jit root — qualified-import edges join precisely; simple-name calls
+    (methods, locals, references) fall back to joining every function
+    sharing the name."""
     by_name: Dict[str, List[Tuple[str, str]]] = {}
+    by_module: Dict[str, List[Tuple[str, str]]] = {}
     for scan in scans:
+        mod = scan.relpath.replace(os.sep, "/")
+        mod = mod[:-3] if mod.endswith(".py") else mod
+        if mod.endswith("/__init__"):
+            mod = mod[: -len("/__init__")]
+        dotted = mod.replace("/", ".")
         for qual, info in scan.functions.items():
             by_name.setdefault(qual.rsplit(".", 1)[-1], []).append(
+                (scan.relpath, qual)
+            )
+            by_module.setdefault(dotted, []).append(
                 (scan.relpath, qual)
             )
     info_of = {
         (scan.relpath, qual): info
         for scan in scans for qual, info in scan.functions.items()
     }
+
+    def resolve_qual(mod: str, name: str) -> List[Tuple[str, str]]:
+        """Functions named ``name`` inside the scanned module ``mod``.
+        When the module is scanned but defines no such function (a
+        package ``__init__`` RE-EXPORTING it), fall back to the
+        simple-name join — dropping the edge would un-lint traced code.
+        A module outside the scan (numpy, jax) yields no edge at all:
+        ``np.argsort`` must not join a package function named argsort."""
+        hits = [
+            key for key in by_module.get(mod, ())
+            if key[1] == name or key[1].endswith("." + name)
+        ]
+        if hits:
+            return hits
+        internal = mod in by_module or any(
+            k.startswith(mod + ".") for k in by_module
+        )
+        return list(by_name.get(name, ())) if internal else []
+
     traced: Set[Tuple[str, str]] = {
         key for key, info in info_of.items() if info.jit_call is not None
     }
@@ -239,6 +345,8 @@ def _traced_closure(scans: List[_ModuleScan]) -> Set[Tuple[str, str]]:
         new = set()
         for callee in info_of[key].calls:
             new.update(by_name.get(callee, ()))
+        for mod, name in info_of[key].qual_calls:
+            new.update(resolve_qual(mod, name))
         # Nested defs of a traced function run traced (the while_loop
         # body / line-search closure pattern) even when only ever passed
         # by reference through names the call-graph cannot resolve.
@@ -522,3 +630,26 @@ def lint_package(root: str, package_dir: str) -> List[Finding]:
             if fn.endswith(".py"):
                 paths.append(os.path.join(dirpath, fn))
     return lint_paths(sorted(paths), root)
+
+
+def package_static_names(package_dir: str) -> Set[str]:
+    """The package-wide static-parameter-name set from a light parse of
+    every module — seeds ``lint_paths`` in ``--changed`` fast mode so a
+    scoped lint keeps the full calling-convention context."""
+    names: Set[str] = set()
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r") as fh:
+                    source = fh.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError):
+                continue
+            scan = _ModuleScan(os.path.relpath(path, package_dir),
+                               tree, source)
+            _walk_functions(scan)
+            names |= _collect_package_static_names([scan])
+    return names

@@ -50,7 +50,7 @@ HISTORY_FILE = "RUNHISTORY.jsonl"
 
 #: Artifact families the backfill scans for (filename prefixes).
 FAMILIES = ("BENCH_", "SERVE_", "CHAOS_", "EVAL_", "RUNLEDGER_",
-            "SCALE_")
+            "SCALE_", "ANALYSIS_")
 
 _git_rev_cache: Dict[str, Optional[str]] = {}
 
@@ -308,6 +308,33 @@ def _freshness_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _analysis_row(rep: Dict[str, Any]) -> Dict[str, Any]:
+    """Static-analysis gate rows (python -m tsspark_tpu.analysis;
+    analysis/report.py).  The gate's drift metrics — waiver creep,
+    suppressed-finding growth, gate runtime — become trajectory points
+    so a PR that quietly doubles the waiver count is as visible as one
+    that halves throughput.  Only FULL gate runs write the artifact
+    (the CLI skips it for --changed/partial runs, whose counts are not
+    comparable), so every row here shares one workload key."""
+    m: Dict[str, float] = {}
+    for k in ("ok", "findings", "suppressed", "waivers_inline",
+              "waivers_baseline", "wall_s"):
+        _put(m, k, rep.get(k))
+    for name, n in sorted((rep.get("checkers") or {}).items()):
+        _put(m, f"raw_{name}", n)
+    return {
+        "kind": "analysis",
+        "trace_id": rep.get("trace_id"),
+        "unix": rep.get("unix"),
+        "workload": "analysis_full",
+        "device": None,
+        "numerics_rev": None,
+        "config_fingerprint": None,
+        "git_rev": rep.get("git_rev"),
+        "metrics": m,
+    }
+
+
 def _chaos_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     m: Dict[str, float] = {}
     _put(m, "ok", rep.get("ok"))
@@ -387,6 +414,8 @@ def classify(rep: Dict[str, Any]) -> Optional[str]:
         return "scale"
     if kind == "freshness-bench":
         return "freshness"
+    if kind == "analysis-gate":
+        return "analysis"
     if kind == "chaos-storm":
         return "chaos"
     if kind == "run-ledger":
@@ -407,6 +436,7 @@ _ROW_BUILDERS = {
     "serve": _serve_row,
     "scale": _scale_row,
     "freshness": _freshness_row,
+    "analysis": _analysis_row,
     "chaos": _chaos_row,
     "eval": _eval_row,
     "ledger": _ledger_row,
@@ -566,6 +596,8 @@ _TRAJECTORY_COLUMNS = {
     "freshness": ("freshness_p50_s", "freshness_p95_s",
                   "freshness_vs_cold_frac", "cycle_overhead_frac",
                   "spec_hit_rate", "wrong_version", "complete"),
+    "analysis": ("ok", "findings", "suppressed", "waivers_inline",
+                 "waivers_baseline", "wall_s"),
     "chaos": ("ok", "invariant_fails"),
     "eval": ("config3_m5.smape_holdout_cpu",
              "config3_m5.delta_holdout_p50",
@@ -605,7 +637,7 @@ def trajectory(rows: Sequence[Dict[str, Any]]) -> List[str]:
     in ingest order (the roadmap's 'bench trajectory' block)."""
     lines: List[str] = []
     for kind in ("bench", "eval", "serve", "scale", "freshness",
-                 "chaos", "ledger"):
+                 "analysis", "chaos", "ledger"):
         group = [r for r in rows if r.get("kind") == kind]
         if not group:
             continue

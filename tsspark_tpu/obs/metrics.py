@@ -67,7 +67,11 @@ class Gauge:
 
     def set(self, v: float) -> None:
         # A single store is atomic under the GIL; no lock needed.
-        self.value = float(v)  # lint-ok[host-sync]: host-side metrics handle; never called under a trace (name-collision with traced .set methods)
+        # (A lint-ok[host-sync] waiver lived here while tracelint
+        # joined call graphs by simple name — `.at[i].set(...)` in
+        # traced code collided with this method.  The qualified-name
+        # closure removed the collision class, so the waiver is gone.)
+        self.value = float(v)
 
     def _reset(self) -> None:
         self.value = 0.0

@@ -377,7 +377,7 @@ class PredictionEngine:
         if version is not None:
             self._carry_cache_forward(version)
         self.cache.invalidate(version)
-        self._snapshot = None
+        self._snapshot = None  # lint-ok[lock-guard]: publisher-thread callback; a single store to None is GIL-atomic and refresh() reads the slot through a local (see its docstring) — taking the pump lock here would stall every flip behind an in-flight batch dispatch
 
     def _carry_cache_forward(self, new_version: int) -> None:
         """Partial cache invalidation on a DELTA flip: when the version
@@ -490,7 +490,7 @@ class PredictionEngine:
         swaps it in with zero disk I/O.  Explicit version — no
         fallback substitution."""
         snap = self.registry.load(int(version), fallback=False)
-        self._prefetched = snap
+        self._prefetched = snap  # lint-ok[lock-guard]: single reference store; a refresh racing this at worst drops the stash and pays one disk load on the next flip — never a torn snapshot (the loaded object is immutable)
         return snap
 
     def ensure_version(self, version: int) -> bool:

@@ -249,28 +249,40 @@ class _Replica:
                                replica=str(self.slot))
         m_q = METRICS.gauge("tsspark_pool_replica_queue",
                             replica=str(self.slot))
-        while not self.stop.is_set():
-            try:
-                os.utime(hb)
-            except OSError:
-                pass
-            if not self._claim_slot():
-                # Renewal refused: a replacement owns the slot.  Flip
-                # to fenced and let the grace timer end the process —
-                # in-flight probes must observe the structured refusal.
-                self.fenced.set()
-                obs.event("replica.fenced", slot=self.slot,
-                          pid=os.getpid())
-                threading.Timer(self.fence_grace_s,
-                                self.stop.set).start()
-                return
-            if self.engine is not None:
-                m_shed.set(float(self.engine.stats.shed))
-                m_q.set(float(self.engine.stats.submitted
-                              - self.engine.stats.completed
-                              - self.engine.stats.shed
-                              - self.engine.stats.failed))
-            self.stop.wait(self.heartbeat_s)
+        try:
+            while not self.stop.is_set():
+                try:
+                    os.utime(hb)
+                except OSError:
+                    pass
+                if not self._claim_slot():
+                    # Renewal refused: a replacement owns the slot.
+                    # Flip to fenced and let the grace timer end the
+                    # process — in-flight probes must observe the
+                    # structured refusal.
+                    self.fenced.set()
+                    obs.event("replica.fenced", slot=self.slot,
+                              pid=os.getpid())
+                    threading.Timer(self.fence_grace_s,  # lint-ok[thread-join]: one-shot grace timer whose only action is stop.set; run() exits (and the process with it) when it fires
+                                    self.stop.set).start()
+                    return
+                if self.engine is not None:
+                    m_shed.set(float(self.engine.stats.shed))
+                    m_q.set(float(self.engine.stats.submitted
+                                  - self.engine.stats.completed
+                                  - self.engine.stats.shed
+                                  - self.engine.stats.failed))
+                self.stop.wait(self.heartbeat_s)
+        except Exception as e:
+            # A dying heartbeat must take the replica down VISIBLY:
+            # with renewals stopped the lease lapses and the front
+            # respawns the slot — but only if this process also stops
+            # serving instead of running on silently past its fence
+            # (the publisher-join bug class the concurrency gate
+            # exists to catch).
+            obs.event("replica.heartbeat_failed", slot=self.slot,
+                      pid=os.getpid(), error=repr(e))
+            self.stop.set()
 
     # -- request handling ------------------------------------------------------
 
@@ -531,7 +543,7 @@ class _Replica:
                     continue
                 except OSError:
                     break
-                threading.Thread(target=self._serve_conn, args=(conn,),
+                threading.Thread(target=self._serve_conn, args=(conn,),  # lint-ok[thread-join]: per-connection daemon threads bounded by the connection lifetime; stop closes the listener, engine.stop resolves their pends, and each closes its conn in a finally — the client observes EOF and fails over
                                  daemon=True).start()
         finally:
             self.stop.set()
@@ -540,6 +552,7 @@ class _Replica:
             except OSError:
                 pass
             self.engine.stop()
+            hb_t.join(timeout=2.0)
             if self.fenced.is_set():
                 return RC_FENCED
             # Clean shutdown releases the slot for an instant successor.
@@ -795,7 +808,7 @@ class ReplicaPool:
 
     def start(self) -> "ReplicaPool":
         """Spawn every replica and wait until each answers ping."""
-        with self._lock:
+        with self._lock:  # lint-ok[lock-blocking]: lifecycle RLock held across spawn waits BY DESIGN — it serializes spawn/health/stop passes only; the request path uses the dedicated rid/count/activate locks and never contends (PR 10)
             for slot in range(self.n_replicas):
                 deadline = time.monotonic() + self.spawn_timeout_s
                 while not self._spawn(slot):
@@ -850,7 +863,7 @@ class ReplicaPool:
 
     def stop(self) -> None:
         self.stop_watch()
-        with self._lock:
+        with self._lock:  # lint-ok[lock-blocking]: lifecycle RLock across replica terminate/wait — teardown must exclude a concurrent health pass respawning what it just killed; request threads never take this lock
             for info in self.replicas.values():
                 try:
                     self._request_slot(info.slot, {"cmd": "quit"},
@@ -913,7 +926,7 @@ class ReplicaPool:
         """One health pass: respawn dead/wedged slots (under the
         respawn policy's backoff).  Returns the slots respawned."""
         respawned: List[int] = []
-        with self._lock:
+        with self._lock:  # lint-ok[lock-blocking]: lifecycle RLock across the kill/respawn pass — exactly the PR 10 design: one health pass at a time, while the request path routes on breakers/leases without ever taking this lock
             alive = 0
             for slot, info in self.replicas.items():
                 why = self._slot_unhealthy(info)
@@ -1027,7 +1040,7 @@ class ReplicaPool:
             return
         active = self.registry.active_version()
         if version == active:
-            self.expected_version = active
+            self.expected_version = active  # lint-ok[lock-guard]: single reference store (GIL-atomic) adopting the registry's active pointer; every writer converges to active_version(), so last-write-wins is idempotent — locking would park the request path behind a multi-second activate
             return
         self._bump("wrong_version")
         self._m_wrongv.inc()
@@ -1114,7 +1127,7 @@ class ReplicaPool:
             info.breaker.record_success()
             active = self.registry.active_version()
             if active != self.expected_version:
-                self.expected_version = active
+                self.expected_version = active  # lint-ok[lock-guard]: same single-store adoption as _note_served_version — GIL-atomic, idempotent, request path must not wait on the activate lock
             return self._try_slot(slot, payload, _retried=True)
         if reason == "version-mismatch":
             info.breaker.record_failure()
