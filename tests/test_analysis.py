@@ -305,6 +305,51 @@ def test_fileproto_flags_unregistered_artifact(tmp_path):
     assert found[0].rule == "non-atomic-write"
 
 
+def test_io_routing_catches_unrouted_durable_writes(tmp_path):
+    """Seeded violations of the storage-fault-domain routing rule: a
+    direct utils.atomic import, a raw os.replace, and a raw write-mode
+    open() each fire ``io-routing``; the append-mode lock idiom stays
+    exempt."""
+    src = textwrap.dedent(
+        """
+        import os
+        from tsspark_tpu.utils.atomic import atomic_write
+
+        def sideload(path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(payload)
+            os.replace(tmp, path)
+
+        def heartbeat(path):
+            with open(path, "a") as fh:
+                fh.write("alive\\n")
+        """
+    )
+    rel = "tsspark_tpu/plane/unrouted.py"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True)
+    p.write_text(src)
+    found = fileproto.check_io_routing(str(tmp_path), modules=[rel])
+    assert _rules(found) == {"io-routing"}
+    msgs = [f.message for f in found]
+    assert any("utils.atomic" in m for m in msgs)
+    assert any("os.replace" in m for m in msgs)
+    assert any("open" in m for m in msgs)
+    # Exactly three: the append-mode heartbeat did NOT fire.
+    assert len(found) == 3
+    assert all(f.qualname in ("<module>", "sideload") for f in found)
+
+
+def test_io_routing_live_tree_is_clean():
+    """Every in-scope module of the real tree routes its durable
+    writes through tsspark_tpu.io — the routing rule holds with no
+    baseline suppressions."""
+    root = os.path.dirname(os.path.dirname(fileproto.__file__))
+    repo = os.path.dirname(root)
+    assert fileproto.check_io_routing(repo) == []
+
+
 def test_claim_model_catches_overlapping_planner():
     def broken_plan(done, lo, hi, chunk):
         # Ignores completed coverage: refits everything in the window.

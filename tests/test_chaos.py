@@ -98,3 +98,37 @@ def test_smoke_storm_all_invariants_green(tmp_path):
     from tsspark_tpu.obs.__main__ import main as obs_main
 
     assert obs_main(["report", ledger_path]) == 0
+
+
+def test_storage_storm_all_invariants_green(tmp_path):
+    """The storage-fault-domain smoke (docs/RESILIENCE.md § Storage
+    fault domain): the five storage chaos classes — ENOSPC mid-publish,
+    EIO on the manifest flip, a short-write-torn column, a lost fsync
+    followed by a kill, and a disk-pressure brownout — each with its
+    invariant (no torn read served, bitwise equality with the
+    fault-free run, the degradation ladder recovers)."""
+    classes = set(compose(3, "storage").by_class())
+    assert {"enospc-mid-publish", "eio-on-flip",
+            "short-write-torn-column", "lost-fsync-then-kill",
+            "disk-pressure-brownout"} <= classes
+    report = run_storm(seed=3, profile="storage",
+                       scratch=str(tmp_path / "storm"))
+    assert report["ok"], report["invariants"]
+    inv = report["invariants"]
+    for key in ("storage_enospc_publish", "storage_eio_flip",
+                "storage_short_write", "storage_lost_fsync",
+                "storage_brownout"):
+        assert inv[key]["ok"], (key, inv[key])
+    assert inv["recovery_within_budget"]["ok"]
+    assert inv["trace_joined"]["ok"], inv["trace_joined"]
+    # The io.* counters prove the faults went through the durable-I/O
+    # layer, not around it.
+    io = report["io"]
+    assert io["tsspark_io_fault_enospc_total"] >= 1
+    assert io["tsspark_io_fault_eio_total"] >= 1
+    assert io["tsspark_io_fault_shortwrite_total"] >= 1
+    assert io["tsspark_io_disk_errors_total"] >= 2
+    assert io["tsspark_io_writes_total"] > 0
+    st = report["stages"]["storage"]
+    assert st["brownout"]["ladder"][0] == "stale_serve"
+    assert report["workload"]["storage_storm"] is True

@@ -176,6 +176,10 @@ def test_pool_stats_and_metrics_expose_per_replica_shed(pool_env):
     assert 'replica="0"' in prom and 'replica="1"' in prom
     assert "tsspark_serve_retry_after_seconds" in prom
     assert "tsspark_pool_replicas_alive" in prom
+    # Storage fault domain: with no disk budget armed the ladder reads
+    # normal and nothing is flagged stale.
+    assert st["disk_ladder"] == "normal"
+    assert st["stale_serve"] is False
 
 
 def test_zombie_replica_is_fenced_after_lease_steal(pool_env):

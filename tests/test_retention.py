@@ -1,0 +1,117 @@
+"""Retention safety under the degradation ladder (referenced from
+tsspark_tpu/io/ladder.py): eager reaping and budget-refused publishes
+may drop retained *history*, never the active version, a pinned plan's
+cycle, or anything outside the cycle namespace."""
+
+import json
+import os
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu import refit
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import (
+    ProphetConfig,
+    SeasonalityConfig,
+    SolverConfig,
+)
+from tsspark_tpu.io import DiskFullError, atomic_write_text
+from tsspark_tpu.io import budget as iobudget
+from tsspark_tpu.serve import ParamRegistry
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),),
+    n_changepoints=3,
+)
+SOLVER = SolverConfig(max_iters=10)
+
+
+def _mk_cycle(scratch, b, s, payload="x" * 64):
+    plan = {"base_stamp": b, "plan_stamp": s}
+    cdir = refit.cycle_paths(scratch, plan)[0]
+    os.makedirs(os.path.join(cdir, "delta_data"), exist_ok=True)
+    atomic_write_text(os.path.join(cdir, "delta_data", "rows.bin"),
+                      payload)
+    return cdir
+
+
+def test_reap_cycles_property_spares_keep_and_non_cycle_paths(tmp_path):
+    """Randomized trials: whatever the mix of cycle dirs, kept dirs,
+    and bystander files, reap removes exactly the unkept ``cycle_*``
+    dirs and nothing else."""
+    rng = random.Random(1302)
+    for trial in range(8):
+        scratch = str(tmp_path / f"scratch{trial}")
+        os.makedirs(scratch)
+        # Bystanders that must survive any reap: the plan record, the
+        # sched state, a registry-looking subdir, loose files.
+        atomic_write_text(os.path.join(scratch, "refit_plan.json"),
+                          json.dumps({"base_stamp": 1}))
+        atomic_write_text(os.path.join(scratch, "sched_state.json"),
+                          "{}")
+        os.makedirs(os.path.join(scratch, "registry", "v000001"))
+        atomic_write_text(
+            os.path.join(scratch, "registry", "v000001", "m.json"),
+            "{}")
+        cycles = [_mk_cycle(scratch, b, b + 1)
+                  for b in rng.sample(range(1, 500),
+                                      rng.randrange(1, 7))]
+        keep = [c for c in cycles if rng.random() < 0.5]
+        refit.reap_cycles(scratch, keep=tuple(keep))
+        survivors = {n for n in os.listdir(scratch)
+                     if n.startswith("cycle_")}
+        assert survivors == {os.path.basename(k) for k in keep}
+        for k in keep:  # kept dirs intact, not just present
+            assert os.path.exists(
+                os.path.join(k, "delta_data", "rows.bin"))
+        assert os.path.exists(
+            os.path.join(scratch, "refit_plan.json"))
+        assert os.path.exists(
+            os.path.join(scratch, "registry", "v000001", "m.json"))
+
+
+def test_reap_missing_scratch_is_a_noop(tmp_path):
+    refit.reap_cycles(str(tmp_path / "never_made"))
+
+
+def test_budget_refused_publish_never_disturbs_active_version(
+        tmp_path, monkeypatch):
+    """Disk pressure refuses NEW versions; it must not eat the one
+    being served.  Arm an exhausted budget over a live registry, watch
+    the publish fail typed, then verify the active version still loads
+    bitwise-intact."""
+    rng = np.random.default_rng(3)
+    t = np.arange(96.0)
+    y = (10 + 0.02 * t[None, :] + np.sin(2 * np.pi * t[None, :] / 7)
+         + rng.normal(0, 0.1, (4, 96)))
+    backend = get_backend("tpu", CFG, SOLVER)
+    state = backend.fit(t, jnp.asarray(y))
+    ids = [f"s{i}" for i in range(4)]
+    root = str(tmp_path / "registry")
+    reg = ParamRegistry(root, CFG)
+    v1 = reg.publish(state, ids)
+    before = {
+        os.path.relpath(os.path.join(d, f), root)
+        for d, _s, fs in os.walk(root) for f in fs
+    }
+    ref = reg.load()
+    used = iobudget.DiskBudget(root).used_bytes()
+    monkeypatch.setenv(iobudget.ENV_BUDGET_ROOT, root)
+    monkeypatch.setenv(iobudget.ENV_BUDGET_BYTES, str(used))
+    with pytest.raises(DiskFullError):
+        reg.publish(state._replace(theta=state.theta * 1.01), ids)
+    monkeypatch.delenv(iobudget.ENV_BUDGET_ROOT)
+    monkeypatch.delenv(iobudget.ENV_BUDGET_BYTES)
+    after = {
+        os.path.relpath(os.path.join(d, f), root)
+        for d, _s, fs in os.walk(root) for f in fs
+    }
+    # Nothing that existed before the refused publish was removed.
+    assert before <= after
+    snap = reg.load()
+    assert snap.version == v1 and snap.fallback_from is None
+    np.testing.assert_array_equal(
+        np.asarray(snap.state.theta), np.asarray(ref.state.theta))

@@ -480,3 +480,100 @@ def test_cache_carried_metric_exported():
     assert moved == 3
     assert METRICS.counter("tsspark_serve_cache_carried").value \
         == before + 3
+
+
+# ---------------------------------------------------------------------------
+# disk-pressure degradation ladder (docs/RESILIENCE.md § Storage fault
+# domain): idle ticks shed speculation and reap eagerly under pressure,
+# and resume once the budget clears.
+# ---------------------------------------------------------------------------
+
+
+def test_idle_tick_sheds_speculation_and_reaps_under_pressure(
+        tmp_path, monkeypatch):
+    from tsspark_tpu.io import atomic_write_text, current_state
+    from tsspark_tpu.io import budget as iobudget
+
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    scratch = str(tmp_path / "sched")
+    loop = sched.RefitScheduler(
+        dset, reg, scratch, chunk=CHUNK, solver_config=SOLVER,
+        poll_s=0.0, debounce_s=0.0, spec_refresh_s=0.0,
+    )
+    calls = []
+    monkeypatch.setattr(loop, "_refresh_speculation",
+                        lambda: calls.append(1))
+    # A stale completed cycle, with real bytes so an exhausted budget
+    # reads as zero headroom.
+    stale = os.path.join(scratch, "cycle_b000001_s000002")
+    os.makedirs(stale, exist_ok=True)
+    atomic_write_text(os.path.join(stale, "spill.bin"), "x" * 4096)
+    # Unarmed: the tick speculates and leaves retained history alone.
+    loop._idle_tick()
+    assert calls == [1]
+    assert os.path.isdir(stale)
+    # Exhausted budget over scratch: rung 1 sheds the warm prep, rung 2
+    # reaps the stale cycle — on the SAME idle tick, no publish needed.
+    used = iobudget.DiskBudget(scratch).used_bytes()
+    monkeypatch.setenv(iobudget.ENV_BUDGET_ROOT, scratch)
+    monkeypatch.setenv(iobudget.ENV_BUDGET_BYTES, str(max(1, used)))
+    loop._idle_tick()
+    assert calls == [1]  # speculation shed
+    assert not os.path.exists(stale)  # history reaped
+    # The advisory state file reports the rung for operators.  The
+    # reap itself freed budgeted bytes, so the rung may already have
+    # climbed — but with a budget armed it cannot read "normal".
+    loop._write_sched_state()
+    state = sched.read_sched_state(scratch)
+    assert state["disk_ladder"] in ("shed_spec", "reap",
+                                    "pause_ingest", "stale_serve")
+    # Budget cleared: the next tick resumes speculative warm prep.
+    monkeypatch.delenv(iobudget.ENV_BUDGET_ROOT)
+    monkeypatch.delenv(iobudget.ENV_BUDGET_BYTES)
+    loop._idle_tick()
+    assert calls == [1, 1]
+    assert current_state(scratch) == "normal"
+
+
+def test_tick_pauses_refit_intake_at_pause_ingest(tmp_path, monkeypatch):
+    """Rung 3 (pause_ingest): with pending deltas but no headroom the
+    tick must not draft a cycle (the spill would grow scratch at the
+    worst moment) — deltas stay pending until relief."""
+    from tsspark_tpu.io import atomic_write_text
+    from tsspark_tpu.io import budget as iobudget
+
+    spec, dset, reg, ids, v1 = _setup(tmp_path)
+    plane.land_synthetic_delta(dset, 0.25)
+    scratch = str(tmp_path / "sched")
+    loop = sched.RefitScheduler(
+        dset, reg, scratch, chunk=CHUNK, solver_config=SOLVER,
+        poll_s=0.0, debounce_s=0.0, spec_refresh_s=1e9,
+    )
+    loop._startup_resume()
+    assert loop._pending  # the delta is seen and owed a cycle
+    real_draft = refit.draft_plan
+    os.makedirs(scratch, exist_ok=True)
+    atomic_write_text(os.path.join(scratch, "ballast"), "b" * 4096)
+    used = iobudget.DiskBudget(scratch).used_bytes()
+    monkeypatch.setenv(iobudget.ENV_BUDGET_ROOT, scratch)
+    monkeypatch.setenv(iobudget.ENV_BUDGET_BYTES, str(max(1, used)))
+    monkeypatch.setattr(
+        refit, "draft_plan",
+        lambda *a, **k: pytest.fail("drafted a cycle under pause_ingest"))
+    loop._tick()
+    assert loop._pending  # still owed — intake paused, not dropped
+    assert loop.failures == 0  # a pause is not a failure
+    # Relief: the same tick drafts (and the recorder proves it got
+    # past the gate).
+    monkeypatch.delenv(iobudget.ENV_BUDGET_ROOT)
+    monkeypatch.delenv(iobudget.ENV_BUDGET_BYTES)
+    drafted = []
+
+    def record_draft(*a, **k):
+        drafted.append(1)
+        return real_draft(*a, **k)
+
+    monkeypatch.setattr(refit, "draft_plan", record_draft)
+    loop._tick()
+    loop._join_publisher(block=True)
+    assert drafted

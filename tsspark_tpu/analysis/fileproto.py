@@ -348,8 +348,24 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "contains the 'manifest.json' fragment",
     ),
     ArtifactSpec(
+        # The unified plane library's generic writers: their path
+        # arguments are caller-supplied (no literal fragment), so they
+        # classify by writer name.  Every plane artifact they produce
+        # also has its own marker-keyed spec above/below carrying the
+        # per-family lifecycle story.
+        "plane-protocol", (),
+        ("write_spec", "write_column", "write_sentinel",
+         "publish_plane"),
+        "generic column-plane protocol writers (plane/protocol.py), "
+        "each an atomic publish through tsspark_tpu.io: spec first, "
+        "column payloads, CRC sentinel LAST — the one implementation "
+        "the plane-protocol ProtocolSpec verifies for every caller",
+    ),
+    ArtifactSpec(
         "snapshot-plane", ("snapcol_", "snap_spec.json", "snapok.json"),
-        ("write_plane", "write_plane_delta", "_link_or_copy"),
+        ("write_plane", "write_plane_delta", "publish_plane",
+         "write_spec", "write_column", "write_sentinel",
+         "link_or_copy"),
         "mmap snapshot column plane (serve/snapplane.py): spec first, "
         "one atomic .npy per FitState column + the id->row index, the "
         "per-shard CRC sentinel LAST — the unit of visibility, exactly "
@@ -451,6 +467,18 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "the trajectory",
     ),
     ArtifactSpec(
+        # The durable-I/O layer itself (io/durable.py): its wrappers
+        # delegate to each other with caller-supplied paths, so the
+        # inner calls classify by writer name.  Each artifact the
+        # wrappers ultimately produce is registered at its call site's
+        # module via markers.
+        "io-layer", (),
+        ("atomic_write", "atomic_write_text", "append_line"),
+        "the durable-I/O choke point (tsspark_tpu.io): budget gate, "
+        "io_* fault points, fsync barrier, classified errors — the "
+        "helper every storage-fault-domain artifact routes through",
+    ),
+    ArtifactSpec(
         "fault-injection", (),
         ("corrupt_file", "FaultPlan.corrupt_file", "inject"),
         "deterministic test-only corruption/sentinels (resilience."
@@ -467,6 +495,11 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/sched.py",
     "tsspark_tpu/data/plane.py",
     "tsspark_tpu/data/ingest.py",
+    "tsspark_tpu/io/durable.py",
+    "tsspark_tpu/io/budget.py",
+    "tsspark_tpu/io/ladder.py",
+    "tsspark_tpu/io/errors.py",
+    "tsspark_tpu/plane/protocol.py",
     "tsspark_tpu/streaming/state.py",
     "tsspark_tpu/streaming/driver.py",
     "tsspark_tpu/streaming/source.py",
@@ -754,6 +787,120 @@ def _writer_allowed(spec: ArtifactSpec, qualname: str) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# storage-fault-domain routing: durable writes go through tsspark_tpu.io
+# ---------------------------------------------------------------------------
+
+#: Modules inside the storage fault domain.  Durable artifacts written
+#: here must route through ``tsspark_tpu.io`` — the one fault-
+#: injectable, budget-gated, error-classified choke point — so a raw
+#: publish syscall or a direct ``utils.atomic`` import silently opts a
+#: writer out of ENOSPC/EIO chaos coverage and is flagged.
+IO_ROUTED_PREFIXES: Tuple[str, ...] = (
+    "tsspark_tpu/data/",
+    "tsspark_tpu/serve/",
+    "tsspark_tpu/plane/",
+)
+IO_ROUTED_MODULES: Tuple[str, ...] = (
+    "tsspark_tpu/refit.py",
+    "tsspark_tpu/sched.py",
+)
+
+#: os-level durable publish primitives the io layer owns.
+_RAW_OS_DURABLE = frozenset({"replace", "rename", "link", "write"})
+
+
+def _in_io_scope(rel: str) -> bool:
+    return rel.startswith(IO_ROUTED_PREFIXES) or rel in IO_ROUTED_MODULES
+
+
+def check_io_routing(
+    root: str, modules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """The ``fileproto`` routing rule of the storage fault domain:
+    modules under ``data/``, ``serve/``, ``plane/`` plus ``refit`` and
+    ``sched`` may not import durable-write helpers from
+    ``utils.atomic`` directly, call ``os.replace``/``os.rename``/
+    ``os.link``/``os.write``, or ``open()`` a file in a create/write
+    mode — every durable write goes through ``tsspark_tpu.io`` so each
+    one sits behind the ``io_*`` fault points, typed storage errors,
+    and the disk budget.  Append-mode opens stay legal: lock files and
+    heartbeats are liveness/serialization primitives, not artifacts.
+
+    ``modules`` overrides the scan set verbatim (the seeded-violation
+    fixture test); by default the in-scope PROTOCOL_MODULES are
+    scanned."""
+    if modules is None:
+        scan = [rel for rel in PROTOCOL_MODULES if _in_io_scope(rel)]
+    else:
+        scan = list(modules)
+    findings: List[Finding] = []
+
+    def emit(rel, line, qual, detail):
+        findings.append(Finding("io-routing", rel, line, qual, detail))
+
+    for rel in scan:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            continue
+        with open(path, "r") as fh:
+            tree = ast.parse(fh.read(), filename=rel)
+        qualnames = _fn_qualname_map(tree)
+
+        # Walk with an explicit function stack so findings carry the
+        # enclosing qualname.
+        def visit(node, stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack = stack + [qualnames[id(node)]]
+            qual = stack[-1] if stack else "<module>"
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "tsspark_tpu.utils.atomic":
+                durable = sorted(
+                    a.name for a in node.names
+                    if a.name in ("atomic_write", "atomic_write_text",
+                                  "append_line")
+                )
+                if durable:
+                    emit(rel, node.lineno, qual,
+                         f"imports {durable} from utils.atomic; "
+                         "storage-fault-domain modules must import "
+                         "durable writers from tsspark_tpu.io so every "
+                         "write sits behind the io_* fault points and "
+                         "the disk budget")
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _RAW_OS_DURABLE
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id == "os"):
+                    emit(rel, node.lineno, qual,
+                         f"raw os.{func.attr}() in the storage fault "
+                         "domain; route the publish through "
+                         "tsspark_tpu.io (atomic_write / hardlink / "
+                         "append_line) so it is fault-injectable and "
+                         "error-classified")
+                elif isinstance(func, ast.Name) and func.id == "open":
+                    mode = ""
+                    if len(node.args) > 1 \
+                            and isinstance(node.args[1], ast.Constant):
+                        mode = str(node.args[1].value)
+                    for kw in node.keywords:
+                        if kw.arg == "mode" \
+                                and isinstance(kw.value, ast.Constant):
+                            mode = str(kw.value.value)
+                    if any(c in mode for c in "wx+"):
+                        emit(rel, node.lineno, qual,
+                             f"raw open(..., {mode!r}) in the storage "
+                             "fault domain; durable artifacts are "
+                             "published via tsspark_tpu.io.atomic_write "
+                             "(append-mode locks/heartbeats are exempt)")
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack)
+
+        visit(tree, [])
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # range-claim small-model check
 # ---------------------------------------------------------------------------
 
@@ -903,6 +1050,7 @@ def check_completed_ranges_order() -> List[Finding]:
 def check_fileproto(root: str) -> List[Finding]:
     return (
         check_write_sites(root)
+        + check_io_routing(root)
         + check_claim_invariants()
         + check_completed_ranges_order()
     )

@@ -119,7 +119,7 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
             StepSpec("scatter", "call:open_memmap",
                      reader="readers trust only sentinel-covered rows; "
                             "unsentineled column bytes are invisible"),
-            StepSpec("sentinel", "art:plane-shard-ok", role="gate",
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
                      certifies=("spec", "scatter")),
         ),
         resume="a producer killed mid-shard leaves no sentinel; any "
@@ -138,7 +138,7 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
                      reader="absolute-value scatter is bitwise "
                             "idempotent; repair rolls a torn shard "
                             "back to base + visible patches"),
-            StepSpec("reland", "art:plane-shard-ok",
+            StepSpec("reland", "call:_reland_sentinel_from_disk",
                      reader="re-landed sentinel carries post-delta "
                             "CRCs; a kill before it reads as shard "
                             "corruption and repair() re-lands"),
@@ -150,33 +150,36 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
                "invisible; the flock serializes racing landers",
     ),
     ProtocolSpec(
-        "snap-plane-publish",
-        "tsspark_tpu/serve/snapplane.py", "write_plane",
+        # The ONE generic plane publish every implementation routes
+        # through (data plane base shards, snapshot planes, delta
+        # copy-forwards): verifying this writer verifies them all.
+        "plane-protocol",
+        "tsspark_tpu/plane/protocol.py", "publish_plane",
         steps=(
-            StepSpec("spec", "tok:SNAP_SPEC",
-                     reader="attach() requires spec + sentinel; a "
+            StepSpec("spec", "call:write_spec",
+                     reader="readers require spec + sentinel; a "
                             "spec-only dir is rejected whole"),
-            StepSpec("columns", "tok:_col_path",
+            StepSpec("columns", "call:write_column",
                      reader="columns are invisible until the CRC "
-                            "sentinel lands; attach rejects mismatches "
-                            "and falls back down the version chain"),
-            StepSpec("sentinel", "tok:SNAP_OK", role="gate",
+                            "sentinel lands; readers reject mismatches "
+                            "and fall back down the version chain"),
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
                      certifies=("spec", "columns")),
         ),
-        resume="the version dir is publisher-private until the registry "
-               "manifest references it; a publisher killed mid-plane "
-               "leaves an orphan dir the allocator skips",
+        resume="a publisher killed mid-plane leaves no sentinel: the "
+               "plane reads as absent/in-progress and any successor "
+               "republishes the same bytes",
     ),
     ProtocolSpec(
         "snap-plane-delta",
         "tsspark_tpu/serve/snapplane.py", "write_plane_delta",
         steps=(
-            StepSpec("spec", "tok:SNAP_SPEC",
+            StepSpec("spec", "call:write_spec",
                      reader="same attach() gate as the full plane"),
-            StepSpec("columns", "tok:_col_path",
+            StepSpec("columns", "call:write_column",
                      reader="hardlinked or copy-forwarded columns are "
                             "invisible until the sentinel lands"),
-            StepSpec("sentinel", "tok:SNAP_OK", role="gate",
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
                      certifies=("spec", "columns")),
             StepSpec("delta-manifest", "tok:DELTA_MANIFEST",
                      role="advisory",

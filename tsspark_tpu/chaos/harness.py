@@ -1272,6 +1272,293 @@ def _run_sched_storm(scratch: str, storm: StormPlan,
             os.environ[faults.ENV_VAR] = env_plan
 
 
+# ---------------------------------------------------------------------------
+# stage J: storage fault domain (the durable-I/O layer) under storm
+# ---------------------------------------------------------------------------
+
+
+def _run_storage_storm(scratch: str, storm: StormPlan, state, ids,
+                       mttr: Dict[str, Optional[float]]
+                       ) -> Tuple[Dict, Dict]:
+    """The five storage classes against a PRIVATE registry + data
+    plane: every durable write in the stage routes through
+    ``tsspark_tpu.io``, so the armed ``io_write``/``io_fsync`` rules and
+    the environment-armed ``DiskBudget`` are the only faults — the
+    global storm env plan is popped for the stage's duration.
+
+    Cross-class invariants (docs/RESILIENCE.md "Storage fault domain"):
+    no torn read is ever served (every ``registry.load`` returns a
+    CRC-complete version), the post-fault republish is bitwise the
+    fault-free publish, and the degradation ladder both descends under
+    pressure and releases on relief."""
+    import glob as _glob
+    import subprocess
+    import warnings as _warnings
+
+    from tsspark_tpu import orchestrate
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.io import (
+        BackpressureError,
+        DiskFullError,
+        DiskIOError,
+        active_ladder,
+        current_state,
+        stale_serving,
+    )
+    from tsspark_tpu.io import budget as iobudget
+    from tsspark_tpu.serve import snapplane
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    base = os.path.join(scratch, "storage")
+    os.makedirs(base, exist_ok=True)
+    t0 = time.time()
+    env_plan = os.environ.pop(faults.ENV_VAR, None)
+    old_budget = {k: os.environ.pop(k, None)
+                  for k in (iobudget.ENV_BUDGET_BYTES,
+                            iobudget.ENV_BUDGET_ROOT)}
+    invariants: Dict[str, Dict] = {}
+    step = np.ones(len(ids))
+    try:
+        cfg, _solver = _config(storm.profile.max_iters)
+        registry = ParamRegistry(os.path.join(base, "registry"), cfg)
+        v1 = registry.publish(state, ids, step=step)
+        ref_snap = registry.load()
+
+        # ---- enospc-mid-publish: ENOSPC on a snapshot column write
+        # ---- kills the publish mid-plane; the manifest never moves --
+        inj_a = storm.direct("enospc-mid-publish")
+        plan_a = faults.FaultPlan(
+            state_dir=os.path.join(base, "faults_enospc"))
+        plan_a.fail("io_write", mode="enospc", after=inj_a.after,
+                    attempts=1, path="snapcol_",
+                    tag="enospc-mid-publish")
+        plan_a.install()
+        t_fault = time.time()
+        err_a: Optional[BaseException] = None
+        try:
+            registry.publish(state, ids, step=step,
+                             snapshot_format="mmap")
+        except OSError as e:
+            err_a = e
+        os.environ.pop(faults.ENV_VAR, None)
+        obs.event("fault", tag="enospc-mid-publish", mode="direct")
+        active_mid = registry.active_version()
+        mid_snap = registry.load()
+        v_retry = registry.publish(state, ids, step=step,
+                                   snapshot_format="mmap")
+        retry_snap = registry.load()
+        mttr["enospc-mid-publish"] = time.time() - t_fault
+        obs.event("recovered", tag="enospc-mid-publish")
+        bitwise_a = inv.states_bitwise_equal(retry_snap.state,
+                                             ref_snap.state)
+        invariants["storage_enospc_publish"] = {
+            "ok": (isinstance(err_a, DiskFullError)
+                   and active_mid == v1 and mid_snap.version == v1
+                   and retry_snap.version == v_retry
+                   and bitwise_a["ok"]),
+            "error": type(err_a).__name__ if err_a else None,
+            "active_preserved": active_mid == v1,
+            "served_mid_fault": mid_snap.version,
+            "retry_version": v_retry,
+            "retry_bitwise_vs_reference": bitwise_a,
+        }
+
+        # ---- eio-on-flip: the manifest rename that activates a
+        # ---- version raises EIO; the flip fails CLEAN ---------------
+        v_next = registry.publish(state, ids, step=step,
+                                  activate=False)
+        plan_b = faults.FaultPlan(
+            state_dir=os.path.join(base, "faults_eio"))
+        plan_b.fail("io_write", mode="eio", path="manifest.json",
+                    tag="eio-on-flip")
+        plan_b.install()
+        t_fault = time.time()
+        err_b: Optional[BaseException] = None
+        try:
+            registry.activate(v_next)
+        except OSError as e:
+            err_b = e
+        os.environ.pop(faults.ENV_VAR, None)
+        obs.event("fault", tag="eio-on-flip", mode="direct")
+        active_after_eio = registry.active_version()
+        registry.activate(v_next)  # fault exhausted: retry flips
+        mttr["eio-on-flip"] = time.time() - t_fault
+        obs.event("recovered", tag="eio-on-flip")
+        invariants["storage_eio_flip"] = {
+            "ok": (isinstance(err_b, DiskIOError)
+                   and active_after_eio == v_retry
+                   and registry.active_version() == v_next),
+            "error": type(err_b).__name__ if err_b else None,
+            "active_after_fault": active_after_eio,
+            "active_after_retry": registry.active_version(),
+        }
+
+        # ---- short-write-torn-column: a silently truncated column
+        # ---- publishes "successfully"; only the CRC sentinel and the
+        # ---- fallback chain stand between it and a served forecast --
+        inj_c = storm.direct("short-write-torn-column")
+        frac = 0.3 + ((inj_c.series or 0) % 101) / 250.0  # [0.3, 0.7]
+        plan_c = faults.FaultPlan(
+            state_dir=os.path.join(base, "faults_shortw"))
+        plan_c.fail("io_write", mode="shortwrite", path="snapcol_theta",
+                    fraction=round(frac, 3),
+                    tag="short-write-torn-column")
+        plan_c.install()
+        t_fault = time.time()
+        v_torn = registry.publish(state, ids, step=step,
+                                  snapshot_format="mmap")
+        os.environ.pop(faults.ENV_VAR, None)
+        obs.event("fault", tag="short-write-torn-column",
+                  mode="direct", version=v_torn)
+        torn_rejected = not snapplane.verify_plane(
+            registry.version_dir(v_torn))
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore", RuntimeWarning)
+            snap_c = registry.load()
+        mttr["short-write-torn-column"] = time.time() - t_fault
+        obs.event("recovered", tag="short-write-torn-column")
+        invariants["storage_short_write"] = {
+            "ok": (torn_rejected and snap_c.version == v_next
+                   and snap_c.fallback_from == v_torn),
+            "torn_version": v_torn,
+            "sentinel_rejected": torn_rejected,
+            "served_version": snap_c.version,
+            "fallback_from": snap_c.fallback_from,
+        }
+        registry.activate(v_next)  # restore a good active pointer
+
+        # ---- lost-fsync-then-kill: an activation flip lands only in
+        # ---- the page cache, the process dies, the rename dies with
+        # ---- it — the survivor must observe the PRE-flip truth ------
+        inj_d = storm.direct("lost-fsync-then-kill")
+        v_lost = registry.publish(state, ids, step=step,
+                                  activate=False)
+        marker = os.path.join(base, "killmarker.json")
+        plan_d = faults.FaultPlan(
+            state_dir=os.path.join(base, "faults_lost"))
+        plan_d.fail("io_fsync", mode="lost_fsync",
+                    path="manifest.json", tag="lost-fsync-then-kill")
+        plan_d.fail("io_write", mode="exit", rc=inj_d.rc,
+                    path="killmarker", tag="lost-fsync-then-kill")
+        env = orchestrate._child_env()
+        env[faults.ENV_VAR] = plan_d.to_env()
+        obs.inject_env(env)
+        code = (
+            "from tsspark_tpu.io import atomic_write_text\n"
+            "from tsspark_tpu.serve.registry import ParamRegistry\n"
+            f"r = ParamRegistry.open({registry.root!r})\n"
+            f"r.activate({int(v_lost)})\n"
+            # The flip 'succeeded' in-process; the armed kill below
+            # replays the lost fsync (rolls the manifest back) and dies.
+            f"atomic_write_text({marker!r}, 'never lands')\n"
+        )
+        child = subprocess.run([sys.executable, "-c", code], env=env,
+                               stdout=sys.stderr, timeout=120)
+        # MTTR clock starts when the kill is OBSERVED (child exit), as
+        # at every other kill class — not at child launch, which would
+        # bill interpreter startup to the recovery path.
+        t_fault = time.time()
+        obs.event("fault", tag="lost-fsync-then-kill", mode="direct",
+                  rc=child.returncode)
+        active_after_kill = registry.active_version()
+        survivor_snap = registry.load()
+        replayed = _glob.glob(os.path.join(
+            plan_d.state_dir, "lostfsync", "rec.*.json.done"))
+        registry.activate(v_lost)  # the successor re-flips cleanly
+        mttr["lost-fsync-then-kill"] = time.time() - t_fault
+        obs.event("recovered", tag="lost-fsync-then-kill")
+        invariants["storage_lost_fsync"] = {
+            "ok": (child.returncode == inj_d.rc
+                   and active_after_kill == v_next
+                   and survivor_snap.version == v_next
+                   and not os.path.exists(marker)
+                   and len(replayed) == 1
+                   and registry.active_version() == v_lost),
+            "child_rc": child.returncode,
+            "active_after_kill": active_after_kill,
+            "served_after_kill": survivor_snap.version,
+            "rollback_replayed": len(replayed),
+            "marker_landed": os.path.exists(marker),
+            "active_after_resume": registry.active_version(),
+        }
+
+        # ---- disk-pressure-brownout: a byte budget strangles the
+        # ---- root; the ladder must descend in order and release -----
+        spec = plane.DatasetSpec(
+            generator="demo_weekly", n_series=16, n_timesteps=48,
+            seed=storm.seed + 5, shard_rows=8,
+        )
+        dset = plane.ensure(spec, root=os.path.join(base, "plane"))
+        rec0 = plane.land_synthetic_delta(dset, 0.25)
+        used = iobudget.DiskBudget(base).used_bytes()
+        os.environ[iobudget.ENV_BUDGET_ROOT] = base
+        os.environ[iobudget.ENV_BUDGET_BYTES] = str(used + 1024)
+        t_fault = time.time()
+        obs.event("fault", tag="disk-pressure-brownout", mode="direct")
+        lad = active_ladder(dset)
+        state_tight = current_state(dset)
+        shed = lad is not None and not lad.allows("speculate")
+        stale = stale_serving(registry.root)
+        bp: Optional[BaseException] = None
+        try:
+            plane.land_synthetic_delta(dset, 0.25)
+        except BackpressureError as e:
+            bp = e
+        full_err: Optional[BaseException] = None
+        try:
+            registry.publish(state, ids, step=step)
+        except DiskFullError as e:
+            full_err = e
+        under_pressure = registry.load()
+        # Relief: a 50x budget — the ladder must release (hysteresis
+        # permitting; the REAL filesystem's free fraction still caps
+        # headroom) far enough to resume delta ingestion.
+        os.environ[iobudget.ENV_BUDGET_BYTES] = str(used * 50)
+        state_relief = current_state(dset)
+        rec2 = plane.land_synthetic_delta(dset, 0.25)
+        mttr["disk-pressure-brownout"] = time.time() - t_fault
+        obs.event("recovered", tag="disk-pressure-brownout")
+        unstale = not stale_serving(registry.root)
+        invariants["storage_brownout"] = {
+            "ok": (state_tight == "stale_serve" and shed and stale
+                   and isinstance(bp, BackpressureError)
+                   and isinstance(full_err, DiskFullError)
+                   and under_pressure.version == v_lost
+                   and rec2["seq"] > rec0["seq"] and unstale),
+            "ladder_under_pressure": state_tight,
+            "speculation_shed": shed,
+            "stale_serving_flagged": stale,
+            "ingest_backpressure": type(bp).__name__ if bp else None,
+            "publish_refused": (type(full_err).__name__
+                               if full_err else None),
+            "served_under_pressure": under_pressure.version,
+            "ladder_after_relief": state_relief,
+            "ingest_resumed": rec2["seq"] > rec0["seq"],
+            "unstale_after_relief": unstale,
+        }
+
+        stage = {
+            "wall_s": round(time.time() - t0, 3),
+            "v1": v1, "enospc_retry": v_retry, "eio_flip": v_next,
+            "torn": v_torn, "lost_fsync_flip": v_lost,
+            "brownout": {
+                "used_bytes": used,
+                "ladder": [state_tight, state_relief],
+                "delta_seqs": [rec0["seq"], rec2["seq"]],
+            },
+        }
+        return stage, invariants
+    finally:
+        os.environ.pop(faults.ENV_VAR, None)
+        for k, v in old_budget.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if env_plan is not None:
+            os.environ[faults.ENV_VAR] = env_plan
+
+
 def run_storm(seed: int = 0, profile: str = "full",
               scratch: Optional[str] = None,
               keep_scratch: bool = False,
@@ -1543,6 +1830,14 @@ def run_storm(seed: int = 0, profile: str = "full",
                 )
             invariants.update(sched_inv)
 
+        # ---- stage J: storage fault domain (durable-I/O layer) -------
+        if prof.storage_storm:
+            with obs.span("stage.storage"):
+                stages["storage"], storage_inv = _run_storage_storm(
+                    scratch, storm, got_state, ids, mttr
+                )
+            invariants.update(storage_inv)
+
         # ---- cross-stage invariants ----------------------------------
         if out_dir is not None:
             corrupt_injected = sum(
@@ -1599,6 +1894,17 @@ def run_storm(seed: int = 0, profile: str = "full",
         # ---- the run ledger: every stage joined under one trace ------
         METRICS.export(os.path.join(scratch, "metrics_harness.json"),
                        trace_id=obs.trace_id())
+        # The storage fault domain's own accounting: every io.* counter
+        # and gauge the storm drove (writes, classified disk errors,
+        # fired storage faults, budget headroom, ladder state) — scored
+        # into the report so RUNHISTORY rows carry them per storm.
+        snap_m = METRICS.snapshot()
+        io_metrics = {
+            m["name"]: m["value"]
+            for kind in ("counters", "gauges")
+            for m in snap_m[kind]
+            if m["name"].startswith("tsspark_io_")
+        }
         ledger = obs_ledger.build_ledger(scratch)
         mttr_spans = ledger["mttr_s"]
         mttr_delta = {
@@ -1670,6 +1976,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "resident_series": prof.resident_series,
                 "refit_series": prof.refit_series,
                 "sched_storm": prof.sched_storm,
+                "storage_storm": prof.storage_storm,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),
@@ -1678,6 +1985,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                            if kk not in ("out_dir", "end_time")}
                        for k, v in stages.items()},
             "invariants": invariants,
+            "io": io_metrics,
             "mttr_s": {k: (None if v is None else round(v, 3))
                        for k, v in mttr.items()},
             "mttr_spans_s": mttr_spans,

@@ -96,6 +96,37 @@ profiles with ``sched_storm``):
                      final snapshot's unchanged rows are bitwise its
                      base's, and data-to-forecast freshness recovers
                      within the recovery budget after the storm.
+
+Storage fault-domain classes (the durable-I/O layer ``tsspark_tpu.io``;
+profiles with ``storage_storm``, docs/RESILIENCE.md "Storage fault
+domain"):
+
+  enospc-mid-publish  an injected ENOSPC (``io_write``, path-scoped to
+                      the snapshot columns) kills a registry publish
+                      mid-plane: the manifest never flips, the active
+                      version keeps serving, and a retry publishes a
+                      version bitwise equal to the fault-free one
+  eio-on-flip         the manifest rename that activates a version
+                      raises EIO: the flip must fail CLEAN (old pointer
+                      intact, typed ``DiskIOError``) and succeed on
+                      retry
+  short-write-torn-column  a column payload is silently truncated
+                      (unchecked ``write(2)`` return) and the publish
+                      REPORTS SUCCESS: only the CRC sentinel can catch
+                      it at attach — the fallback chain serves the last
+                      good version, never torn parameters
+  lost-fsync-then-kill  an activation's manifest rename lands only in
+                      the page cache; the process is killed and the
+                      rename rolled back (the crash lost it): the
+                      survivor must observe the PRE-flip truth and a
+                      successor re-activate cleanly
+  disk-pressure-brownout  a byte budget strangles the storage root:
+                      the degradation ladder must descend in order
+                      (shed speculation -> reap -> pause ingest with
+                      ``BackpressureError`` -> stale-flagged serving),
+                      version-producing writes must be refused by the
+                      budget gate while the active version KEEPS
+                      serving, and relief must resume ingestion
 """
 
 from __future__ import annotations
@@ -168,6 +199,10 @@ class StormProfile:
     # Loop-storm (the always-on scheduler): reuses refit_series/
     # refit_chunk/refit_churn sizing; the flag arms the kill chain.
     sched_storm: bool = False
+    # Storage fault domain (tsspark_tpu.io): ENOSPC/EIO/short-write/
+    # lost-fsync against the registry's durable writes plus the
+    # disk-pressure brownout driving the degradation ladder.
+    storage_storm: bool = False
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -191,6 +226,17 @@ PROFILES: Dict[str, StormProfile] = {
         run_streaming=False, pool_replicas=2, pool_requests=30,
         plane_series=48, plane_shard_rows=16,
     ),
+    # Storage fault-domain smoke for tier-1 (<30 s budget): one
+    # in-process fit feeds a private registry, then the five storage
+    # classes run against the durable-I/O layer — no pool, no loadgen,
+    # no streaming.
+    "storage": StormProfile(
+        name="storage", series=12, days=48, chunk=8, max_iters=15,
+        phase1_iters=0, stream_series=0, stream_batches=0,
+        loadgen_requests=0, serve_queue=16, probe_accelerator=False,
+        recovery_budget_s=60.0, run_orchestrate=False,
+        run_streaming=False, storage_storm=True,
+    ),
     # The acceptance storm (python -m tsspark_tpu.chaos --seed 0):
     # two-phase orchestrate, probe loop included, longer loadgen, the
     # replica pool under kill/split-brain/front-crash, the data plane
@@ -204,7 +250,7 @@ PROFILES: Dict[str, StormProfile] = {
         plane_series=64, plane_shard_rows=16,
         resident_series=32, resident_chunk=8,
         refit_series=32, refit_chunk=8, refit_churn=0.25,
-        sched_storm=True,
+        sched_storm=True, storage_storm=True,
     ),
 }
 
@@ -410,6 +456,35 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
         inj.append(Injection(
             cls="loop-storm", stage="sched", point="sched_proc",
             mode="direct",
+        ))
+
+    # -- storage fault-domain stage (the harness arms each class's
+    # -- PRIVATE plan against the io_* points; ``after`` picks which
+    # -- column write the ENOSPC lands on, ``series`` seeds the
+    # -- short-write fraction draw, ``rc`` the lost-fsync kill) -------
+    if prof.storage_storm:
+        inj.append(Injection(
+            cls="enospc-mid-publish", stage="storage",
+            point="io_write", mode="direct",
+            after=rng.randrange(0, 3),
+        ))
+        inj.append(Injection(
+            cls="eio-on-flip", stage="storage", point="io_write",
+            mode="direct",
+        ))
+        inj.append(Injection(
+            cls="short-write-torn-column", stage="storage",
+            point="io_write", mode="direct",
+            series=rng.randrange(1 << 16),
+        ))
+        inj.append(Injection(
+            cls="lost-fsync-then-kill", stage="storage",
+            point="io_fsync", mode="direct",
+            rc=rng.choice((17, 23, 29)),
+        ))
+        inj.append(Injection(
+            cls="disk-pressure-brownout", stage="storage",
+            point="disk-budget", mode="direct",
         ))
 
     # -- data-plane stage ---------------------------------------------

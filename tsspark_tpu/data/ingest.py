@@ -31,7 +31,7 @@ from typing import Dict, Optional
 
 from tsspark_tpu.data import plane
 from tsspark_tpu.obs import context as obs
-from tsspark_tpu.utils.atomic import atomic_write
+from tsspark_tpu.io import atomic_write
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))

@@ -57,8 +57,22 @@ import numpy as np
 
 from tsspark_tpu.data import datasets
 from tsspark_tpu.data.datasets import SeriesBatch
+from tsspark_tpu.io import (
+    atomic_write,
+    attach_array,
+    gate_ingest,
+    hardlink,
+    is_missing,
+    open_memmap,
+    reraise_classified,
+)
+from tsspark_tpu.plane.protocol import (
+    read_json,
+    shard_crcs,
+    write_sentinel,
+)
+from tsspark_tpu.plane.protocol import shard_ranges as _plane_shard_ranges
 from tsspark_tpu.resilience import integrity
-from tsspark_tpu.utils.atomic import atomic_write
 
 #: Cache-format revision: bump when the on-disk layout (NOT the data)
 #: changes incompatibly; part of every spec record.
@@ -168,10 +182,7 @@ def dataset_dir(spec: DatasetSpec, root: Optional[str] = None) -> str:
 
 
 def shard_ranges(spec: DatasetSpec) -> List[Tuple[int, int]]:
-    return [
-        (lo, min(lo + spec.shard_rows, spec.n_series))
-        for lo in range(0, spec.n_series, spec.shard_rows)
-    ]
+    return _plane_shard_ranges(spec.n_series, spec.shard_rows)
 
 
 def generate_rows(spec: DatasetSpec, lo: int, hi: int) -> SeriesBatch:
@@ -213,13 +224,6 @@ def batch_columns(batch: SeriesBatch) -> Dict[str, np.ndarray]:
     return cols
 
 
-def _shard_crcs(cols: Dict[str, np.ndarray]) -> Dict[str, int]:
-    return {
-        k: zlib.crc32(np.ascontiguousarray(v).tobytes())
-        for k, v in cols.items()
-    }
-
-
 def _sentinel_path(dset_dir: str, lo: int, hi: int) -> str:
     return os.path.join(dset_dir, f"shardok_{lo:09d}_{hi:09d}.json")
 
@@ -233,12 +237,9 @@ def _land_shard_sentinel(dset_dir: str, lo: int, hi: int,
     the advanced rows as corruption and roll them back to base."""
     sentinel = {
         "lo": lo, "hi": hi, "unix": round(time.time(), 3),
-        "crc": _shard_crcs(cols), "pid": os.getpid(),
+        "crc": shard_crcs(cols), "pid": os.getpid(),
     }
-    atomic_write(
-        _sentinel_path(dset_dir, lo, hi),
-        lambda fh: json.dump(sentinel, fh), mode="w",
-    )
+    write_sentinel(_sentinel_path(dset_dir, lo, hi), sentinel)
 
 
 # ---------------------------------------------------------------------------
@@ -270,29 +271,28 @@ def _prealloc_column(path: str, shape: Tuple[int, ...]) -> None:
         return
     d, base = os.path.split(os.path.abspath(path))
     tmp = os.path.join(d, f".{base}.tmp.{os.getpid()}")
-    mm = np.lib.format.open_memmap(tmp, mode="w+", dtype=np.float32,
-                                   shape=shape)
+    mm = open_memmap(tmp, mode="w+", dtype=np.float32, shape=shape)
     del mm
     try:
-        os.link(tmp, path)
+        hardlink(tmp, path)
     except FileExistsError:
         pass  # a racer published first; keep theirs (rows may be landed)
     finally:
         try:
             os.remove(tmp)
-        except OSError:
-            pass
+        except OSError as e:
+            # A temp that is already gone is fine; a disk that refuses
+            # the unlink (EIO, EROFS) is not — surface it typed.
+            if not is_missing(e):
+                reraise_classified(e)
 
 
 def read_spec(dset_dir: str) -> Optional[Dict]:
     """The dataset's identity record, or None when ``dset_dir`` is not
-    a plane dataset (e.g. a plain ``orchestrate.spill_data`` dir)."""
-    try:
-        with open(os.path.join(dset_dir, _SPEC_FILE)) as fh:
-            d = json.load(fh)
-        return d if isinstance(d, dict) else None
-    except (OSError, ValueError):
-        return None
+    a plane dataset (e.g. a plain ``orchestrate.spill_data`` dir).
+    Absence and torn JSON read as None; a real disk failure raises its
+    typed storage error (``tsspark_tpu.io.errors``)."""
+    return read_json(os.path.join(dset_dir, _SPEC_FILE))
 
 
 def create_columns(spec: DatasetSpec, root: Optional[str] = None) -> str:
@@ -356,8 +356,8 @@ def write_shard(spec: DatasetSpec, shard_index: int,
     batch = generate_rows(spec, lo, hi)
     cols = batch_columns(batch)
     for f, rows in cols.items():
-        mm = np.lib.format.open_memmap(
-            os.path.join(dset_dir, f"{f}.npy"), mode="r+"
+        mm = open_memmap(
+            os.path.join(dset_dir, f"{f}.npy"), mode="r+", lo=lo, hi=hi
         )
         mm[lo:hi] = rows
         mm.flush()
@@ -427,7 +427,7 @@ def import_batch(batch: SeriesBatch, name: str,
     for f in fields:
         path = os.path.join(dset_dir, f"{f}.npy")
         _prealloc_column(path, cols[f].shape)
-        mm = np.lib.format.open_memmap(path, mode="r+")
+        mm = open_memmap(path, mode="r+")
         mm[:] = cols[f]
         mm.flush()
         del mm
@@ -568,7 +568,7 @@ def verify_shard(dset_dir: str, lo: int, hi: int) -> bool:
     for f, want in crcs.items():
         path = os.path.join(dset_dir, f"{f}.npy")
         try:
-            mm = np.load(path, mmap_mode="r")
+            mm = attach_array(path)
         except (OSError, ValueError):
             return False
         got = zlib.crc32(np.ascontiguousarray(mm[lo:hi]).tobytes())
@@ -757,9 +757,7 @@ def _apply_patch(dset_dir: str, n_timesteps: int, patch: Dict,
         return 0
     t0 = n_timesteps - w
     for f, vals in (("y", y_vals), ("mask", m_vals)):
-        mm = np.lib.format.open_memmap(
-            os.path.join(dset_dir, f"{f}.npy"), mode="r+"
-        )
+        mm = open_memmap(os.path.join(dset_dir, f"{f}.npy"), mode="r+")
         mm[rows, t0:] = vals
         mm.flush()
         del mm
@@ -787,7 +785,7 @@ def _reland_sentinel_from_disk(dset_dir: str, lo: int, hi: int) -> None:
     rec = read_spec(dset_dir) or {}
     cols = {}
     for f in rec.get("fields") or ("mask", "y"):
-        mm = np.load(os.path.join(dset_dir, f"{f}.npy"), mmap_mode="r")
+        mm = attach_array(os.path.join(dset_dir, f"{f}.npy"))
         cols[f] = np.ascontiguousarray(mm[lo:hi])
         del mm
     _land_shard_sentinel(dset_dir, lo, hi, cols)
@@ -825,6 +823,10 @@ def land_delta(data_dir: str, rows, y_tail,
     never claimable, the permanent-staleness failure mode)."""
     import fcntl
 
+    # Degradation-ladder backpressure: below the pause-ingest headroom
+    # threshold a lander fails fast (BackpressureError) instead of
+    # racing the reaper for the last bytes on the device.
+    gate_ingest(data_dir)
     rec = read_spec(data_dir)
     if rec is None:
         raise ValueError(f"{data_dir} is not a plane dataset")
@@ -919,7 +921,7 @@ def land_synthetic_delta(data_dir: str, frac: float,
         if k == 0:
             raise ValueError("frac too small: no series would advance")
         rows = np.sort(rng.choice(n, size=min(k, n), replace=False))
-    y_mm = np.load(os.path.join(data_dir, "y.npy"), mmap_mode="r")
+    y_mm = attach_array(os.path.join(data_dir, "y.npy"))
     cur = np.asarray(y_mm[rows, t_len - w:], np.float32)
     del y_mm
     drift = rng.normal(0.0, 0.05, cur.shape).astype(np.float32)
@@ -943,8 +945,12 @@ def repair(spec: DatasetSpec, root: Optional[str] = None,
         bad.append((lo, hi))
         try:
             os.remove(os.path.join(dset_dir, _MANIFEST_FILE))
-        except OSError:
-            pass
+        except OSError as e:
+            # No manifest to drop is the common case; a disk refusing
+            # the unlink must not let a corrupt dataset keep its
+            # warm-hit marker silently.
+            if not is_missing(e):
+                reraise_classified(e)
         write_shard(spec, i, root)
     if bad and not missing_shards(spec, root):
         finalize(spec, root)
@@ -961,8 +967,8 @@ def open_batch(dset_dir: str, mmap: bool = True) -> SeriesBatch:
         )
     rec = read_spec(dset_dir) or {}
     mode = "r" if mmap else None
-    load = lambda f: np.load(os.path.join(dset_dir, f"{f}.npy"),
-                             mmap_mode=mode)
+    load = lambda f: attach_array(os.path.join(dset_dir, f"{f}.npy"),
+                                  mmap_mode=mode)
     fields = rec.get("fields") or ["mask", "y"]
     ids = rec.get("series_ids")
     if ids is None:
@@ -1000,8 +1006,10 @@ def sweep_stale_datasets(root: Optional[str] = None,
     removed = 0
     try:
         entries = [os.path.join(root, n) for n in os.listdir(root)]
-    except OSError:
-        return 0
+    except OSError as e:
+        if is_missing(e):
+            return 0  # no cache root yet: nothing to sweep
+        reraise_classified(e)
     now = time.time()
     for d in entries:
         if not os.path.isdir(d):
@@ -1012,8 +1020,10 @@ def sweep_stale_datasets(root: Optional[str] = None,
                  glob.glob(os.path.join(d, "**"), recursive=True)),
                 default=os.path.getmtime(d),
             )
-        except OSError:
-            continue
+        except OSError as e:
+            if is_missing(e):
+                continue  # a racer removed the dir mid-scan
+            reraise_classified(e)
         if now - newest > max_age_s:
             shutil.rmtree(d, ignore_errors=True)
             removed += 1

@@ -1,0 +1,236 @@
+"""THE durable-I/O choke point: every plane, registry, chunk, plan, and
+patch writer routes here.
+
+This wraps ``tsspark_tpu.utils.atomic``'s write-temp-then-rename idiom
+with the three things a raw helper cannot give:
+
+  * **Named fault injection** — ``io_write`` / ``io_fsync`` /
+    ``io_rename`` / ``io_link`` / ``io_mmap`` points from
+    ``resilience/faults.py``, so ENOSPC, EIO, short writes, lost
+    fsyncs, and slow media are injectable ONCE, for every artifact
+    family, past and future.  Storage rules may be path-scoped
+    (``path="manifest.json"``) to aim at one family.
+  * **Typed error classification** — real disk failures surface as
+    ``tsspark_tpu.io.errors`` subclasses (still ``OSError``s), never
+    masquerading as missing files.
+  * **Accounting** — ``io.*`` latency/byte metrics, and the
+    environment-armed ``DiskBudget`` consulted before every
+    version-producing write under its root.
+
+The wrappers keep the exact NAMES of the ``utils.atomic`` helpers
+(``atomic_write``, ``atomic_write_text``, ``append_line``,
+``sweep_stale_temps``) so the ``fileproto`` static checker's
+atomic-helper recognition holds at every call site unchanged.
+
+Unlike the raw helper, ``atomic_write`` here fsyncs the temp before the
+rename — the publish is a real durability barrier, and the ``io_fsync``
+point sits exactly where a lost fsync would bite.
+"""
+
+from __future__ import annotations
+
+import errno as _errno
+import os
+import shutil
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from tsspark_tpu.io import budget as _budget
+from tsspark_tpu.io.errors import classify_os_error
+from tsspark_tpu.resilience import faults
+from tsspark_tpu.utils.atomic import (  # noqa: F401  (re-exported)
+    STALE_TEMP_S,
+    _tmp_path,
+    sweep_stale_temps,
+)
+from tsspark_tpu.utils.atomic import append_line as _raw_append_line
+
+#: Named injection points (see resilience/faults.py docstring).
+IO_WRITE = "io_write"
+IO_FSYNC = "io_fsync"
+IO_RENAME = "io_rename"
+IO_LINK = "io_link"
+IO_MMAP = "io_mmap"
+
+#: errnos where a hardlink legitimately degrades to a copy (filesystem
+#: capability, not media failure) — anything else must propagate, or an
+#: injected EIO would be silently healed by the fallback.
+_LINK_FALLBACK_ERRNOS = frozenset(
+    getattr(_errno, name)
+    for name in ("EXDEV", "EPERM", "EMLINK", "EOPNOTSUPP", "ENOTSUP")
+    if hasattr(_errno, name)
+)
+
+_m = {"init": False}
+
+
+def _metrics():
+    """Lazy ``io.*`` instrument cache (obs must never break I/O)."""
+    if not _m["init"]:
+        try:
+            from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+            _m["writes"] = METRICS.counter("tsspark_io_writes_total")
+            _m["bytes"] = METRICS.counter("tsspark_io_write_bytes_total")
+            _m["write_s"] = METRICS.histogram("tsspark_io_write_seconds")
+            _m["fsync_s"] = METRICS.histogram("tsspark_io_fsync_seconds")
+        except Exception:
+            _m["writes"] = _m["bytes"] = None
+            _m["write_s"] = _m["fsync_s"] = None
+        _m["init"] = True
+    return _m
+
+
+def _reraise_classified(e: OSError) -> None:
+    """Re-raise ``e`` as its typed storage subclass (or as-is when it
+    needs no mapping).  Call only from an ``except OSError`` block."""
+    ce = classify_os_error(e)
+    if ce is e:
+        raise
+    raise ce from e
+
+
+def _gate_budget(path: str) -> None:
+    """Consult the environment-armed ``DiskBudget`` before a
+    version-producing write under its root."""
+    b = _budget.active()
+    if b is not None and b.governs(path):
+        b.check(0, what=os.path.basename(path))
+
+
+def atomic_write(path: str, write_fn: Callable, mode: str = "wb", *,
+                 lo: Optional[int] = None,
+                 hi: Optional[int] = None) -> None:
+    """Durable atomic publish of ``path``: budget gate, temp write,
+    fsync barrier, rename — each step a named fault point.  Same
+    contract as ``utils.atomic.atomic_write`` plus durability and
+    classified errors; ``lo``/``hi`` scope series-targeted fault rules
+    exactly as at the fit points."""
+    t0 = time.perf_counter()
+    tmp = _tmp_path(path)
+    nbytes = 0
+    try:
+        try:
+            _gate_budget(path)
+            faults.inject(IO_WRITE, lo=lo, hi=hi, path=path)
+            with open(tmp, mode) as fh:
+                write_fn(fh)
+                fh.flush()
+                frac = faults.short_write(IO_WRITE, path, lo=lo, hi=hi)
+                if frac is not None:
+                    # The torn artifact still publishes: an unchecked
+                    # write(2) return looks exactly like success, and
+                    # only the CRC-sentinel read path may catch it.
+                    fh.truncate(max(0, int(fh.tell() * frac)))
+                t1 = time.perf_counter()
+                faults.inject(IO_FSYNC, lo=lo, hi=hi, path=path)
+                os.fsync(fh.fileno())
+                m = _metrics()
+                if m["fsync_s"] is not None:
+                    m["fsync_s"].observe(time.perf_counter() - t1)
+            nbytes = os.path.getsize(tmp)
+            faults.inject(IO_RENAME, lo=lo, hi=hi, path=path)
+            faults.lost_fsync(IO_FSYNC, path, lo=lo, hi=hi)
+            os.replace(tmp, path)
+        except OSError as e:
+            _reraise_classified(e)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    m = _metrics()
+    if m["writes"] is not None:
+        m["writes"].inc()
+        m["bytes"].inc(nbytes)
+        m["write_s"].observe(time.perf_counter() - t0)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Durable atomic text-file write (sentinels, fingerprints,
+    manifests)."""
+    atomic_write(path, lambda fh: fh.write(text), mode="w")
+
+
+def append_line(path: str, line: str) -> None:
+    """Crash-safe single-``os.write`` append (same contract as
+    ``utils.atomic.append_line``) behind the ``io_write`` fault point
+    and classified errors."""
+    try:
+        faults.inject(IO_WRITE, path=path)
+        _raw_append_line(path, line)
+    except OSError as e:
+        _reraise_classified(e)
+
+
+def hardlink(src: str, dst: str) -> None:
+    """``os.link`` behind the ``io_link`` fault point; classified
+    errors."""
+    try:
+        faults.inject(IO_LINK, path=dst)
+        os.link(src, dst)
+    except OSError as e:
+        _reraise_classified(e)
+
+
+def link_or_copy(src: str, dst: str) -> None:
+    """Hardlink ``src`` → ``dst``, degrading to a byte copy ONLY for
+    capability errnos (cross-device, no-hardlink filesystems).  Real
+    media failures — including injected ones — propagate; a copy
+    fallback that swallowed EIO would un-test the fault."""
+    try:
+        hardlink(src, dst)
+    except OSError as e:
+        if getattr(e, "errno", None) not in _LINK_FALLBACK_ERRNOS:
+            raise
+        try:
+            shutil.copy2(src, dst)
+        except OSError as e2:
+            _reraise_classified(e2)
+
+
+def open_memmap(path: str, *, mode: str = "r", dtype=None, shape=None,
+                lo: Optional[int] = None,
+                hi: Optional[int] = None):
+    """``np.lib.format.open_memmap`` behind the ``io_mmap`` fault point
+    (attach AND create flavors); classified errors."""
+    try:
+        faults.inject(IO_MMAP, lo=lo, hi=hi, path=path)
+        if mode in ("w+",):
+            _gate_budget(path)
+        if dtype is None and shape is None:
+            return np.lib.format.open_memmap(path, mode=mode)
+        return np.lib.format.open_memmap(
+            path, mode=mode, dtype=dtype, shape=shape)
+    except OSError as e:
+        _reraise_classified(e)
+
+
+def attach_array(path: str, *, mmap_mode: str = "r"):
+    """``np.load(..., mmap_mode=...)`` behind the ``io_mmap`` fault
+    point — the read-side attach every plane viewer uses."""
+    try:
+        faults.inject(IO_MMAP, path=path)
+        return np.load(path, mmap_mode=mmap_mode)
+    except OSError as e:
+        _reraise_classified(e)
+
+
+def fsync_dir(dirpath: str) -> None:
+    """Directory-entry durability barrier (publish-rename visibility on
+    a crash); best-effort on filesystems that refuse O_RDONLY dir
+    fsync."""
+    faults.inject(IO_FSYNC, path=dirpath)
+    try:
+        fd = os.open(dirpath, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

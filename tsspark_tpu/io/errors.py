@@ -1,0 +1,118 @@
+"""Typed storage errors: a failing disk must never look like a missing file.
+
+``data/plane.py`` historically swallowed ``OSError`` wholesale on its
+read/cleanup paths, which is correct for the ENOENT family (an absent
+artifact IS the protocol's "not landed yet" signal) but catastrophic for
+ENOSPC/EIO/EROFS — a dying disk silently degrades into "dataset looks
+empty, regenerate it".  Every durable-I/O site classifies through here:
+the ENOENT family stays a soft "missing", real media failures surface as
+typed subclasses callers can count, alert on, and feed the degradation
+ladder.
+
+All storage errors subclass ``OSError`` with the original errno
+preserved, so pre-existing ``except OSError`` handlers keep working —
+the classification ADDS information, it never changes reachability.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+
+#: errnos that mean "the artifact is not there" — the protocol-normal
+#: case every reader already treats as absence, never a disk failure.
+_MISSING_ERRNOS = frozenset({
+    errno.ENOENT, errno.ENOTDIR, errno.ESTALE,
+})
+
+
+class StorageError(OSError):
+    """A durable-I/O operation failed for a reason that is NOT absence:
+    the media, filesystem, or quota misbehaved."""
+
+
+class DiskFullError(StorageError):
+    """ENOSPC / EDQUOT: no space (or quota) left on the device."""
+
+
+class DiskIOError(StorageError):
+    """EIO: the device reported a hard I/O error."""
+
+
+class ReadOnlyError(StorageError):
+    """EROFS: the filesystem went read-only under us (the kernel's
+    last-resort response to a failing device)."""
+
+
+class ShortWriteError(StorageError):
+    """A write persisted fewer bytes than were handed to it and the
+    site detected the tear before publishing."""
+
+
+class BackpressureError(RuntimeError):
+    """The degradation ladder refused new ingest work: disk headroom is
+    below the pause threshold.  Deliberately NOT an ``OSError`` — this
+    is flow control, not a failure, and must never be swallowed by a
+    ``missing-file`` handler."""
+
+    def __init__(self, state: str, headroom: float):
+        super().__init__(
+            f"delta ingestion paused by degradation ladder "
+            f"(state={state}, headroom={headroom:.3f})"
+        )
+        self.state = state
+        self.headroom = headroom
+
+
+_ERRNO_CLASS = {
+    errno.ENOSPC: DiskFullError,
+    errno.EDQUOT: DiskFullError,
+    errno.EIO: DiskIOError,
+    errno.EROFS: ReadOnlyError,
+}
+
+
+def is_missing(e: BaseException) -> bool:
+    """True when ``e`` means "the file is not there" (protocol-normal
+    absence), False for everything else — in particular every real disk
+    failure."""
+    return (isinstance(e, OSError)
+            and e.errno in _MISSING_ERRNOS)
+
+
+def classify_os_error(e: OSError) -> OSError:
+    """Map an ``OSError`` to its typed storage subclass (ENOSPC →
+    ``DiskFullError``, EIO → ``DiskIOError``, EROFS →
+    ``ReadOnlyError``); anything else — including the ENOENT family —
+    comes back unchanged.  The returned error carries the original
+    errno and message, so ``except OSError`` and errno dispatch both
+    keep working."""
+    if isinstance(e, StorageError):
+        return e
+    cls = _ERRNO_CLASS.get(e.errno)
+    if cls is None:
+        return e
+    err = cls(e.errno, os.strerror(e.errno) if e.errno else str(e))
+    err.filename = getattr(e, "filename", None)
+    err.__cause__ = e
+    try:
+        # Real disk failures are COUNTED, not just raised — the alert
+        # surface a swallowed OSError never had.
+        from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+        METRICS.counter("tsspark_io_disk_errors_total").inc()
+        METRICS.counter(
+            f"tsspark_io_disk_error_{cls.__name__}_total").inc()
+    except Exception:
+        pass
+    return err
+
+
+def reraise_classified(e: OSError):
+    """Raise ``e`` as its typed storage subclass (or as itself when it
+    needs no mapping) — the one-liner every narrowed ``except OSError``
+    site ends with after handling the missing case."""
+    ce = classify_os_error(e)
+    if ce is e:
+        raise e
+    raise ce from e

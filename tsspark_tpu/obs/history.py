@@ -344,6 +344,10 @@ def _chaos_row(rep: Dict[str, Any]) -> Dict[str, Any]:
              if isinstance(v, dict) and not v.get("ok")))
     for cls, v in sorted((rep.get("mttr_s") or {}).items()):
         _put(m, f"mttr_{cls}", v)
+    # Storage fault-domain accounting (the report's ``io`` section):
+    # write/error/fault counters and budget/ladder gauges per storm.
+    for name, v in sorted((rep.get("io") or {}).items()):
+        _put(m, name.replace("tsspark_", ""), v)
     return {
         "kind": "chaos",
         "trace_id": rep.get("trace_id"),

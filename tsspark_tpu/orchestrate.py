@@ -74,7 +74,7 @@ from tsspark_tpu.resilience.report import (
     STATUS_QUARANTINED,
     attach_report,
 )
-from tsspark_tpu.utils.atomic import (
+from tsspark_tpu.io import (
     atomic_write,
     atomic_write_text,
     sweep_stale_temps,

@@ -1,0 +1,27 @@
+"""``tsspark_tpu.plane`` — the unified column-plane protocol library.
+
+One implementation of the spec-first / CRC-sentinel-last memmap plane
+protocol, extracted from its three historical copies (``data/plane.py``,
+``serve/snapplane.py``, the delta patch stream) and built on the
+durable-I/O layer (``tsspark_tpu.io``).  See ``plane.protocol`` and
+docs/ANALYSIS.md § unified ProtocolSpec.
+"""
+
+from tsspark_tpu.plane.protocol import (
+    attach_column,
+    link_or_copy,
+    publish_plane,
+    read_json,
+    shard_crcs,
+    shard_ranges,
+    verify_crcs,
+    write_column,
+    write_sentinel,
+    write_spec,
+)
+
+__all__ = [
+    "attach_column", "link_or_copy", "publish_plane", "read_json",
+    "shard_crcs", "shard_ranges", "verify_crcs", "write_column",
+    "write_sentinel", "write_spec",
+]

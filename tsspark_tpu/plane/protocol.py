@@ -1,0 +1,144 @@
+"""THE column-plane protocol library: spec-first, CRC-sentinel-last.
+
+The package grew the same memmap column-plane protocol three times —
+the data plane (``data/plane.py``), the snapshot plane
+(``serve/snapplane.py``), and the delta patch stream — each with its
+own copy of the shard math, the CRC helpers, the JSON probe, and the
+spec-first / payload / sentinel-LAST write order.  This module is the
+single implementation they all route through, built on the durable-I/O
+layer (``tsspark_tpu.io``) so every plane — past and future — inherits
+the same fault injection points, typed storage errors, and disk-budget
+gate.
+
+The write order is the protocol:
+
+* ``write_spec``     — the identity record, FIRST.  A reader finding a
+  spec without its sentinel treats the plane as absent/in-progress.
+* ``write_column``   — one atomic ``.npy`` per column (payload).
+  Column bytes are invisible until the sentinel certifies them.
+* ``write_sentinel`` — the CRC sentinel, LAST: the unit of visibility.
+  A reader trusts nothing this sentinel does not cover, so a torn or
+  short-written column is rejected at attach, never served.
+
+``publish_plane`` is the one generic writer emitting that order; the
+``plane-protocol`` :class:`~tsspark_tpu.analysis.protomodel.ProtocolSpec`
+verifies it statically (happens-before writer order + exhaustive
+kill-point sweep), so every caller of ``publish_plane`` inherits a
+machine-checked crash story.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tsspark_tpu.io import (
+    atomic_write,
+    attach_array,
+    is_missing,
+    link_or_copy,
+    reraise_classified,
+)
+
+__all__ = [
+    "shard_ranges", "shard_crcs", "read_json", "write_spec",
+    "write_column", "write_sentinel", "publish_plane", "verify_crcs",
+    "attach_column", "link_or_copy",
+]
+
+
+def shard_ranges(n: int, shard_rows: int) -> List[Tuple[int, int]]:
+    """Row ranges of the CRC shards: ``[lo, hi)`` windows of
+    ``shard_rows`` covering ``n`` rows.  Shards bound what one torn
+    write can hide behind a stale CRC and give the chaos harness a
+    named unit to tear."""
+    return [(lo, min(lo + shard_rows, n))
+            for lo in range(0, n, shard_rows)]
+
+
+def shard_crcs(cols: Dict[str, np.ndarray],
+               lo: Optional[int] = None,
+               hi: Optional[int] = None) -> Dict[str, int]:
+    """Per-column CRC32 over rows ``[lo, hi)`` (whole columns when no
+    range is given) — the sentinel's payload and the attach-time
+    verifier's recomputation, one definition for both sides."""
+    if lo is None:
+        return {k: zlib.crc32(np.ascontiguousarray(a).tobytes())
+                for k, a in cols.items()}
+    return {k: zlib.crc32(np.ascontiguousarray(a[lo:hi]).tobytes())
+            for k, a in cols.items()}
+
+
+def read_json(path: str) -> Optional[Dict]:
+    """Probe a JSON protocol record: a dict, or None when the file is
+    absent or torn (protocol-normal).  A real disk failure (EIO, EROFS)
+    raises its typed storage error instead of reading as absence — the
+    narrow-except discipline of the storage fault domain."""
+    try:
+        with open(path) as fh:
+            d = json.load(fh)
+        return d if isinstance(d, dict) else None
+    except ValueError:
+        return None  # torn/partial JSON: never landed, reads as absent
+    except OSError as e:
+        if is_missing(e):
+            return None
+        reraise_classified(e)
+
+
+def write_spec(path: str, spec: Dict) -> None:
+    """Land a plane's identity record (step 1: spec FIRST)."""
+    atomic_write(path, lambda fh: json.dump(spec, fh, indent=1),
+                 mode="w")
+
+
+def write_column(path: str, arr: np.ndarray, *,
+                 lo: Optional[int] = None,
+                 hi: Optional[int] = None) -> None:
+    """Land one column payload atomically (step 2).  ``lo``/``hi``
+    scope series-targeted fault rules to the rows this column carries."""
+    atomic_write(path, lambda fh: np.save(fh, arr), lo=lo, hi=hi)
+
+
+def write_sentinel(path: str, sentinel: Dict) -> None:
+    """Land the CRC sentinel (step 3: the gate, LAST — its presence is
+    the unit of visibility for everything it certifies)."""
+    atomic_write(path, lambda fh: json.dump(sentinel, fh), mode="w")
+
+
+def publish_plane(dirpath: str, spec_name: str, spec: Dict,
+                  columns: Dict[str, np.ndarray],
+                  col_path: Callable[[str, str], str],
+                  sentinel_name: str, sentinel: Dict) -> None:
+    """The generic plane publish: spec first, every column payload,
+    CRC sentinel LAST.  The ``plane-protocol`` ProtocolSpec statically
+    verifies this writer's order and kill-points — a crash after any
+    prefix leaves the plane invisible (no sentinel) or complete."""
+    write_spec(os.path.join(dirpath, spec_name), spec)
+    for name, arr in columns.items():
+        write_column(col_path(dirpath, name), arr)
+    write_sentinel(os.path.join(dirpath, sentinel_name), sentinel)
+
+
+def verify_crcs(cols: Dict[str, np.ndarray],
+                shards) -> Optional[Tuple[str, int, int]]:
+    """Recompute every shard CRC against the sentinel's records.
+    Returns None when all match, else ``(column, lo, hi)`` of the first
+    mismatch — a torn, short-written, or silently corrupted column."""
+    for entry in shards or ():
+        lo, hi, crcs = int(entry[0]), int(entry[1]), entry[2]
+        got = shard_crcs(cols, lo, hi)
+        for name, want in crcs.items():
+            if got.get(name) != int(want):
+                return (name, lo, hi)
+    return None
+
+
+def attach_column(path: str):
+    """Attach one column as a read-only memmap (via the durable-I/O
+    layer's ``io_mmap`` fault point)."""
+    return attach_array(path, mmap_mode="r")

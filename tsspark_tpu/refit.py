@@ -61,7 +61,7 @@ import numpy as np
 
 from tsspark_tpu import orchestrate
 from tsspark_tpu.obs import context as obs
-from tsspark_tpu.utils.atomic import atomic_write
+from tsspark_tpu.io import atomic_write
 
 #: The cycle's pinned plan: base version, coverage stamps, the changed
 #: row set — replaced atomically, so a successor after a mid-cycle kill

@@ -60,7 +60,7 @@ from typing import Callable, Optional
 from tsspark_tpu import orchestrate
 from tsspark_tpu.obs import context as obs
 from tsspark_tpu.resilience import faults, integrity
-from tsspark_tpu.utils.atomic import (
+from tsspark_tpu.io import (
     atomic_write,
     atomic_write_text,
     sweep_stale_temps,

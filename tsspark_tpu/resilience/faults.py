@@ -26,6 +26,34 @@ Named points currently wired (see docs/RESILIENCE.md):
   prep_save         after save_prep_atomic's rename        (corrupt)
   backend_fit       TpuBackend.fit entry                   (raise)
   stream_poll       streaming source poll                  (raise)
+  io_write          tsspark_tpu.io payload write           (enospc/eio/
+                                                            shortwrite/sleep)
+  io_rename         tsspark_tpu.io publish rename          (enospc/eio)
+  io_fsync          tsspark_tpu.io durability barrier      (lost_fsync/eio)
+  io_link           tsspark_tpu.io hardlink copy-forward   (enospc/eio)
+  io_mmap           tsspark_tpu.io memmap attach           (eio/sleep)
+
+Storage modes (the disk misbehaving, not the process):
+
+  "enospc"/"eio"  — ``inject`` raises ``OSError`` with the real errno so
+                    the site's error classification is exercised, not a
+                    lookalike exception.
+  "shortwrite"    — ``short_write`` returns a fraction; the durable-I/O
+                    layer truncates the payload it just wrote to that
+                    fraction and then REPORTS SUCCESS, the way an
+                    unchecked ``write(2)`` return tears a file.  The
+                    CRC-sentinel protocol must catch it at read time.
+  "lost_fsync"    — ``lost_fsync`` snapshots the target's PRE-write
+                    state; the write proceeds and the caller sees
+                    success, but the next ``exit``-mode firing in the
+                    same plan rolls the file back before dying — the
+                    rename lived in the page cache and the crash lost
+                    it.  Replay rides the same deterministic
+                    call-window machinery as every other rule.
+
+Rules may carry ``path=<substring>`` to scope a storage rule to one
+artifact family (e.g. ``path="manifest.json"`` fires only on registry
+manifest renames) — the io layer passes every call's target path.
 
 Production safety: with ``TSSPARK_FAULTS`` unset, ``inject`` is a single
 dict lookup returning immediately.
@@ -33,15 +61,27 @@ dict lookup returning immediately.
 
 from __future__ import annotations
 
+import errno as _errno
 import json
 import os
+import shutil
 import tempfile
 import time
 from typing import Dict, List, Optional
 
 ENV_VAR = "TSSPARK_FAULTS"
 
-_MODES = ("raise", "exit", "flag", "corrupt", "sleep")
+_MODES = ("raise", "exit", "flag", "corrupt", "sleep",
+          "enospc", "eio", "shortwrite", "lost_fsync")
+
+# Modes that never fire from the generic ``inject`` gate: each has a
+# dedicated hook (``corrupt_file``, ``short_write``, ``lost_fsync``)
+# because firing needs the artifact path, not just the point name.
+_HOOK_MODES = ("corrupt", "shortwrite", "lost_fsync")
+
+# Subdirectory of the plan's state_dir holding lost-fsync rollback
+# records (pre-write snapshots awaiting replay at the next kill point).
+_LOST_DIR = "lostfsync"
 
 # Guard against a runaway call counter chewing the state dir: no test
 # plan legitimately sees this many calls at one point.
@@ -97,19 +137,27 @@ class FaultPlan:
     def fail(self, point: str, *, attempts: int = 1, after: int = 0,
              mode: str = "raise", series: Optional[int] = None,
              rc: int = 23, delay_s: float = 0.5,
-             tag: Optional[str] = None) -> "FaultPlan":
+             tag: Optional[str] = None, path: Optional[str] = None,
+             fraction: float = 0.5) -> "FaultPlan":
         """``tag``: free-form class label stamped onto the observability
         event a firing emits (the chaos storm tags rules with their
-        fault class so MTTR is readable off the span ledger)."""
+        fault class so MTTR is readable off the span ledger).
+        ``path``: substring scope — the rule only matches calls whose
+        target path contains it (storage rules aim at one artifact
+        family this way).  ``fraction``: surviving fraction of the
+        payload for ``shortwrite`` mode."""
         if mode not in _MODES:
             raise ValueError(f"mode {mode!r} not in {_MODES}")
         if attempts < 1 or after < 0:
             raise ValueError("attempts must be >= 1 and after >= 0")
+        if not (0.0 <= fraction < 1.0):
+            raise ValueError("fraction must be in [0, 1)")
         self.rules.append({
             "id": f"r{len(self.rules)}_{point}",
             "point": point, "attempts": int(attempts), "after": int(after),
             "mode": mode, "series": series, "rc": int(rc),
-            "delay_s": float(delay_s), "tag": tag,
+            "delay_s": float(delay_s), "tag": tag, "path": path,
+            "fraction": float(fraction),
         })
         return self
 
@@ -155,6 +203,15 @@ def _matches(rule: dict, lo: Optional[int], hi: Optional[int]) -> bool:
     return lo <= s < (hi if hi is not None else lo + 1)
 
 
+def _matches_path(rule: dict, path: Optional[str]) -> bool:
+    scope = rule.get("path")
+    if scope is None:
+        return True
+    if path is None:
+        return False  # path-scoped rule at a pathless call site
+    return scope in os.path.abspath(path)
+
+
 def _claim_call(state_dir: str, rule: dict) -> Optional[int]:
     """Atomically claim this call's global 0-based sequence number for
     ``rule`` (cross-process: first O_CREAT|O_EXCL success wins a slot)."""
@@ -182,7 +239,8 @@ def _armed_call(rule: dict, state_dir: str,
 
 
 def _obs_fault(rule: dict, point: str,
-               lo: Optional[int], hi: Optional[int]) -> None:
+               lo: Optional[int], hi: Optional[int],
+               path: Optional[str] = None) -> None:
     """Span-ledger annotation for one firing: the moment a fault was
     injected becomes readable off the trace (MTTR from spans), not just
     off the claim files' mtimes.  Best-effort; never breaks the site."""
@@ -193,34 +251,71 @@ def _obs_fault(rule: dict, point: str,
                  "mode": rule["mode"], "tag": rule.get("tag")}
         if lo is not None:
             attrs["lo"], attrs["hi"] = lo, hi
+        if path is not None:
+            attrs["path"] = os.path.basename(path)
         obs.event("fault", **attrs)
     except Exception:
         pass
 
 
+def _count_fault_metric(point: str, mode: str) -> None:
+    """Best-effort ``io.*`` accounting of fired faults (chaos reports
+    and RUNHISTORY read these)."""
+    try:
+        from tsspark_tpu.obs.metrics import DEFAULT as METRICS
+
+        METRICS.counter("tsspark_io_faults_fired_total").inc()
+        METRICS.counter(f"tsspark_io_fault_{mode}_total").inc()
+    except Exception:
+        pass
+
+
 def inject(point: str, *, lo: Optional[int] = None,
-           hi: Optional[int] = None) -> bool:
+           hi: Optional[int] = None,
+           path: Optional[str] = None) -> bool:
     """Fault injection point.  No-op (False) unless a plan arms ``point``.
 
     ``lo``/``hi``: the series range this call is operating on, matched
-    against series-targeted rules.  Returns True when a "flag"-mode rule
-    fires (the caller fails soft); "raise" raises ``FaultInjected``;
-    "exit" kills the process like a real worker death.
+    against series-targeted rules.  ``path``: the artifact path the call
+    targets (io-layer sites pass it; path-scoped rules need it to
+    match).  Returns True when a "flag"-mode rule fires (the caller
+    fails soft); "raise" raises ``FaultInjected``; "exit" kills the
+    process like a real worker death; "enospc"/"eio" raise ``OSError``
+    with the real errno so the site's disk-failure classification runs.
     """
     plan = _active_plan()
     if plan is None:
         return False
     flagged = False
     for rule in plan.rules:
-        if rule["point"] != point or rule["mode"] == "corrupt":
+        if rule["point"] != point or rule["mode"] in _HOOK_MODES:
+            continue
+        if not _matches_path(rule, path):
             continue
         if not _armed_call(rule, plan.state_dir, lo, hi):
             continue
-        _obs_fault(rule, point, lo, hi)
+        _obs_fault(rule, point, lo, hi, path)
+        _count_fault_metric(point, rule["mode"])
         if rule["mode"] == "exit":
+            # A kill point is where un-fsynced renames die with the
+            # process: replay any recorded lost-fsync rollbacks first so
+            # the survivor observes the pre-crash on-disk truth.
+            _replay_lost_fsyncs(plan.state_dir)
             os._exit(rule["rc"])
         if rule["mode"] == "raise":
             raise FaultInjected(point, rule["id"])
+        if rule["mode"] == "enospc":
+            raise OSError(
+                _errno.ENOSPC,
+                f"injected ENOSPC at {point!r} (rule {rule['id']}); "
+                f"deliberate — a FaultPlan armed this point",
+            )
+        if rule["mode"] == "eio":
+            raise OSError(
+                _errno.EIO,
+                f"injected EIO at {point!r} (rule {rule['id']}); "
+                f"deliberate — a FaultPlan armed this point",
+            )
         if rule["mode"] == "sleep":
             # A stall, not a failure: the call proceeds after the delay
             # (and the site is NOT flagged), so the only observable
@@ -244,9 +339,11 @@ def corrupt_file(point: str, path: str, *, lo: Optional[int] = None,
     for rule in plan.rules:
         if rule["point"] != point or rule["mode"] != "corrupt":
             continue
+        if not _matches_path(rule, path):
+            continue
         if not _armed_call(rule, plan.state_dir, lo, hi):
             continue
-        _obs_fault(rule, point, lo, hi)
+        _obs_fault(rule, point, lo, hi, path)
         try:
             size = os.path.getsize(path)
             with open(path, "r+b") as fh:
@@ -264,3 +361,123 @@ def corrupt_file(point: str, path: str, *, lo: Optional[int] = None,
         except OSError:
             pass
     return hit
+
+
+def short_write(point: str, path: str, *, lo: Optional[int] = None,
+                hi: Optional[int] = None) -> Optional[float]:
+    """Short-write injection point: when a "shortwrite"-mode rule at
+    ``point`` fires, return the fraction of the payload that should
+    survive.  The durable-I/O layer truncates the temp it just filled to
+    that fraction and then completes the publish normally — the torn
+    artifact lands in place exactly as an unchecked ``write(2)`` return
+    would leave it, and only the CRC-sentinel read path can catch it.
+    Returns None when nothing fired."""
+    plan = _active_plan()
+    if plan is None:
+        return None
+    for rule in plan.rules:
+        if rule["point"] != point or rule["mode"] != "shortwrite":
+            continue
+        if not _matches_path(rule, path):
+            continue
+        if not _armed_call(rule, plan.state_dir, lo, hi):
+            continue
+        _obs_fault(rule, point, lo, hi, path)
+        _count_fault_metric(point, "shortwrite")
+        return float(rule.get("fraction", 0.5))
+    return None
+
+
+def lost_fsync(point: str, path: str, *, lo: Optional[int] = None,
+               hi: Optional[int] = None) -> bool:
+    """Lost-fsync injection point, called by the durable-I/O layer just
+    BEFORE it renames a finished temp over ``path``.  When a
+    "lost_fsync"-mode rule fires, the target's current (pre-write) state
+    — its bytes, or the fact it did not exist — is snapshotted into the
+    plan's state dir.  The write then proceeds and the caller sees
+    success; the snapshot is replayed (file rolled back) by the next
+    ``exit``-mode firing in the same plan, before ``os._exit``.  That is
+    the real failure being modeled: the rename was only in the page
+    cache, and the crash lost it while the process kept running as if it
+    were durable.  Returns True when a snapshot was recorded."""
+    plan = _active_plan()
+    if plan is None:
+        return False
+    hit = False
+    for rule in plan.rules:
+        if rule["point"] != point or rule["mode"] != "lost_fsync":
+            continue
+        if not _matches_path(rule, path):
+            continue
+        if not _armed_call(rule, plan.state_dir, lo, hi):
+            continue
+        _obs_fault(rule, point, lo, hi, path)
+        _count_fault_metric(point, "lost_fsync")
+        try:
+            _record_lost_fsync(plan.state_dir, path)
+            hit = True
+        except OSError:
+            pass  # unwritable state dir: fail open, like _claim_call
+    return hit
+
+
+def _record_lost_fsync(state_dir: str, path: str) -> None:
+    """Snapshot ``path``'s pre-write state for later rollback.  Slot
+    allocation reuses the O_CREAT|O_EXCL idiom so concurrent processes
+    recording at once never clobber each other's record."""
+    d = os.path.join(state_dir, _LOST_DIR)
+    os.makedirs(d, exist_ok=True)
+    target = os.path.abspath(path)
+    for n in range(_MAX_CALLS):
+        rec_path = os.path.join(d, f"rec.{n}.json")
+        try:
+            fd = os.open(rec_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            continue
+        existed = os.path.exists(target)
+        if existed:
+            shutil.copy2(target, rec_path + ".bak")
+        rec = {"path": target, "existed": existed}
+        try:
+            os.write(fd, json.dumps(rec).encode())
+        finally:
+            os.close(fd)
+        return
+    raise OSError("lost-fsync record slots exhausted")
+
+
+def _replay_lost_fsyncs(state_dir: str) -> int:
+    """Roll back every recorded-but-unreplayed lost fsync: restore the
+    pre-write bytes (or remove the file that 'never landed').  Each
+    record is consumed by renaming it to ``.done`` first — the claim is
+    atomic, so two kill points racing the replay apply it once.  Returns
+    the number of files rolled back."""
+    d = os.path.join(state_dir, _LOST_DIR)
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return 0
+    replayed = 0
+    for name in names:
+        if not (name.startswith("rec.") and name.endswith(".json")):
+            continue
+        rec_path = os.path.join(d, name)
+        done_path = rec_path + ".done"
+        try:
+            os.rename(rec_path, done_path)
+        except OSError:
+            continue  # another process claimed this record
+        try:
+            with open(done_path) as fh:
+                rec = json.load(fh)
+            if rec.get("existed"):
+                shutil.copy2(rec_path + ".bak", rec["path"])
+            else:
+                try:
+                    os.remove(rec["path"])
+                except FileNotFoundError:
+                    pass
+            replayed += 1
+        except (OSError, ValueError, KeyError):
+            continue  # torn record: skip, never break the kill path
+    return replayed

@@ -72,7 +72,12 @@ from tsspark_tpu import refit
 from tsspark_tpu.obs import context as obs
 from tsspark_tpu.obs.metrics import DEFAULT as METRICS
 from tsspark_tpu.resilience import faults
-from tsspark_tpu.utils.atomic import atomic_write
+from tsspark_tpu.io import (
+    StorageError,
+    active_ladder,
+    atomic_write,
+    current_state,
+)
 
 #: Advisory scheduler telemetry (cycles, freshness summary, backoff
 #: state) — replaced atomically after every cycle so ``obs watch`` and
@@ -356,6 +361,7 @@ class RefitScheduler:
             "wrong_version": self.wrong_version,
             "probe_failures": self.probe_failures,
             "pipeline": self.pipeline,
+            "disk_ladder": current_state(self.scratch),
             "ok": self._fail_streak == 0,
         }
         self._write_sched_state(summary)
@@ -473,6 +479,14 @@ class RefitScheduler:
                 return
             self._idle_tick()
             return
+        lad = active_ladder(self.scratch)
+        if lad is not None and not lad.allows("ingest"):
+            # Ladder rung 3 (pause_ingest): the cycle's spill + fit
+            # would grow scratch at the worst possible moment.  New
+            # deltas stay pending (freshness pays, by design); the
+            # idle tick keeps reaping, and relief resumes intake.
+            self._idle_tick()
+            return
         if self.debounce_s > 0:
             # Debounce: let a landing burst settle so one cycle owns
             # the whole batch instead of one cycle per delta.
@@ -488,10 +502,20 @@ class RefitScheduler:
 
         cache = None
         if plan["n_changed"]:
-            # Overlapped stages: spill + warm-cache merge are mmap
-            # reads; cycle N's publish may still be running.
-            refit.ensure_spill(self.data_dir, plan, self.scratch)
-            cache = self._warm_cache_for(plan)
+            try:
+                # Overlapped stages: spill + warm-cache merge are mmap
+                # reads; cycle N's publish may still be running.
+                refit.ensure_spill(self.data_dir, plan, self.scratch)
+                cache = self._warm_cache_for(plan)
+            except StorageError:
+                # A typed disk refusal (budget tripped between the
+                # ladder gate and the spill, or a real ENOSPC/EIO) is
+                # a cycle failure like any other: back off and retry —
+                # the draft is idempotent — instead of crashing the
+                # daemon.
+                self._busy.append((t_work0, time.time()))
+                self._note_failure("storage")
+                return
         if not self._join_publisher(block=True):
             self._busy.append((t_work0, time.time()))
             self._note_failure("publish")
@@ -549,8 +573,20 @@ class RefitScheduler:
             self._last_reprobe = time.monotonic()
             self._after_publish(int(self._head_version),
                                 int(self._head_stamp or 0))
+        lad = active_ladder(self.scratch)
+        if lad is not None and lad.should_reap():
+            # Ladder rung 2 (reap): shrinking headroom — drop retained
+            # cycle history down to the safety floor NOW instead of at
+            # the next publish, sparing the in-flight plan's dir (its
+            # spill is the publisher's input).
+            keep = ()
+            if self._inflight is not None:
+                keep = (refit.cycle_paths(self.scratch,
+                                          self._inflight[0])[0],)
+            refit.reap_cycles(self.scratch, keep=keep)
         if (self.speculate and self._pub_thread is None
                 and self.warm_start
+                and (lad is None or lad.allows("speculate"))
                 and time.monotonic() - self._last_spec
                 >= self.spec_refresh_s):
             self._last_spec = time.monotonic()
@@ -818,15 +854,23 @@ class RefitScheduler:
             "pending_deltas": len(self._pending),
             "freshness": self.freshness_summary(),
             "spec": self.spec_summary(),
+            "disk_ladder": current_state(self.scratch),
         }
         if summary is not None:
             state["last_summary"] = {
                 k: v for k, v in summary.items() if k != "kind"
             }
-        atomic_write(
-            os.path.join(self.scratch, SCHED_STATE_FILE),
-            lambda fh: json.dump(state, fh, indent=1), mode="w",
-        )
+        try:
+            atomic_write(
+                os.path.join(self.scratch, SCHED_STATE_FILE),
+                lambda fh: json.dump(state, fh, indent=1), mode="w",
+            )
+        except StorageError:
+            # Advisory observability, never fatal: under an exhausted
+            # budget the daemon must keep running its ladder (reap,
+            # pause) rather than die writing the file that REPORTS the
+            # pressure.
+            pass
 
 
 def read_sched_state(scratch: str) -> Optional[Dict]:

@@ -130,7 +130,7 @@ def _report_identity(registry) -> dict:
 
 def _write_report(report, args) -> str:
     """Persist the SERVE report atomically; returns its path."""
-    from tsspark_tpu.utils.atomic import atomic_write
+    from tsspark_tpu.io import atomic_write
 
     out = args.report or f"SERVE_{int(time.time())}.json"
     atomic_write(out, lambda fh: json.dump(report, fh, indent=1),

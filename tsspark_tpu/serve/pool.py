@@ -69,7 +69,7 @@ from tsspark_tpu.obs import context as obs
 from tsspark_tpu.obs.metrics import DEFAULT as METRICS
 from tsspark_tpu.resilience.policy import CircuitBreaker, RetryPolicy
 from tsspark_tpu.serve.engine import ServeError
-from tsspark_tpu.utils.atomic import atomic_write
+from tsspark_tpu.io import atomic_write, current_state, stale_serving
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))
@@ -1070,7 +1070,15 @@ class ReplicaPool:
         }
         if deadline_ms is not None:
             payload["deadline_ms"] = float(deadline_ms)
-        return self._route(payload)
+        resp = self._route(payload)
+        if isinstance(resp, dict) and stale_serving(self.registry_root):
+            # Ladder rung 4 (stale_serve): the registry root is out of
+            # disk, refits are paused, and this answer may be computed
+            # from a version older than the landed data.  Keep serving
+            # — recency honesty beats an outage — but say so.
+            resp["stale"] = True
+            resp["disk_ladder"] = current_state(self.registry_root)
+        return resp
 
     def _route(self, payload: Dict,
                skip_slot: Optional[int] = None) -> Dict:
@@ -1330,6 +1338,8 @@ class ReplicaPool:
             "fenced_seen": self.fenced_seen,
             "breakers": {str(k): i.breaker.snapshot()
                          for k, i in self.replicas.items()},
+            "disk_ladder": current_state(self.registry_root),
+            "stale_serve": stale_serving(self.registry_root),
             "replicas": per,
         }
 

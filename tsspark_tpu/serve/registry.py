@@ -46,7 +46,7 @@ from tsspark_tpu.obs import context as obs
 from tsspark_tpu.resilience import integrity
 from tsspark_tpu.serve import snapplane
 from tsspark_tpu.utils import checkpoint as ckpt
-from tsspark_tpu.utils.atomic import atomic_write, sweep_stale_temps
+from tsspark_tpu.io import atomic_write, sweep_stale_temps
 
 _MANIFEST = "manifest.json"
 _FORMAT = 1

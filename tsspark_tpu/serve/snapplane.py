@@ -50,14 +50,32 @@ import dataclasses
 import json
 import os
 import time
-import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Optional
 
 import numpy as np
 
+from tsspark_tpu.io import atomic_write, link_or_copy
 from tsspark_tpu.models.prophet.design import ScalingMeta
 from tsspark_tpu.models.prophet.model import FitState
-from tsspark_tpu.utils.atomic import atomic_write
+from tsspark_tpu.plane.protocol import (
+    attach_column,
+    publish_plane,
+    read_json,
+    shard_crcs,
+    shard_ranges,
+    verify_crcs,
+    write_column,
+    write_sentinel,
+    write_spec,
+)
+
+__all__ = [
+    "SNAP_FORMAT", "SNAP_SPEC", "SNAP_OK", "COL_PREFIX",
+    "DELTA_MANIFEST", "DEFAULT_SHARD_ROWS", "SnapshotPlaneError",
+    "PlaneView", "shard_ranges", "state_columns", "write_plane",
+    "write_plane_delta", "read_delta_manifest", "attach", "has_plane",
+    "verify_plane", "snapshot_nbytes",
+]
 
 #: Plane format revision (bump on incompatible layout change; the
 #: reader refuses unknown revisions instead of misparsing them).
@@ -94,11 +112,6 @@ def _col_path(vdir: str, name: str) -> str:
     return os.path.join(vdir, f"{COL_PREFIX}{name}.npy")
 
 
-def shard_ranges(n: int, shard_rows: int) -> List[Tuple[int, int]]:
-    return [(lo, min(lo + shard_rows, n))
-            for lo in range(0, n, shard_rows)]
-
-
 def state_columns(state: FitState,
                   extras: Optional[Dict[str, np.ndarray]] = None
                   ) -> Dict[str, np.ndarray]:
@@ -124,14 +137,6 @@ def state_columns(state: FitState,
          for k, v in (extras or {}).items()}
     )
     return cols
-
-
-def _shard_crcs(cols: Dict[str, np.ndarray], lo: int,
-                hi: int) -> Dict[str, int]:
-    return {
-        k: zlib.crc32(np.ascontiguousarray(a[lo:hi]).tobytes())
-        for k, a in cols.items()
-    }
 
 
 def write_plane(vdir: str, state: FitState, ids: np.ndarray,
@@ -167,33 +172,16 @@ def write_plane(vdir: str, state: FitState, ids: np.ndarray,
         "columns": {k: {"dtype": a.dtype.str, "shape": list(a.shape)}
                     for k, a in cols.items()},
     }
-    atomic_write(os.path.join(vdir, SNAP_SPEC),
-                 lambda fh: json.dump(spec, fh, indent=1), mode="w")
-    for k, a in cols.items():
-        atomic_write(_col_path(vdir, k),
-                     lambda fh, a=a: np.save(fh, a))
     sentinel = {
         "format": SNAP_FORMAT,
         "n_series": n,
         "shard_rows": int(shard_rows),
         "unix": round(time.time(), 3),
-        "shards": [[lo, hi, _shard_crcs(cols, lo, hi)]
+        "shards": [[lo, hi, shard_crcs(cols, lo, hi)]
                    for lo, hi in shard_ranges(n, shard_rows)],
     }
-    atomic_write(os.path.join(vdir, SNAP_OK),
-                 lambda fh: json.dump(sentinel, fh), mode="w")
-
-
-def _link_or_copy(src: str, dst: str) -> None:
-    """Share ``src``'s bytes into ``dst``: hardlink (zero new snapshot
-    bytes — columns are write-once, so sharing the inode across
-    versions is safe) with a copy fallback for cross-device roots."""
-    try:
-        os.link(src, dst)
-    except OSError:
-        import shutil
-
-        shutil.copy2(src, dst)
+    publish_plane(vdir, SNAP_SPEC, spec, cols, _col_path,
+                  SNAP_OK, sentinel)
 
 
 def write_plane_delta(vdir: str, base_vdir: str, changed_rows,
@@ -231,8 +219,8 @@ def write_plane_delta(vdir: str, base_vdir: str, changed_rows,
     manifest record."""
     from tsspark_tpu.resilience import faults
 
-    base_spec = _read_json(os.path.join(base_vdir, SNAP_SPEC))
-    base_ok = _read_json(os.path.join(base_vdir, SNAP_OK))
+    base_spec = read_json(os.path.join(base_vdir, SNAP_SPEC))
+    base_ok = read_json(os.path.join(base_vdir, SNAP_OK))
     if base_spec is None or base_ok is None:
         raise SnapshotPlaneError(
             "absent", f"{base_vdir}: delta publish needs the base "
@@ -266,21 +254,20 @@ def write_plane_delta(vdir: str, base_vdir: str, changed_rows,
     spec = dict(base_spec, fingerprint=fingerprint,
                 numerics_rev=numerics_rev,
                 delta_from=base_version, n_changed=int(len(changed)))
-    atomic_write(os.path.join(vdir, SNAP_SPEC),
-                 lambda fh: json.dump(spec, fh, indent=1), mode="w")
+    write_spec(os.path.join(vdir, SNAP_SPEC), spec)
     scattered: Dict[str, np.ndarray] = {}
     for name in base_spec["columns"]:
         src = _col_path(base_vdir, name)
         dst = _col_path(vdir, name)
         faults.inject("delta_publish")
         if name not in sub_cols:
-            _link_or_copy(src, dst)
+            link_or_copy(src, dst)
             continue
-        base_mm = np.load(src, mmap_mode="r")
+        base_mm = attach_column(src)
         out = np.array(base_mm)        # copy-forward: one sequential read
         del base_mm
         out[changed] = np.asarray(sub_cols[name], out.dtype)
-        atomic_write(dst, lambda fh, a=out: np.save(fh, a))
+        write_column(dst, out)
         scattered[name] = out
     # Sentinel: recompute only (scattered column x touched shard) CRCs.
     touched = set(np.unique(changed // shard_rows).tolist())
@@ -288,12 +275,11 @@ def write_plane_delta(vdir: str, base_vdir: str, changed_rows,
     for entry in base_ok.get("shards") or ():
         lo, hi, crcs = int(entry[0]), int(entry[1]), dict(entry[2])
         if lo // shard_rows in touched:
-            crcs.update(_shard_crcs(scattered, lo, hi))
+            crcs.update(shard_crcs(scattered, lo, hi))
         shards.append([lo, hi, crcs])
     sentinel = dict(base_ok, unix=round(time.time(), 3), shards=shards)
-    atomic_write(os.path.join(vdir, SNAP_OK),
-                 lambda fh: json.dump(sentinel, fh), mode="w")
-    ids_mm = np.load(_col_path(base_vdir, "ids"), mmap_mode="r")
+    write_sentinel(os.path.join(vdir, SNAP_OK), sentinel)
+    ids_mm = attach_column(_col_path(base_vdir, "ids"))
     manifest = {
         "base_version": base_version,
         "n_changed": int(len(changed)),
@@ -311,7 +297,7 @@ def write_plane_delta(vdir: str, base_vdir: str, changed_rows,
 def read_delta_manifest(vdir: str) -> Optional[Dict]:
     """The version's delta-publish metadata, or None for a full
     (non-delta) version."""
-    return _read_json(os.path.join(vdir, DELTA_MANIFEST))
+    return read_json(os.path.join(vdir, DELTA_MANIFEST))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -328,15 +314,6 @@ class PlaneView:
     numerics_rev: Optional[int]
 
 
-def _read_json(path: str) -> Optional[Dict]:
-    try:
-        with open(path) as fh:
-            d = json.load(fh)
-        return d if isinstance(d, dict) else None
-    except (OSError, ValueError):
-        return None
-
-
 def attach(vdir: str, *, verify: bool = True,
            expected_n: Optional[int] = None) -> PlaneView:
     """Attach the plane in ``vdir`` as memmap views.
@@ -348,8 +325,8 @@ def attach(vdir: str, *, verify: bool = True,
     ``SnapshotPlaneError("absent")`` when no plane was published here,
     ``("corrupt")`` for anything torn, truncated, or mismatched.
     """
-    sentinel = _read_json(os.path.join(vdir, SNAP_OK))
-    spec = _read_json(os.path.join(vdir, SNAP_SPEC))
+    sentinel = read_json(os.path.join(vdir, SNAP_OK))
+    spec = read_json(os.path.join(vdir, SNAP_SPEC))
     if sentinel is None and spec is None:
         raise SnapshotPlaneError(
             "absent", f"no snapshot plane under {vdir}"
@@ -378,7 +355,7 @@ def attach(vdir: str, *, verify: bool = True,
     for name, meta in (spec.get("columns") or {}).items():
         path = _col_path(vdir, name)
         try:
-            mm = np.load(path, mmap_mode="r")
+            mm = attach_column(path)
         except Exception as e:
             # Not just OSError/ValueError: a header torn mid-byte
             # surfaces as SyntaxError out of numpy's literal_eval — any
@@ -398,17 +375,15 @@ def attach(vdir: str, *, verify: bool = True,
                 "corrupt", f"{vdir}: plane is missing column {req!r}"
             )
     if verify:
-        for entry in sentinel.get("shards") or ():
-            lo, hi, crcs = int(entry[0]), int(entry[1]), entry[2]
-            got = _shard_crcs(cols, lo, hi)
-            for name, want in crcs.items():
-                if got.get(name) != int(want):
-                    raise SnapshotPlaneError(
-                        "corrupt",
-                        f"{_col_path(vdir, name)}: shard [{lo}, {hi}) "
-                        "CRC mismatch (torn or silently corrupted "
-                        "snapshot column)",
-                    )
+        bad = verify_crcs(cols, sentinel.get("shards"))
+        if bad is not None:
+            name, lo, hi = bad
+            raise SnapshotPlaneError(
+                "corrupt",
+                f"{_col_path(vdir, name)}: shard [{lo}, {hi}) "
+                "CRC mismatch (torn or silently corrupted "
+                "snapshot column)",
+            )
     meta_fields = {
         k[len("meta_"):]: np.asarray(cols[k], np.float64)
         for k in cols if k.startswith("meta_")
@@ -454,7 +429,7 @@ def snapshot_nbytes(vdir: str) -> Optional[int]:
     """Total column bytes of the plane in ``vdir`` (the denominator of
     the scale ladder's one-physical-copy RSS accounting); None when no
     plane is published."""
-    spec = _read_json(os.path.join(vdir, SNAP_SPEC))
+    spec = read_json(os.path.join(vdir, SNAP_SPEC))
     if spec is None:
         return None
     total = 0

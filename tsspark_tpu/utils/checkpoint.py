@@ -22,7 +22,7 @@ from tsspark_tpu.config import ProphetConfig
 from tsspark_tpu.models.prophet.design import ScalingMeta
 from tsspark_tpu.models.prophet.model import FitState
 from tsspark_tpu.resilience import integrity
-from tsspark_tpu.utils.atomic import atomic_write
+from tsspark_tpu.io import atomic_write
 
 
 def config_fingerprint(config: ProphetConfig) -> str:
