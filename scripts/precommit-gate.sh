@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Pre-commit slice of the static-analysis gate (docs/ANALYSIS.md).
+#
+# Runs the --changed fast mode of the analysis gate (per-file passes
+# scoped to modules touched since the given ref; whole-repo models and
+# the effect path budgets still run in full) plus the tier-1 analysis
+# tests.  Usage:
+#
+#   scripts/precommit-gate.sh [git-ref]     # default ref: HEAD
+#
+# Wire it up as .git/hooks/pre-commit with:
+#   ln -s ../../scripts/precommit-gate.sh .git/hooks/pre-commit
+set -euo pipefail
+
+ref="${1:-HEAD}"
+root="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$root"
+
+export JAX_PLATFORMS=cpu
+
+echo "== analysis gate (--changed $ref) =="
+python -m tsspark_tpu.analysis --changed "$ref" --no-report
+
+echo "== tier-1 analysis tests =="
+python -m pytest tests/test_analysis.py -q -m 'not slow' \
+    -p no:cacheprovider
+
+echo "precommit-gate: clean"

@@ -909,3 +909,432 @@ def test_sweep_stale_temps_bounds_orphans(tmp_path):
     assert removed == 1
     assert not stale.exists()
     assert fresh.exists() and regular.exists()
+
+
+# ---------------------------------------------------------------------------
+# effect inference & path budgets (analysis/effects.py)
+# ---------------------------------------------------------------------------
+
+def _effects_pkg(tmp_path, files):
+    """A throwaway package tree: {relname: source} under
+    tmp_path/tsspark_tpu, returning the fixture root."""
+    pkg = tmp_path / "tsspark_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _budget(name, roots, forbid, allow_via=()):
+    from tsspark_tpu.analysis import effects
+
+    return effects.EffectsConfig(paths=(effects.PathBudget(
+        name=name, roots=tuple(roots), forbid=tuple(forbid),
+        allow_via=tuple(allow_via),
+    ),))
+
+
+def test_effects_dispatch_on_thread_budget(tmp_path):
+    """The serve-threads claim: a heartbeat helper sneaking a jnp op
+    onto the maintenance thread trips the no-dispatch budget, and the
+    finding carries the call chain from the root."""
+    from tsspark_tpu.analysis import effects
+
+    root = _effects_pkg(tmp_path, {"pool.py": '''
+        import jax.numpy as jnp
+
+        def _heartbeat(self):
+            _refresh_gauge()
+
+        def _refresh_gauge():
+            return jnp.zeros((2,)).sum()
+    '''})
+    found = effects.check_effects(root, config=_budget(
+        "threads", ["tsspark_tpu/pool.py::_heartbeat"],
+        ["jax-dispatch"],
+    ))
+    assert [f.rule for f in found] == ["effect-budget"]
+    assert found[0].qualname == "_refresh_gauge"
+    assert "_heartbeat" in found[0].message  # the chain names the root
+
+
+def test_effects_raw_write_on_respond_path(tmp_path):
+    """open(..., "w") reachable from a respond root fires; the same
+    site under an inline waiver is suppressed (and consumed)."""
+    from tsspark_tpu.analysis import effects
+
+    root = _effects_pkg(tmp_path, {"serve.py": '''
+        def respond(req):
+            return _log_request(req)
+
+        def _log_request(req):
+            with open("/tmp/requests.log", "a") as fh:
+                fh.write(str(req))
+    '''})
+    cfg = _budget("respond", ["tsspark_tpu/serve.py::respond"],
+                  ["raw-fs-write"])
+    found = effects.check_effects(root, config=cfg)
+    assert [f.rule for f in found] == ["effect-budget"]
+    assert found[0].qualname == "_log_request"
+
+    root2 = _effects_pkg(tmp_path / "waived", {"serve.py": '''
+        def respond(req):
+            return _log_request(req)
+
+        def _log_request(req):
+            with open("/tmp/requests.log", "a") as fh:  # lint-ok[effect-budget]: test-only sink
+                fh.write(str(req))
+    '''})
+    assert not effects.check_effects(root2, config=cfg)
+
+
+def test_effects_allow_via_cuts_path(tmp_path):
+    """A declared cut point (the spill-artifact idiom) excuses the
+    effects BEYOND it, and only through it."""
+    from tsspark_tpu.analysis import effects
+
+    src = {"sched.py": '''
+        import os
+
+        def idle_tick(self):
+            ensure_spill("scratch")
+
+        def ensure_spill(scratch):
+            os.makedirs(scratch)
+    '''}
+    roots = ["tsspark_tpu/sched.py::idle_tick"]
+    found = effects.check_effects(
+        _effects_pkg(tmp_path, src),
+        config=_budget("idle", roots, ["raw-fs-write"]),
+    )
+    assert [f.rule for f in found] == ["effect-budget"]
+    found = effects.check_effects(
+        _effects_pkg(tmp_path / "cut", src),
+        config=_budget("idle", roots, ["raw-fs-write"],
+                       allow_via=["tsspark_tpu/sched.py::ensure_spill"]),
+    )
+    assert not found
+
+
+def test_effects_env_unregistered_and_unused(tmp_path):
+    """Every TSSPARK_* read needs an EnvSpec row — including reads
+    through an imported module's constant — and a spec nothing reads
+    is itself a finding (specs die with the read they cover)."""
+    from tsspark_tpu.analysis import effects
+
+    root = _effects_pkg(tmp_path, {
+        "consts.py": "ENV_VAR = 'TSSPARK_VIA_CONST'\n",
+        "mod.py": '''
+            import os
+
+            from tsspark_tpu import consts
+
+            def configured():
+                a = os.environ.get("TSSPARK_DIRECT")
+                b = os.environ.get(consts.ENV_VAR)
+                return a, b
+        ''',
+    })
+    found = effects.check_effects(root, config=effects.EffectsConfig())
+    assert sorted(f.message.split("'")[1] for f in found
+                  if f.rule == "env-unregistered") == [
+        "TSSPARK_DIRECT", "TSSPARK_VIA_CONST",
+    ]
+
+    spec = effects.EnvSpec(var="TSSPARK_DIRECT",
+                           owner="tsspark_tpu/mod.py", inherit=True)
+    ghost = effects.EnvSpec(var="TSSPARK_NEVER_READ",
+                            owner="tsspark_tpu/mod.py", inherit=False)
+    found = effects.check_effects(
+        root, config=effects.EffectsConfig(env=(spec, ghost)),
+    )
+    rules = {f.rule for f in found}
+    assert "env-unused" in rules  # the ghost spec
+    assert all(f.qualname == "TSSPARK_NEVER_READ" for f in found
+               if f.rule == "env-unused")
+
+
+def test_effects_spawn_drops_inherited_spec(tmp_path):
+    """A spawn site passing env= must provably seed from os.environ —
+    a from-scratch dict silently drops every inherited spec.  Both the
+    dict(os.environ) idiom and the _child_env-builder idiom pass."""
+    from tsspark_tpu.analysis import effects
+
+    spec = (effects.EnvSpec(var="TSSPARK_FAULTS",
+                            owner="tsspark_tpu/f.py", inherit=True),)
+    bad = _effects_pkg(tmp_path, {
+        "f.py": "import os\nF = os.environ.get('TSSPARK_FAULTS')\n",
+        "spawn.py": '''
+            import subprocess
+
+            def launch(cmd):
+                env = {"PATH": "/usr/bin"}
+                return subprocess.Popen(cmd, env=env)
+        ''',
+    })
+    found = effects.check_effects(
+        bad, config=effects.EffectsConfig(env=spec),
+    )
+    assert "env-propagation" in {f.rule for f in found}
+    assert any("TSSPARK_FAULTS" in f.message for f in found
+               if f.rule == "env-propagation")
+
+    good = _effects_pkg(tmp_path / "good", {
+        "f.py": "import os\nF = os.environ.get('TSSPARK_FAULTS')\n",
+        "spawn.py": '''
+            import os
+            import subprocess
+
+            def _child_env():
+                env = dict(os.environ)
+                env["EXTRA"] = "1"
+                return env
+
+            def launch_inline(cmd):
+                env = dict(os.environ)
+                return subprocess.Popen(cmd, env=env)
+
+            def launch_builder(cmd):
+                return subprocess.Popen(cmd, env=_child_env())
+
+            def launch_inheriting(cmd):
+                return subprocess.Popen(cmd)
+        ''',
+    })
+    found = effects.check_effects(
+        good, config=effects.EffectsConfig(env=spec),
+    )
+    assert "env-propagation" not in {f.rule for f in found}
+
+
+def test_effects_fault_scope(tmp_path):
+    """faults.inject in a module outside the declared fault_modules
+    set fires; declaring the module clears it; a declared module with
+    no inject site is itself stale."""
+    from tsspark_tpu.analysis import effects
+
+    root = _effects_pkg(tmp_path, {"rogue.py": '''
+        from tsspark_tpu.resilience import faults
+
+        def risky():
+            faults.inject("rogue_point")
+    '''})
+    found = effects.check_effects(root, config=effects.EffectsConfig())
+    assert "fault-scope" in {f.rule for f in found}
+
+    found = effects.check_effects(root, config=effects.EffectsConfig(
+        fault_modules=("tsspark_tpu/rogue.py",),
+    ))
+    assert "fault-scope" not in {f.rule for f in found}
+
+    found = effects.check_effects(root, config=effects.EffectsConfig(
+        fault_modules=("tsspark_tpu/rogue.py",
+                       "tsspark_tpu/gone.py"),
+    ))
+    assert any(f.rule == "effect-model" and "gone.py" in f.qualname
+               for f in found)
+
+
+def test_effects_config_validation(tmp_path):
+    """A typo'd budget must raise at load, and a root matching no
+    function must surface as effect-model — a budget silently checking
+    nothing passes vacuously."""
+    from tsspark_tpu.analysis import effects
+
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent('''
+        [[tool.tsspark.analysis.effects.paths]]
+        name = "bad"
+        roots = ["tsspark_tpu/x.py::f"]
+        forbid = ["jax-dispatcb"]
+    '''))
+    with pytest.raises(ValueError):
+        effects.load_config(str(tmp_path))
+
+    root = _effects_pkg(tmp_path, {"x.py": "def f():\n    pass\n"})
+    found = effects.check_effects(root, config=_budget(
+        "ghost", ["tsspark_tpu/x.py::no_such_fn"], ["spawn"],
+    ))
+    assert [f.rule for f in found] == ["effect-model"]
+
+
+def test_effects_transitive_signature(tmp_path):
+    """The inferred signature unions effects bottom-up over the call
+    graph; an unrelated same-named nested function does not leak in."""
+    from tsspark_tpu.analysis import effects
+
+    root = _effects_pkg(tmp_path, {"m.py": '''
+        import os
+        import subprocess
+
+        def top():
+            mid()
+
+        def mid():
+            subprocess.run(["true"])
+
+        def clean():
+            def loop():
+                return 1
+            return loop()
+
+        def other():
+            def loop():
+                os.makedirs("x")
+            return loop()
+    '''})
+    g = effects.scan_package(root)
+    top = g.transitive_effects(("tsspark_tpu/m.py", "top"))
+    assert "spawn" in top and "raw-fs-write" not in top
+    clean = g.transitive_effects(("tsspark_tpu/m.py", "clean"))
+    assert clean == set()  # other()'s loop must not join clean()'s
+
+
+def test_effects_pyproject_budgets_declared():
+    """The ISSUE's acceptance claim: the committed pyproject declares
+    the serve hot-read-path and maintenance-thread budgets, and the
+    inherited env specs the spawn sites must forward."""
+    from tsspark_tpu.analysis import effects
+
+    cfg = effects.load_config(repo_root())
+    budgets = {p.name: p for p in cfg.paths}
+    respond = budgets["serve-respond"]
+    assert {"jax-compile", "durable-write", "spawn"} <= set(
+        respond.forbid
+    )
+    assert any("_respond_forecast" in r for r in respond.roots)
+    threads = budgets["serve-threads"]
+    assert {"jax-dispatch", "jax-compile"} <= set(threads.forbid)
+    assert any("_heartbeat" in r for r in threads.roots)
+    assert "sched-idle" in budgets and "registry-read" in budgets
+    env = {s.var: s for s in cfg.env}
+    for var in ("TSSPARK_FAULTS", "TSSPARK_TRACE",
+                "TSSPARK_DISK_BUDGET_BYTES"):
+        assert env[var].inherit, f"{var} must be marked inherited"
+    assert cfg.fault_modules  # the kill-point surface is closed
+
+
+def test_analysis_slo_budget_present():
+    """The gate self-SLO: the analysis RUNHISTORY family is sentinel-
+    gated like bench/serve/chaos — zero unwaived findings, bounded
+    wall."""
+    from tsspark_tpu.obs import regress
+
+    budget = regress.load_slo(repo_root())["budgets"]["analysis"]
+    assert budget["findings"]["direction"] == "lower"
+    assert budget["findings"]["max_rise_abs"] == 0.0
+    assert budget["wall_s"]["direction"] == "lower"
+    assert regress.DEFAULT_SLO["budgets"]["analysis"] == budget
+
+
+def test_effects_live_tree_clean():
+    """The effects gate over this repository: the committed budgets
+    hold with zero unwaived findings (the fast, contracts-free slice
+    of test_repo_passes_full_analysis)."""
+    from tsspark_tpu.analysis import effects
+
+    found = effects.check_effects(repo_root())
+    assert not found, "\n".join(str(f) for f in found)
+
+
+def test_effects_changed_scope_limits_site_rules(tmp_path):
+    """--changed semantics: per-site rules narrow to the touched
+    modules, the path budgets still run whole."""
+    from tsspark_tpu.analysis import effects
+
+    root = _effects_pkg(tmp_path, {
+        "a.py": '''
+            import os
+
+            def read_a():
+                return os.environ.get("TSSPARK_UNREG_A")
+        ''',
+        "b.py": '''
+            import os
+
+            def write_b():
+                os.makedirs("x")
+
+            def root_b():
+                write_b()
+        ''',
+    })
+    cfg = _budget("b", ["tsspark_tpu/b.py::root_b"], ["raw-fs-write"])
+    found = effects.check_effects(
+        root, config=cfg,
+        scope_paths=[os.path.join(root, "tsspark_tpu", "b.py")],
+    )
+    rules = [f.rule for f in found]
+    assert "effect-budget" in rules       # budget checked whole
+    assert "env-unregistered" not in rules  # a.py out of scope
+    found = effects.check_effects(root, config=cfg)
+    assert "env-unregistered" in {f.rule for f in found}
+
+
+# ---------------------------------------------------------------------------
+# stale-waiver detection (analysis/waivers.py)
+# ---------------------------------------------------------------------------
+
+def test_stale_waiver_fires_and_consumed_passes(tmp_path):
+    from tsspark_tpu.analysis import waivers
+
+    root = _effects_pkg(tmp_path, {"mod.py": '''
+        def f():
+            x = 1  # lint-ok[trace-branch]: excuses nothing anymore
+            y = 2  # lint-ok[lock-guard]: this one is still consumed
+            return x + y
+    '''})
+    pkg = os.path.join(root, "tsspark_tpu")
+    consumed = {("tsspark_tpu/mod.py", 4, "lock-guard")}
+    found = waivers.check_stale(pkg, root, consumed, [], [])
+    assert [f.rule for f in found] == ["stale-waiver"]
+    assert found[0].line == 3 and "trace-branch" in found[0].message
+
+    # An all-consumed tree is clean.
+    consumed.add(("tsspark_tpu/mod.py", 3, "trace-branch"))
+    assert not waivers.check_stale(pkg, root, consumed, [], [])
+
+
+def test_stale_baseline_suppression_fires(tmp_path):
+    from tsspark_tpu.analysis import waivers
+
+    root = _effects_pkg(tmp_path, {"mod.py": "def f():\n    pass\n"})
+    pkg = os.path.join(root, "tsspark_tpu")
+    live = Finding("host-sync", "tsspark_tpu/mod.py", 1, "f", "x")
+    keys = [("host-sync", "tsspark_tpu/mod.py", "f"),
+            ("host-sync", "tsspark_tpu/mod.py", "ghost_fn")]
+    found = waivers.check_stale(pkg, root, set(), keys, [live])
+    assert [f.rule for f in found] == ["stale-waiver"]
+    assert found[0].qualname == "ghost_fn"
+
+
+def test_waiver_hits_recorded_by_line_ok(tmp_path):
+    """The instrumentation contract: a waiver that suppresses a real
+    finding lands in WAIVER_HITS; lint_paths on a waived violation is
+    exactly that."""
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent('''
+        import jax
+
+        @jax.jit
+        def k(x):
+            if x > 0:  # lint-ok[trace-branch]: fixture waiver
+                x = x + 1
+            return x
+    '''))
+    tracelint.reset_waiver_hits()
+    found = tracelint.lint_paths([str(p)], str(tmp_path))
+    assert not [f for f in found if f.rule == "trace-branch"]
+    assert any(rule == "trace-branch"
+               for _p, _l, rule in tracelint.WAIVER_HITS)
+
+
+def test_run_all_full_pass_reports_stale_count():
+    """The tier-1 wiring: a full run_all carries the stale sweep in
+    its counts (zero on the live tree — waivers die with their code)."""
+    report = analysis.run_all(root=repo_root())
+    counts = dict(report.counts)
+    assert "effects" in counts and "stale" in counts
+    assert counts["stale"] == 0

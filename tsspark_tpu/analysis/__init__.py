@@ -1,6 +1,6 @@
 """JAX/TPU-aware static analysis gating every PR (``docs/ANALYSIS.md``).
 
-Six checkers, all device-free:
+Seven checkers, all device-free:
 
 * ``tracelint``  — AST trace-safety lint over the package (tracer
   branching, host syncs in jitted scopes, f64 drift, silent-recompile
@@ -22,6 +22,18 @@ Six checkers, all device-free:
 * ``hygiene``    — repo hygiene: no committed bytecode
   (``__pycache__``/``.pyc`` in the git index) and the root
   ``.gitignore`` keeps covering interpreter-generated dirs.
+* ``effects``    — whole-program effect inference: every function's
+  transitively reachable side effects (jax-dispatch/compile, durable
+  and raw writes, spawn, locks, blocking I/O, env reads, fault
+  points) checked against the per-path budgets declared in
+  ``[tool.tsspark.analysis.effects]`` — "zero dispatch on the hot
+  read path" as a machine-checked claim — plus the ``TSSPARK_*``
+  env-var registration/propagation contract and fault-point scoping.
+
+Full passes additionally run stale-waiver detection: an inline
+``# lint-ok[rule]:`` comment or baseline suppression that no longer
+suppresses any finding is itself a ``stale-waiver`` gate error —
+waivers must die with the code they excuse.
 
 Run locally with ``python -m tsspark_tpu.analysis``; the same pass runs
 as a default-on tier-1 test (``tests/test_analysis.py``), so a PR that
@@ -66,6 +78,7 @@ class AnalysisReport:
 
 DEFAULT_CHECKERS: Tuple[str, ...] = (
     "trace", "contracts", "fileproto", "concur", "proto", "hygiene",
+    "effects",
 )
 
 
@@ -83,15 +96,22 @@ def run_all(
     from tsspark_tpu.analysis import (
         concur,
         contracts,
+        effects,
         fileproto,
         hygiene,
         protomodel,
         tracelint,
+        waivers,
     )
 
     root = root or repo_root()
     settings = settings or load_settings(root)
     package_dir = os.path.join(root, "tsspark_tpu")
+    full_pass = scope_paths is None and set(checkers) >= set(
+        DEFAULT_CHECKERS
+    )
+    if full_pass:
+        tracelint.reset_waiver_hits()
     raw = []
     counts = []
     if "trace" in checkers:
@@ -129,5 +149,22 @@ def run_all(
         found = hygiene.check_hygiene(root)
         counts.append(("hygiene", len(found)))
         raw += found
+    if "effects" in checkers:
+        found = effects.check_effects(root, scope_paths=scope_paths,
+                                      package_dir=package_dir)
+        counts.append(("effects", len(found)))
+        raw += found
     kept, suppressed = apply_suppressions(tuple(raw), settings)
+    if full_pass:
+        # Stale-waiver sweep: only meaningful when every waiver had
+        # its chance to be consumed (all checkers, whole tree).
+        stale = waivers.check_stale(
+            package_dir, root, tracelint.WAIVER_HITS,
+            settings.suppression_keys(), raw,
+        )
+        counts.append(("stale", len(stale)))
+        stale_kept, stale_supp = apply_suppressions(tuple(stale),
+                                                    settings)
+        kept += stale_kept
+        suppressed += stale_supp
     return AnalysisReport(kept, suppressed, tuple(counts))

@@ -4,9 +4,10 @@ Exit code 0 when every checker is clean (after the committed
 suppression baseline), 1 otherwise.  ``--checker`` narrows to one pass;
 ``-v`` also prints what the baseline suppressed.  ``--changed
 <git-ref>`` is the pre-commit fast path: the per-file passes (trace,
-concur) run only over package modules touched since the ref, while the
-whole-repo models (contracts, fileproto, proto, hygiene) keep their
-full closure.  A full run writes an ``ANALYSIS_*.json`` artifact and
+concur, the effects checker's per-site rules) run only over package
+modules touched since the ref, while the whole-repo models (contracts,
+fileproto, proto, hygiene, the effect path budgets and EnvSpec table)
+keep their full closure.  A full run writes an ``ANALYSIS_*.json`` artifact and
 self-ingests it into RUNHISTORY (``--no-report`` skips both).
 
 The contract checker needs a JAX backend with enough devices for the
@@ -78,16 +79,18 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--checker",
         choices=("trace", "contracts", "fileproto", "concur", "proto",
-                 "hygiene"),
+                 "hygiene", "effects"),
         action="append",
         help="run only this checker (repeatable; default: all)",
     )
     ap.add_argument("--root", default=None,
                     help="repo root (default: the package's parent)")
     ap.add_argument("--changed", default=None, metavar="GIT_REF",
-                    help="fast mode: scope trace+concur to package "
+                    help="fast mode: scope the per-file passes (trace, "
+                         "concur, effects site rules) to package "
                          "modules touched since this ref (contracts/"
-                         "fileproto/proto/hygiene still run whole)")
+                         "fileproto/proto/hygiene and the effect path "
+                         "budgets still run whole)")
     ap.add_argument("--no-report", action="store_true",
                     help="skip the ANALYSIS_* artifact + RUNHISTORY "
                          "ingest (fast/scoped runs skip it anyway)")

@@ -1,0 +1,950 @@
+"""Effect-inference gate: per-path effect budgets over the call graph.
+
+The repo's open speed tentpole (the materialized forecast plane) is
+defined by an *effect* claim — a hot point-forecast read must reach the
+memmap with **zero JAX dispatch, zero compile, zero durable write** —
+and value-level tests cannot state a claim of that shape.  This checker
+can: it infers, bottom-up over the same qualified-import call graph the
+trace lint walks, the set of side effects every package function can
+*transitively* reach, then checks declared per-path budgets from the
+committed ``[tool.tsspark.analysis.effects]`` pyproject table.
+
+The effect lattice (a flat powerset — effects union up the call graph):
+
+* ``jax-dispatch``  — any ``jnp``/``jax``/``lax`` op call, a call into
+  a jit-decorated package function, ``.block_until_ready()``.
+* ``jax-compile``   — a trace entry: ``jax.jit``/``pjit``/
+  ``eval_shape``/``make_jaxpr``, or calling a jit-decorated function
+  (its first dispatch compiles).
+* ``durable-write`` — the storage fault domain's sanctioned writers
+  (``tsspark_tpu.io``: ``atomic_write``/``atomic_write_text``/
+  ``append_line``/``hardlink``/``link_or_copy``/``fsync_dir``, plus
+  ``utils.atomic``).  Raw writes *inside* those choke modules count as
+  durable, not raw — they ARE the choke point.
+* ``raw-fs-write``  — ``open(..., "w"/"a"/"x"/"+")``, ``os.replace``/
+  ``rename``/``link``/``write``/``makedirs``/``unlink``/... ,
+  ``np.save*``, ``json.dump``/``pickle.dump``, ``shutil`` copies.
+* ``spawn``         — ``subprocess.Popen``/``run``/``check_*``,
+  ``os.fork``/``exec*``/``posix_spawn``.
+* ``lock-acquire``  — ``with <something lock-ish>:`` / ``.acquire()``.
+* ``blocking-io``   — ``time.sleep``, ``select.select``, socket
+  ``recv``/``accept``/``connect``/``sendall``, ``.wait(...)``.
+* ``env-read``      — ``os.environ`` reads / ``os.getenv``.
+* ``fault-point``   — ``resilience.faults.inject`` sites (the chaos
+  harness's armable kill points).
+
+Budgets are **path** claims: each entry names root functions
+(``relpath::qualname``), the effects the path must never reach, and
+optional ``allow_via`` cut points — declared escape hatches (the idle
+tick's spill prefetch, its stranded-probe re-publish) whose own effects
+are deliberate and reviewed.  A finding is anchored at the OFFENDING
+function's evidence line (where an inline ``# lint-ok[effect-budget]:``
+waiver can sit next to the actual effect), and its message carries the
+full call chain from the root, so "how does the respond path reach a
+durable write?" is answered by the gate output itself.
+
+Precision notes (heuristic BY DESIGN, like every pass here): qualified
+imports join precisely; attribute/simple calls resolve nested defs
+first, then same-class siblings, then same-module functions, and only
+then fall back to a package-wide name join — so ``start_watch``'s
+nested ``loop`` never inherits the effects of ``engine.start``'s
+``loop``.  External modules (numpy, jax — beyond the jax effect
+classification itself) contribute no edges.
+
+The env-var contract sub-checker rides the same scan: every
+``TSSPARK_*`` read (string literal, module constant, or imported
+constant like ``faults.ENV_VAR``) must be registered in the committed
+``EnvSpec`` table (owner module + child-propagation rule), and every
+spawn site that passes ``env=`` must hand children an environment
+provably seeded from ``os.environ`` (``dict(os.environ)``, a recognized
+builder like ``orchestrate._child_env``) — otherwise specs marked
+``inherit`` (``TSSPARK_FAULTS``, ``TSSPARK_DISK_BUDGET_*``,
+``TSSPARK_TRACE``, ...) would silently stop reaching workers, exactly
+the convention-not-contract gap this table closes.
+
+Rules: ``effect-budget``, ``env-unregistered``, ``env-propagation``,
+``env-unused``, ``fault-scope``, ``effect-model`` (budget/table
+entries that no longer match the tree — a stale declaration checks
+nothing and must die).  All honor the inline waiver and the pyproject
+baseline; docs/ANALYSIS.md section 6 is the operator guide.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tsspark_tpu.analysis.findings import Finding
+from tsspark_tpu.analysis.tracelint import (
+    _ModuleScan,
+    _jit_call_of,
+    _walk_functions,
+)
+
+EFFECTS: Tuple[str, ...] = (
+    "jax-dispatch", "jax-compile", "durable-write", "raw-fs-write",
+    "spawn", "lock-acquire", "blocking-io", "env-read", "fault-point",
+)
+
+#: The storage fault domain's choke modules: raw writes INSIDE them are
+#: the sanctioned durable implementation, not a bypass.
+_DURABLE_CHOKE_RELPATHS = (
+    "tsspark_tpu/io/durable.py",
+    "tsspark_tpu/utils/atomic.py",
+)
+_DURABLE_MODULE_PREFIXES = (
+    "tsspark_tpu.io", "tsspark_tpu.utils.atomic",
+)
+_DURABLE_FNS = {
+    "atomic_write", "atomic_write_text", "append_line", "hardlink",
+    "link_or_copy", "fsync_dir", "open_memmap",
+}
+_RAW_OS_FNS = {
+    "replace", "rename", "link", "symlink", "write", "truncate",
+    "makedirs", "mkdir", "unlink", "remove", "rmdir", "removedirs",
+}
+_OS_SPAWN_FNS = {"fork", "execv", "execve", "execvp", "posix_spawn",
+                 "spawnv", "spawnl"}
+_SUBPROCESS_FNS = {"Popen", "run", "call", "check_call", "check_output"}
+_SHUTIL_WRITE_FNS = {"copy", "copy2", "copyfile", "copytree", "move",
+                     "rmtree"}
+_NP_SAVE_FNS = {"save", "savez", "savez_compressed"}
+_JAX_COMPILE_ATTRS = {"jit", "pjit", "eval_shape", "make_jaxpr",
+                      "xla_computation"}
+_BLOCKING_METHODS = {"recv", "recvfrom", "accept", "connect", "sendall",
+                     "wait"}
+#: Builtins whose simple-name call must NOT join a package function of
+#: the same name (``open(path)`` joining ``ParamRegistry.open`` would
+#: hand every reader the registry's write effects).
+_BUILTIN_SHADOW = {"open", "print", "sorted", "iter", "next", "super",
+                   "min", "max", "abs", "round", "sum", "repr", "vars"}
+
+
+# ---------------------------------------------------------------------------
+# committed configuration: [tool.tsspark.analysis.effects]
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class EnvSpec:
+    """One registered ``TSSPARK_*`` variable: which module owns the
+    read, and whether spawned children must inherit it."""
+
+    var: str
+    owner: str       # repo-relative module path that reads it
+    inherit: bool    # True: every spawn site must forward it
+
+
+@dataclasses.dataclass(frozen=True)
+class PathBudget:
+    """One per-path effect claim: from each root, no function whose
+    base effects intersect ``forbid`` may be reachable, except through
+    the declared ``allow_via`` cut points."""
+
+    name: str
+    roots: Tuple[str, ...]       # "relpath::qualname"
+    forbid: Tuple[str, ...]      # effect names from EFFECTS
+    allow_via: Tuple[str, ...] = ()  # "relpath::qualname" cut points
+
+
+@dataclasses.dataclass(frozen=True)
+class EffectsConfig:
+    paths: Tuple[PathBudget, ...] = ()
+    env: Tuple[EnvSpec, ...] = ()
+    fault_modules: Tuple[str, ...] = ()
+
+
+def _parse_ref(ref: str, where: str) -> Tuple[str, str]:
+    try:
+        relpath, qualname = ref.split("::", 1)
+    except ValueError:
+        raise ValueError(
+            f"effects config {where}: {ref!r} is not "
+            "'<relpath>::<qualname>'"
+        )
+    return relpath.strip(), qualname.strip()
+
+
+def load_config(root: Optional[str] = None) -> EffectsConfig:
+    """``EffectsConfig`` from ``<root>/pyproject.toml``'s
+    ``[tool.tsspark.analysis.effects]`` table (empty config when the
+    file or table is absent).  Unknown effect names and malformed
+    entries raise at load — a typo'd budget silently checking nothing
+    would pass vacuously, the same policy as the suppression parser."""
+    from tsspark_tpu.analysis.config import _load_toml, repo_root
+
+    root = root or repo_root()
+    path = os.path.join(root, "pyproject.toml")
+    if not os.path.exists(path):
+        return EffectsConfig()
+    block = (
+        _load_toml(path).get("tool", {}).get("tsspark", {})
+        .get("analysis", {}).get("effects", {})
+    )
+    paths = []
+    for entry in block.get("paths", ()):
+        name = entry.get("name")
+        if not name:
+            raise ValueError("effects path budget without a 'name'")
+        for eff in entry.get("forbid", ()):
+            if eff not in EFFECTS:
+                raise ValueError(
+                    f"effects budget {name!r} forbids unknown effect "
+                    f"{eff!r} (known: {', '.join(EFFECTS)})"
+                )
+        roots = tuple(entry.get("roots", ()))
+        if not roots:
+            raise ValueError(f"effects budget {name!r} declares no roots")
+        for ref in roots + tuple(entry.get("allow_via", ())):
+            _parse_ref(ref, f"budget {name!r}")
+        paths.append(PathBudget(
+            name=str(name), roots=roots,
+            forbid=tuple(entry.get("forbid", ())),
+            allow_via=tuple(entry.get("allow_via", ())),
+        ))
+    env = []
+    for entry in block.get("env", ()):
+        var = entry.get("var")
+        if not var or not str(var).startswith("TSSPARK_"):
+            raise ValueError(
+                f"EnvSpec var {var!r} must be a TSSPARK_* name"
+            )
+        if "owner" not in entry or "inherit" not in entry:
+            raise ValueError(
+                f"EnvSpec {var!r} needs 'owner' and 'inherit' — an "
+                "unowned variable has no propagation story to check"
+            )
+        env.append(EnvSpec(var=str(var), owner=str(entry["owner"]),
+                           inherit=bool(entry["inherit"])))
+    return EffectsConfig(
+        paths=tuple(paths), env=tuple(env),
+        fault_modules=tuple(block.get("fault_modules", ())),
+    )
+
+
+# ---------------------------------------------------------------------------
+# package scan: functions, call edges, base effects
+# ---------------------------------------------------------------------------
+
+def _dotted(relpath: str) -> str:
+    mod = relpath.replace(os.sep, "/")
+    mod = mod[:-3] if mod.endswith(".py") else mod
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+def _binding(scan: _ModuleScan, name: str) -> Optional[str]:
+    """Dotted target a local name is bound to by imports, else None.
+    ``import jax.numpy as jnp`` -> ``jax.numpy``; ``from
+    tsspark_tpu.resilience import faults`` ->
+    ``tsspark_tpu.resilience.faults``."""
+    if name in scan.imports:
+        return scan.imports[name]
+    if name in scan.from_imports:
+        mod, orig = scan.from_imports[name]
+        return f"{mod}.{orig}" if mod else orig
+    return None
+
+
+def _is_os_environ(scan: _ModuleScan, node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name)
+            and _binding(scan, node.value.id) == "os")
+
+
+def _lockish_with_item(scan: _ModuleScan, ctx: ast.AST) -> bool:
+    """Does a ``with`` context expression look like a lock?  Name/attr
+    containing "lock"/"mutex", or a call to one (``self._locked()``)."""
+    if isinstance(ctx, ast.Call):
+        ctx = ctx.func
+    name = None
+    if isinstance(ctx, ast.Attribute):
+        name = ctx.attr
+    elif isinstance(ctx, ast.Name):
+        name = ctx.id
+    return bool(name) and ("lock" in name.lower() or "mutex" in name.lower())
+
+
+def _open_mode_writes(call: ast.Call) -> bool:
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and any(c in mode for c in "wax+")
+
+
+class _EffectGraph:
+    """Every package function, its outgoing call edges (resolved with
+    nested -> class -> module -> package preference), and its BASE
+    effects with one evidence (line, detail) per effect."""
+
+    def __init__(self, scans: List[_ModuleScan]):
+        self.scans = scans
+        self.scan_of: Dict[str, _ModuleScan] = {
+            s.relpath: s for s in scans
+        }
+        self.by_dotted: Dict[str, _ModuleScan] = {
+            _dotted(s.relpath): s for s in scans
+        }
+        self.info_of = {
+            (s.relpath, qual): info
+            for s in scans for qual, info in s.functions.items()
+        }
+        self.by_name: Dict[str, List[Tuple[str, str]]] = {}
+        for s in scans:
+            for qual in s.functions:
+                self.by_name.setdefault(
+                    qual.rsplit(".", 1)[-1], []
+                ).append((s.relpath, qual))
+        self.constants: Dict[str, Dict[str, str]] = {
+            s.relpath: _module_str_constants(s) for s in scans
+        }
+        self.base: Dict[Tuple[str, str], Dict[str, Tuple[int, str]]] = {}
+        for key, info in self.info_of.items():
+            self.base[key] = _base_effects(
+                self.scan_of[key[0]], info,
+                durable_choke=key[0].replace(os.sep, "/")
+                in _DURABLE_CHOKE_RELPATHS,
+            )
+        self.succ: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {
+            key: self._successors(key) for key in self.info_of
+        }
+
+    def _resolve_simple(self, key: Tuple[str, str],
+                        name: str) -> List[Tuple[str, str]]:
+        if name in _BUILTIN_SHADOW:
+            return []
+        relpath, qual = key
+        scan = self.scan_of[relpath]
+        # 1. nested def of this very function.
+        nested = [q for q in scan.functions
+                  if q.startswith(qual + ".")
+                  and q.rsplit(".", 1)[-1] == name]
+        if nested:
+            return [(relpath, q) for q in nested]
+        # 2. sibling in the same class (self._claim_slot()).
+        if "." in qual:
+            prefix = qual.rsplit(".", 1)[0]
+            sib = f"{prefix}.{name}"
+            if sib in scan.functions:
+                return [(relpath, sib)]
+        # 3. any definition in the same module.
+        local = [q for q in scan.functions
+                 if q == name or q.endswith("." + name)]
+        if local:
+            return [(relpath, q) for q in local]
+        # 4. package-wide simple-name join (the tracelint fallback).
+        return list(self.by_name.get(name, ()))
+
+    def _resolve_qual(self, mod: str, name: str,
+                      depth: int = 0) -> List[Tuple[str, str]]:
+        scan = self.by_dotted.get(mod)
+        if scan is not None:
+            hits = [q for q in scan.functions
+                    if q == name or q.endswith("." + name)]
+            if hits:
+                return [(scan.relpath, q) for q in hits]
+            # A re-export: the module (typically a package __init__)
+            # imports the name from somewhere else — follow it there
+            # PRECISELY rather than joining every same-named function
+            # (``obs.record`` must reach obs.context.record, not
+            # ChunkAutotuner.record).  Depth-bounded against import
+            # cycles.
+            if depth < 4:
+                if name in scan.from_imports:
+                    fmod, forig = scan.from_imports[name]
+                    target = f"{fmod}.{forig}" if fmod else forig
+                    if target in self.by_dotted:
+                        return []   # imported a MODULE, called? drop
+                    return self._resolve_qual(fmod, forig, depth + 1)
+                if name in scan.imports:
+                    return []       # the attr is a module, not a call
+        internal = mod in self.by_dotted or any(
+            d.startswith(mod + ".") for d in self.by_dotted
+        )
+        # A scanned package whose __init__ dynamically exposes the
+        # name: fall back to the name join rather than dropping the
+        # edge.
+        return list(self.by_name.get(name, ())) if internal else []
+
+    def _edges(self, scan: _ModuleScan, info) -> Tuple[Set[str],
+                                                       Set[Tuple[str,
+                                                                 str]]]:
+        """Own edge extraction (richer than ``_FnInfo.calls``): a call
+        through a FROM-imported module (``from serve import snapplane;
+        snapplane.attach(...)``) resolves as a qualified edge into that
+        module instead of degrading to a package-wide simple-name join
+        — tracelint can afford that imprecision, a budget checker
+        cannot."""
+        from tsspark_tpu.analysis.tracelint import _GENERIC_METHODS
+
+        simple: Set[str] = set()
+        qual: Set[Tuple[str, str]] = set()
+        local_names: Set[str] = set(info.param_names)
+        nested: Set[ast.AST] = set()
+        for sub in ast.walk(info.node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not info.node:
+                nested.update(ast.walk(sub))
+            if isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, ast.Store):
+                local_names.add(sub.id)
+        for sub in ast.walk(info.node):
+            if not isinstance(sub, ast.Call) or sub in nested:
+                continue
+            f = sub.func
+            if isinstance(f, ast.Name):
+                if f.id in scan.from_imports:
+                    qual.add(scan.from_imports[f.id])
+                else:
+                    simple.add(f.id)
+            elif isinstance(f, ast.Attribute) \
+                    and f.attr not in _GENERIC_METHODS:
+                recv = f.value
+                if isinstance(recv, ast.Name):
+                    b = _binding(scan, recv.id)
+                    if b is not None:
+                        qual.add((b, f.attr))
+                    elif not isinstance(recv.ctx, ast.Store):
+                        simple.add(f.attr)
+                elif isinstance(recv, ast.Subscript):
+                    pass   # x.at[i].set(v) — never a package module
+                else:
+                    simple.add(f.attr)
+            # Function references passed as arguments (thread targets,
+            # callbacks) run on this function's behalf.
+            for a in list(sub.args) + [kw.value for kw in sub.keywords]:
+                if isinstance(a, ast.Name):
+                    if a.id in scan.from_imports:
+                        qual.add(scan.from_imports[a.id])
+                    elif a.id not in local_names:
+                        simple.add(a.id)
+        return simple, qual
+
+    def _successors(self, key: Tuple[str, str]) -> Set[Tuple[str, str]]:
+        # ``faults.inject`` is an effect SINK: the fault actions it can
+        # reach (lost-fsync replay, simulated crashes) model the
+        # FAILURE of the caller's own effect under an armed chaos plan
+        # — they are not effects the calling path performs.  The
+        # ``fault-point`` base effect still marks every inject site,
+        # and fault-scope bounds where those sites may live.
+        if key[0].replace(os.sep, "/").endswith(
+            "resilience/faults.py"
+        ) and key[1] == "inject":
+            return set()
+        info = self.info_of[key]
+        out: Set[Tuple[str, str]] = set()
+        simple, qual = self._edges(self.scan_of[key[0]], info)
+        for callee in simple:
+            out.update(self._resolve_simple(key, callee))
+        for mod, name in qual:
+            out.update(self._resolve_qual(mod, name))
+        # Nested defs run on behalf of their parent (thread targets,
+        # callbacks) even when the reference never parses as a call.
+        relpath, qualname = key
+        out.update(
+            k for k in self.info_of
+            if k[0] == relpath and k[1].startswith(qualname + ".")
+        )
+        out.discard(key)
+        return out
+
+    def transitive_effects(self, key: Tuple[str, str]) -> Set[str]:
+        """The inferred effect signature: every effect reachable from
+        ``key`` through the call graph (the bottom-up closure)."""
+        seen = {key}
+        frontier = [key]
+        effects: Set[str] = set(self.base.get(key, ()))
+        while frontier:
+            for nxt in self.succ.get(frontier.pop(), ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+                    effects |= set(self.base.get(nxt, ()))
+        return effects
+
+
+def _module_str_constants(scan: _ModuleScan) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for stmt in scan.tree.body:
+        if isinstance(stmt, ast.Assign) \
+                and isinstance(stmt.value, ast.Constant) \
+                and isinstance(stmt.value.value, str):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = stmt.value.value
+    return out
+
+
+def _base_effects(scan: _ModuleScan, info,
+                  durable_choke: bool) -> Dict[str, Tuple[int, str]]:
+    """One (line, detail) evidence per base effect of this function's
+    own body (nested defs carry their own entries)."""
+    out: Dict[str, Tuple[int, str]] = {}
+
+    def note(effect: str, node: ast.AST, detail: str) -> None:
+        if effect == "raw-fs-write" and durable_choke:
+            effect = "durable-write"   # the choke point IS durable
+        out.setdefault(
+            effect, (getattr(node, "lineno", info.node.lineno), detail)
+        )
+
+    if info.jit_call is not None:
+        note("jax-compile", info.node, "jit-decorated (trace entry)")
+        note("jax-dispatch", info.node, "jit-decorated")
+
+    nested: Set[ast.AST] = set()
+    for sub in ast.walk(info.node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and sub is not info.node:
+            nested.update(ast.walk(sub))
+            nested.add(sub)
+
+    for sub in ast.walk(info.node):
+        if sub in nested:
+            continue
+        if isinstance(sub, ast.With):
+            for item in sub.items:
+                if _lockish_with_item(scan, item.context_expr):
+                    note("lock-acquire", sub, "with <lock>")
+        if isinstance(sub, ast.Subscript) and _is_os_environ(scan,
+                                                             sub.value):
+            note("env-read", sub, "os.environ[...]")
+        if isinstance(sub, ast.Compare) and any(
+            _is_os_environ(scan, c) for c in sub.comparators
+        ):
+            note("env-read", sub, "... in os.environ")
+        if not isinstance(sub, ast.Call):
+            continue
+        if _jit_call_of(sub) is not None:
+            note("jax-compile", sub, "jax.jit(...) call")
+        f = sub.func
+        if isinstance(f, ast.Name):
+            b = _binding(scan, f.id)
+            if f.id == "open" and _open_mode_writes(sub):
+                note("raw-fs-write", sub, "open(.., write mode)")
+            elif b:
+                bmod, _, borig = b.rpartition(".")
+                if b.startswith("jax"):
+                    if borig in _JAX_COMPILE_ATTRS:
+                        note("jax-compile", sub, f"{f.id}()")
+                    note("jax-dispatch", sub, f"{f.id}()")
+                elif bmod.startswith(_DURABLE_MODULE_PREFIXES) \
+                        and borig in _DURABLE_FNS:
+                    note("durable-write", sub, f"{borig}()")
+                elif bmod == "subprocess" or b.startswith("subprocess."):
+                    if borig in _SUBPROCESS_FNS:
+                        note("spawn", sub, f"subprocess.{borig}")
+                elif b.endswith("faults.inject") or (
+                    bmod.endswith("resilience.faults")
+                    and borig == "inject"
+                ):
+                    note("fault-point", sub, "faults.inject()")
+        elif isinstance(f, ast.Attribute):
+            a = f.attr
+            recv = f.value
+            rb = (_binding(scan, recv.id)
+                  if isinstance(recv, ast.Name) else None)
+            if rb is not None:
+                if rb == "jax" or rb.startswith("jax."):
+                    if a in _JAX_COMPILE_ATTRS:
+                        note("jax-compile", sub, f"{recv.id}.{a}()")
+                        note("jax-dispatch", sub, f"{recv.id}.{a}()")
+                    elif a not in ("config",):
+                        note("jax-dispatch", sub, f"{recv.id}.{a}()")
+                elif rb == "time" and a == "sleep":
+                    note("blocking-io", sub, "time.sleep()")
+                elif rb == "select" and a == "select":
+                    note("blocking-io", sub, "select.select()")
+                elif rb == "subprocess" and a in _SUBPROCESS_FNS:
+                    note("spawn", sub, f"subprocess.{a}")
+                elif rb == "os" and a in _RAW_OS_FNS:
+                    note("raw-fs-write", sub, f"os.{a}()")
+                elif rb == "os" and a in _OS_SPAWN_FNS:
+                    note("spawn", sub, f"os.{a}()")
+                elif rb == "os" and a == "getenv":
+                    note("env-read", sub, "os.getenv()")
+                elif rb == "shutil" and a in _SHUTIL_WRITE_FNS:
+                    note("raw-fs-write", sub, f"shutil.{a}()")
+                elif (rb in ("numpy", "json", "pickle")
+                      or rb.startswith("numpy.")) and (
+                    a in _NP_SAVE_FNS or a == "dump"
+                ):
+                    note("raw-fs-write", sub, f"{recv.id}.{a}()")
+                elif (rb.startswith(_DURABLE_MODULE_PREFIXES)
+                      and a in _DURABLE_FNS):
+                    note("durable-write", sub, f"{recv.id}.{a}()")
+                elif (rb.endswith("resilience.faults")
+                      or rb.endswith(".faults")) and a == "inject":
+                    note("fault-point", sub, "faults.inject()")
+            if _is_os_environ(scan, recv) \
+                    and a in ("get", "pop", "setdefault"):
+                note("env-read", sub, f"os.environ.{a}()")
+            if a == "acquire":
+                note("lock-acquire", sub, ".acquire()")
+            elif a == "block_until_ready":
+                note("jax-dispatch", sub, ".block_until_ready()")
+            elif a in _BLOCKING_METHODS:
+                note("blocking-io", sub, f".{a}()")
+    return out
+
+
+def scan_package(root: str,
+                 package_dir: Optional[str] = None) -> _EffectGraph:
+    package_dir = package_dir or os.path.join(root, "tsspark_tpu")
+    scans: List[_ModuleScan] = []
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            try:
+                with open(path, "r") as fh:
+                    source = fh.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError):
+                continue   # tracelint owns parse-error findings
+            scan = _ModuleScan(os.path.relpath(path, root), tree, source)
+            _walk_functions(scan)
+            scans.append(scan)
+    return _EffectGraph(scans)
+
+
+# ---------------------------------------------------------------------------
+# path budgets
+# ---------------------------------------------------------------------------
+
+def _check_budgets(graph: _EffectGraph, config: EffectsConfig,
+                   findings: List[Finding]) -> None:
+    for budget in config.paths:
+        cuts: Set[Tuple[str, str]] = set()
+        for ref in budget.allow_via:
+            key = _parse_ref(ref, f"budget {budget.name!r}")
+            if key not in graph.info_of:
+                findings.append(Finding(
+                    "effect-model", "pyproject.toml", 0, budget.name,
+                    f"allow_via {ref!r} matches no package function — "
+                    "a stale cut point must die with the code it "
+                    "excused",
+                ))
+            cuts.add(key)
+        forbid = set(budget.forbid)
+        for ref in budget.roots:
+            root_key = _parse_ref(ref, f"budget {budget.name!r}")
+            if root_key not in graph.info_of:
+                findings.append(Finding(
+                    "effect-model", "pyproject.toml", 0, budget.name,
+                    f"root {ref!r} matches no package function — a "
+                    "budget checking nothing passes vacuously",
+                ))
+                continue
+            # BFS from the root, skipping declared cut points, with
+            # parent pointers for the reported chain.
+            parent: Dict[Tuple[str, str], Tuple[str, str]] = {}
+            seen = {root_key}
+            frontier = [root_key]
+            while frontier:
+                cur = frontier.pop(0)
+                hit = forbid & set(graph.base.get(cur, ()))
+                for eff in sorted(hit):
+                    line, detail = graph.base[cur][eff]
+                    chain: List[str] = []
+                    k = cur
+                    while k in parent:
+                        chain.append(k[1])
+                        k = parent[k]
+                    chain.append(root_key[1])
+                    scan = graph.scan_of[cur[0]]
+                    if not scan.line_ok(line, "effect-budget"):
+                        findings.append(Finding(
+                            "effect-budget", cur[0], line, cur[1],
+                            f"path {budget.name!r} must not reach "
+                            f"{eff!r} but does ({detail}) via "
+                            + " <- ".join(chain),
+                        ))
+                for nxt in sorted(graph.succ.get(cur, ())):
+                    if nxt not in seen and nxt not in cuts:
+                        seen.add(nxt)
+                        parent[nxt] = cur
+                        frontier.append(nxt)
+
+
+# ---------------------------------------------------------------------------
+# env-var contract
+# ---------------------------------------------------------------------------
+
+def _resolve_env_arg(graph: _EffectGraph, scan: _ModuleScan,
+                     node: ast.AST) -> Optional[str]:
+    """The env-var NAME an expression denotes: a literal, a module
+    constant, or an imported module's constant (``faults.ENV_VAR``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return graph.constants.get(scan.relpath, {}).get(node.id)
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name):
+        b = _binding(scan, node.value.id)
+        if b is not None:
+            other = graph.by_dotted.get(b)
+            if other is not None:
+                return graph.constants.get(other.relpath,
+                                           {}).get(node.attr)
+    return None
+
+
+def _env_read_sites(graph: _EffectGraph, scan: _ModuleScan
+                    ) -> List[Tuple[int, str, str]]:
+    """(line, var, qualname) for every resolvable env READ in the
+    module — module-level code included (qualname ``<module>``)."""
+    sites: List[Tuple[int, str, str]] = []
+
+    def visit(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            cq = qual
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                cq = f"{qual}.{child.name}" if qual != "<module>" \
+                    else child.name
+            elif isinstance(child, ast.ClassDef):
+                cq = f"{qual}.{child.name}" if qual != "<module>" \
+                    else child.name
+            arg = None
+            if isinstance(child, ast.Subscript) \
+                    and isinstance(child.ctx, ast.Load) \
+                    and _is_os_environ(scan, child.value):
+                arg = child.slice
+            elif isinstance(child, ast.Call):
+                f = child.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("get", "pop", "setdefault") \
+                        and _is_os_environ(scan, f.value):
+                    arg = child.args[0] if child.args else None
+                elif isinstance(f, ast.Attribute) and f.attr == "getenv" \
+                        and isinstance(f.value, ast.Name) \
+                        and _binding(scan, f.value.id) == "os":
+                    arg = child.args[0] if child.args else None
+            elif isinstance(child, ast.Compare) and any(
+                _is_os_environ(scan, c) for c in child.comparators
+            ):
+                arg = child.left
+            if arg is not None:
+                var = _resolve_env_arg(graph, scan, arg)
+                if var is not None:
+                    sites.append((child.lineno, var, qual))
+            visit(child, cq)
+
+    visit(scan.tree, "<module>")
+    return sites
+
+
+def _inherit_all_builders(graph: _EffectGraph) -> Set[str]:
+    """Simple names of functions that RETURN an environment seeded from
+    the parent's (``env = dict(os.environ) ... return env``) — the
+    ``_child_env`` idiom every spawn site routes through."""
+    builders: Set[str] = set()
+    for key, info in graph.info_of.items():
+        seeded: Set[str] = set()
+        returned = False
+        for sub in ast.walk(info.node):
+            if isinstance(sub, ast.Assign) \
+                    and _seeds_from_environ(graph.scan_of[key[0]],
+                                            sub.value):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        seeded.add(t.id)
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                if isinstance(sub.value, ast.Name) \
+                        and sub.value.id in seeded:
+                    returned = True
+                elif _seeds_from_environ(graph.scan_of[key[0]],
+                                         sub.value):
+                    returned = True
+        if returned:
+            builders.add(key[1].rsplit(".", 1)[-1])
+    return builders
+
+
+def _seeds_from_environ(scan: _ModuleScan, value: ast.AST) -> bool:
+    """``dict(os.environ)`` / ``os.environ.copy()`` / ``{**os.environ}``."""
+    if isinstance(value, ast.Call):
+        f = value.func
+        if isinstance(f, ast.Name) and f.id == "dict" and value.args \
+                and _is_os_environ(scan, value.args[0]):
+            return True
+        if isinstance(f, ast.Attribute) and f.attr == "copy" \
+                and _is_os_environ(scan, f.value):
+            return True
+    if isinstance(value, ast.Dict):
+        return any(k is None and _is_os_environ(scan, v)
+                   for k, v in zip(value.keys, value.values))
+    return False
+
+
+def _check_env_contract(graph: _EffectGraph, config: EffectsConfig,
+                        scope_rel: Optional[Set[str]],
+                        findings: List[Finding], root: str) -> None:
+    registered = {spec.var: spec for spec in config.env}
+    inherited = sorted(v for v, s in registered.items() if s.inherit)
+    builders = _inherit_all_builders(graph)
+    seen_vars: Set[str] = set()
+
+    for scan in graph.scans:
+        in_scope = scope_rel is None or scan.relpath in scope_rel
+        for line, var, qual in _env_read_sites(graph, scan):
+            if not var.startswith("TSSPARK_"):
+                continue
+            seen_vars.add(var)
+            if var not in registered and in_scope \
+                    and not scan.line_ok(line, "env-unregistered"):
+                findings.append(Finding(
+                    "env-unregistered", scan.relpath, line, qual,
+                    f"reads {var!r}, which is not in the EnvSpec table "
+                    "([tool.tsspark.analysis.effects.env]): register "
+                    "its owner and child-propagation rule",
+                ))
+        if not in_scope:
+            continue
+        for key, info in graph.info_of.items():
+            if key[0] != scan.relpath:
+                continue
+            for sub in ast.walk(info.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                is_spawn = (
+                    (isinstance(f, ast.Attribute)
+                     and f.attr in _SUBPROCESS_FNS
+                     and isinstance(f.value, ast.Name)
+                     and _binding(scan, f.value.id) == "subprocess")
+                    or (isinstance(f, ast.Name)
+                        and (_binding(scan, f.id) or "")
+                        .startswith("subprocess."))
+                )
+                if not is_spawn:
+                    continue
+                env_kw = next((kw.value for kw in sub.keywords
+                               if kw.arg == "env"), None)
+                if env_kw is None:
+                    continue   # child inherits the whole parent env
+                if _env_provably_inherits(graph, scan, info, env_kw,
+                                          builders):
+                    continue
+                if not scan.line_ok(sub.lineno, "env-propagation"):
+                    findings.append(Finding(
+                        "env-propagation", scan.relpath, sub.lineno,
+                        key[1],
+                        "spawn passes env= not provably seeded from "
+                        "os.environ; inherited EnvSpecs would be "
+                        f"dropped ({', '.join(inherited) or 'none'}) — "
+                        "seed with dict(os.environ) or a _child_env "
+                        "builder",
+                    ))
+
+    if scope_rel is None:
+        for var, spec in sorted(registered.items()):
+            if var not in seen_vars:
+                findings.append(Finding(
+                    "env-unused", "pyproject.toml", 0, var,
+                    "EnvSpec registers a variable nothing reads — a "
+                    "stale spec must die with the read it covered "
+                    f"(declared owner: {spec.owner})",
+                ))
+            elif not os.path.exists(os.path.join(root, spec.owner)):
+                findings.append(Finding(
+                    "effect-model", "pyproject.toml", 0, var,
+                    f"EnvSpec owner {spec.owner!r} does not exist",
+                ))
+
+
+def _env_provably_inherits(graph: _EffectGraph, scan: _ModuleScan,
+                           info, env_kw: ast.AST,
+                           builders: Set[str]) -> bool:
+    def is_builder_call(value: ast.AST) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        f = value.func
+        name = (f.attr if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else None)
+        return name in builders
+
+    if _seeds_from_environ(scan, env_kw) or is_builder_call(env_kw):
+        return True
+    if isinstance(env_kw, ast.Name):
+        for sub in ast.walk(info.node):
+            if isinstance(sub, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == env_kw.id
+                for t in sub.targets
+            ):
+                if _seeds_from_environ(scan, sub.value) \
+                        or is_builder_call(sub.value):
+                    return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# fault-point scoping
+# ---------------------------------------------------------------------------
+
+def _check_fault_scope(graph: _EffectGraph, config: EffectsConfig,
+                       scope_rel: Optional[Set[str]],
+                       findings: List[Finding]) -> None:
+    declared = set(config.fault_modules)
+    firing: Set[str] = set()
+    for key, effects in graph.base.items():
+        if "fault-point" not in effects:
+            continue
+        rel = key[0].replace(os.sep, "/")
+        firing.add(rel)
+        if rel in declared or rel.endswith("resilience/faults.py"):
+            continue
+        if scope_rel is not None and key[0] not in scope_rel:
+            continue
+        line, detail = graph.base[key]["fault-point"]
+        if not graph.scan_of[key[0]].line_ok(line, "fault-scope"):
+            findings.append(Finding(
+                "fault-scope", key[0], line, key[1],
+                f"{detail} in a module not declared in fault_modules "
+                "([tool.tsspark.analysis.effects]): armable kill "
+                "points must be a reviewed, enumerable surface",
+            ))
+    if scope_rel is None:
+        for rel in sorted(declared - firing):
+            findings.append(Finding(
+                "effect-model", "pyproject.toml", 0, rel,
+                "fault_modules declares a module with no "
+                "faults.inject site — a stale declaration must die "
+                "with the kill point it covered",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def check_effects(
+    root: str,
+    config: Optional[EffectsConfig] = None,
+    scope_paths: Optional[Sequence[str]] = None,
+    package_dir: Optional[str] = None,
+) -> List[Finding]:
+    """The whole effects pass.  ``scope_paths`` (the ``--changed`` fast
+    mode) narrows the per-site rules (env-unregistered,
+    env-propagation, fault-scope) to the touched modules; the path
+    budgets and the EnvSpec/fault tables are ALWAYS checked whole —
+    a one-module edit can put a forbidden effect within reach of a
+    root defined elsewhere, which is exactly what a path budget is
+    for."""
+    config = config if config is not None else load_config(root)
+    graph = scan_package(root, package_dir)
+    scope_rel: Optional[Set[str]] = None
+    if scope_paths is not None:
+        scope_rel = {os.path.relpath(p, root) for p in scope_paths}
+    findings: List[Finding] = []
+    _check_budgets(graph, config, findings)
+    _check_env_contract(graph, config, scope_rel, findings, root)
+    _check_fault_scope(graph, config, scope_rel, findings)
+    return findings

@@ -49,6 +49,18 @@ from tsspark_tpu.analysis.findings import Finding
 
 _INLINE_OK = re.compile(r"#\s*lint-ok\[(?P<rule>[a-z0-9-]+)\]\s*:\s*\S")
 
+#: (relpath, lineno, rule) triples for every inline waiver that
+#: actually suppressed a finding during this process's checker runs.
+#: ``line_ok`` is called exactly when a finding is about to be emitted,
+#: so a site absent from this set after a full pass is a waiver
+#: excusing nothing — the stale-waiver checker's raw material.  All
+#: checkers built on ``_ModuleScan`` (trace, concur, effects) feed it.
+WAIVER_HITS: Set[Tuple[str, int, str]] = set()
+
+
+def reset_waiver_hits() -> None:
+    WAIVER_HITS.clear()
+
 # Value accessors that are STATIC under tracing (reading them off a
 # tracer yields a concrete Python value at trace time, no sync).
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "_fields", "sharding"}
@@ -195,7 +207,9 @@ class _ModuleScan:
     def line_ok(self, lineno: int, rule: str) -> bool:
         if 1 <= lineno <= len(self.lines):
             m = _INLINE_OK.search(self.lines[lineno - 1])
-            return bool(m and m.group("rule") == rule)
+            if m and m.group("rule") == rule:
+                WAIVER_HITS.add((self.relpath, lineno, rule))
+                return True
         return False
 
 

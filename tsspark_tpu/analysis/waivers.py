@@ -1,0 +1,117 @@
+"""Stale-waiver detection: waivers must die with the code they excuse.
+
+Both waiver mechanisms — the inline ``# lint-ok[rule]: reason`` comment
+and the pyproject suppression baseline — are REVIEWED exceptions.  An
+exception that no longer suppresses anything is worse than dead code:
+it reads as "this risk is acknowledged here" while the risk has moved
+or vanished, and it will silently excuse the NEXT finding that happens
+to land on its line.  So on every full gate pass, a waiver matching no
+finding is itself a ``stale-waiver`` finding.
+
+Mechanism: ``tracelint._ModuleScan.line_ok`` — the single choke point
+through which trace, concur, AND effects consult inline waivers — now
+records every (relpath, line, rule) it actually matched into
+``tracelint.WAIVER_HITS``.  ``line_ok`` is only ever called at the
+moment a finding is about to be emitted, so consumed == suppressed a
+real finding; after a full pass, every tokenizer-discovered waiver
+site absent from the hit set is stale.  Baseline entries are simpler:
+``apply_suppressions`` already returns the findings each key absorbed,
+so a key absorbing zero is stale.
+
+The check only runs on FULL passes (no ``--changed`` scope, all
+default checkers): on a scoped run most waivers legitimately go
+unconsulted, and flagging them would teach operators to ignore the
+rule.  Like every other rule, ``stale-waiver`` findings can themselves
+be baseline-suppressed (e.g. a waiver kept deliberately across a
+refactor window) — but not inline-waived, which would be turtles all
+the way down.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tokenize
+from typing import Iterable, List, Set, Tuple
+
+from tsspark_tpu.analysis import tracelint
+from tsspark_tpu.analysis.findings import Finding
+
+
+def inline_waiver_sites(package_dir: str,
+                        root: str) -> List[Tuple[str, int, str]]:
+    """Every ``# lint-ok[rule]:`` comment in the package as (relpath,
+    line, rule), via the tokenizer (same discipline as the report's
+    waiver census: comments only, no string-literal false hits)."""
+    sites: List[Tuple[str, int, str]] = []
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            relpath = os.path.relpath(path, root)
+            try:
+                with open(path, "r") as fh:
+                    source = fh.read()
+                tokens = tokenize.generate_tokens(
+                    io.StringIO(source).readline
+                )
+                for tok in tokens:
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = tracelint._INLINE_OK.search(tok.string)
+                    if m:
+                        sites.append(
+                            (relpath, tok.start[0], m.group("rule"))
+                        )
+            except (OSError, tokenize.TokenizeError, SyntaxError):
+                continue
+    return sites
+
+
+def check_stale(
+    package_dir: str,
+    root: str,
+    consumed_inline: Set[Tuple[str, int, str]],
+    suppression_keys: Iterable[Tuple[str, str, str]],
+    raw_findings: Iterable[Finding],
+) -> List[Finding]:
+    """``stale-waiver`` findings for (a) inline waiver sites that
+    suppressed nothing this pass, (b) baseline suppression keys that
+    matched zero raw findings.  Call AFTER all checkers ran so
+    ``consumed_inline`` (normally ``tracelint.WAIVER_HITS``) is
+    complete."""
+    findings: List[Finding] = []
+    for relpath, line, rule in sorted(inline_waiver_sites(package_dir,
+                                                          root)):
+        if (relpath, line, rule) not in consumed_inline:
+            findings.append(Finding(
+                "stale-waiver", relpath, line, "<inline>",
+                f"lint-ok[{rule}] waiver suppressed no finding this "
+                "pass — waivers must die with the code they excuse",
+            ))
+    matched = {(f.rule, f.path, f.qualname) for f in raw_findings}
+    keys = list(suppression_keys)
+
+    def flag(rule: str, relpath: str, qualname: str) -> None:
+        findings.append(Finding(
+            "stale-waiver", relpath, 0, qualname,
+            f"baseline suppression for {rule!r} matches no finding — "
+            "remove the entry from [tool.tsspark.analysis] "
+            "suppressions",
+        ))
+
+    # Ordinary keys first; keys suppressing stale-waiver findings are
+    # judged against the stale findings built just above (a baseline
+    # entry keeping a known-stale waiver alive across a refactor
+    # window is consumed by the very finding it absorbs).
+    for rule, relpath, qualname in keys:
+        if rule != "stale-waiver" \
+                and (rule, relpath, qualname) not in matched:
+            flag(rule, relpath, qualname)
+    stale_keys = {(f.rule, f.path, f.qualname) for f in findings}
+    for rule, relpath, qualname in keys:
+        if rule == "stale-waiver" \
+                and (rule, relpath, qualname) not in stale_keys:
+            flag(rule, relpath, qualname)
+    return findings

@@ -17,6 +17,12 @@ with the three things a raw helper cannot give:
     environment-armed ``DiskBudget`` consulted before every
     version-producing write under its root.
 
+The effect gate (``analysis/effects.py``) treats this module (with
+``utils/atomic.py``) as the durable choke point: raw filesystem writes
+HERE classify as ``durable-write``, anywhere else as ``raw-fs-write``
+— so a path budget forbidding durable writes catches bypasses and
+sanctioned writes alike, attributed correctly.
+
 The wrappers keep the exact NAMES of the ``utils.atomic`` helpers
 (``atomic_write``, ``atomic_write_text``, ``append_line``,
 ``sweep_stale_temps``) so the ``fileproto`` static checker's

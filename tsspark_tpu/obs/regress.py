@@ -124,6 +124,11 @@ DEFAULT_SLO: Dict[str, Any] = {
                                     "max_rise_frac": 0.05,
                                     "slack_abs": 0.2},
         },
+        "analysis": {
+            "findings": {"direction": "lower", "max_rise_abs": 0.0},
+            "wall_s": {"direction": "lower", "max_rise_frac": 1.0,
+                       "slack_abs": 30.0},
+        },
     },
 }
 

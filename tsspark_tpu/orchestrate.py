@@ -1603,7 +1603,13 @@ def tunnel_preflight(timeout: float = 90.0) -> bool:
 def _child_env(force_cpu: bool = False) -> dict:
     """Child env: the package's parent dir prepended to PYTHONPATH (the
     ``-m`` entry must resolve tsspark_tpu) WITHOUT clobbering existing
-    entries — the TPU plugin may live on PYTHONPATH too."""
+    entries — the TPU plugin may live on PYTHONPATH too.
+
+    Seeding from ``dict(os.environ)`` is load-bearing: the effect
+    gate's env-propagation rule requires every spawn site to forward
+    the inherited ``EnvSpec`` variables (``TSSPARK_FAULTS``,
+    ``TSSPARK_DISK_BUDGET_*``, ``TSSPARK_TRACE``, ...), and recognizes
+    this builder by exactly that seed."""
     env = dict(os.environ)
     parts = [_REPO_ROOT] + (
         [env["PYTHONPATH"]] if env.get("PYTHONPATH") else []

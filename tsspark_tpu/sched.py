@@ -562,7 +562,10 @@ class RefitScheduler:
         """No new deltas: re-probe any stranded freshness, refresh the
         speculative warm prep, then sleep.  NEVER publishes — a
         zero-delta idle tick must not grow the registry, the snapshot
-        dir, or RUNHISTORY (pinned by tests/test_sched.py)."""
+        dir, or RUNHISTORY (pinned by tests/test_sched.py, and by the
+        ``sched-idle`` effect budget: no durable or raw write is
+        reachable from here outside the declared spill/reap/re-probe
+        cut points, so mispredicted speculation is free to abandon)."""
         if (self._pending and self._pub_thread is None
                 and self._head_version is not None
                 and min(self._pending) <= (self._head_stamp or 0)

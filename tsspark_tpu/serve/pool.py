@@ -244,6 +244,9 @@ class _Replica:
         )
 
     def _heartbeat(self) -> None:
+        # Root of the `serve-threads` effect budget: this thread may
+        # touch the filesystem and the lease, but never the device —
+        # no jax-dispatch/compile anywhere in its reach.
         hb = _hb_path(self.pool_dir, self.slot)
         m_shed = METRICS.gauge("tsspark_pool_replica_shed",
                                replica=str(self.slot))
@@ -332,7 +335,12 @@ class _Replica:
     def _respond_forecast(self, rid, expect, pend) -> Dict:
         """Resolve one pending forecast into a response line, enforcing
         the lease fence and the front's version expectation AT RESPOND
-        TIME (the analog of the fit worker's save-time fence)."""
+        TIME (the analog of the fit worker's save-time fence).
+
+        This is a root of the ``serve-respond`` effect budget
+        (pyproject ``[tool.tsspark.analysis.effects]``): nothing
+        reachable from here may compile, touch durable storage, or
+        spawn — the gate proves it on every commit."""
         import numpy as np
 
         from tsspark_tpu.serve.registry import RegistryError

@@ -313,6 +313,9 @@ class ParamRegistry:
             fh.close()
 
     # -- queries ---------------------------------------------------------------
+    # Roots of the `registry-read` effect budget: nothing reachable
+    # from these may write (publish/activate/rollback are the only
+    # writers) — a reader polling versions must never mutate the store.
 
     def manifest_key(self) -> Optional[Tuple[int, int, int]]:
         """Cheap change detector for the manifest ((ino, mtime_ns,
