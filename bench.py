@@ -494,6 +494,19 @@ def main() -> None:
                          "steady-state data-to-forecast freshness "
                          "p50/p95 (docs/PERF.md \"Continuous refit & "
                          "freshness\"); emits BENCH_freshness_*")
+    ap.add_argument("--serveplane", nargs="?", const=48, default=None,
+                    type=int, metavar="N_SERIES",
+                    help="forecast-plane serve benchmark "
+                         "(tsspark_tpu.serve.planebench): hot-read "
+                         "req/s served from the materialized plane vs "
+                         "the compute path, the zero-dispatch read "
+                         "p50/p99, and 1-replica TTFR cold vs "
+                         "AOT-bank-warmed; emits BENCH_serveplane_* "
+                         "judged under [tool.tsspark.slo.serve] "
+                         "(docs/SERVE.md \"Forecast plane\")")
+    ap.add_argument("--serveplane-requests", type=int, default=2000,
+                    help="--serveplane: hot reads through the plane "
+                         "engine (the dispatch arm replays 1/8th)")
     ap.add_argument("--reuse-cold", default=None, metavar="DIR",
                     help="for --delta/--freshness: reuse (or record) "
                          "the cold fit+publish reference under DIR so "
@@ -523,6 +536,23 @@ def main() -> None:
             reuse_cold=args.reuse_cold,
         )
         sys.exit(0 if refit.sweep_ok(reports) else 1)
+    if args.serveplane:
+        # Same device pinning as `python -m tsspark_tpu.serve`: the
+        # serve bench must never block on a wedged accelerator tunnel.
+        if os.environ.get("TSSPARK_SERVE_DEVICE", "cpu") == "cpu":
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        import argparse as _argparse
+
+        from tsspark_tpu.serve import planebench
+
+        sys.exit(planebench.run_serveplane_bench(_argparse.Namespace(
+            series=args.serveplane,
+            requests=args.serveplane_requests,
+            seed=0, dir=None, report=None, data_root=None,
+        )))
     if args.freshness:
         from tsspark_tpu.resident import force_virtual_host_mesh
 

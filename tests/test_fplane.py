@@ -1,0 +1,270 @@
+"""Materialized forecast plane (tsspark_tpu/serve/fplane.py,
+docs/SERVING.md "Forecast plane"): full-grid bitwise parity of
+plane-served vs engine-computed forecasts, delta copy-forward flips,
+torn-publish rejection + compute fallback + bitwise-equal retry, and
+the coverage rules (sampled and long-tail requests stay on compute)."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.resilience import FaultPlan, faults
+from tsspark_tpu.serve import (
+    ForecastCache,
+    ParamRegistry,
+    PredictionEngine,
+    fplane,
+)
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+)
+SOLVER = SolverConfig(max_iters=25)
+HOT = fplane.DEFAULT_HOT_HORIZONS
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(7)
+    t = np.arange(150.0)
+    y = (10 + 0.02 * t[None, :] + np.sin(2 * np.pi * t[None, :] / 7)
+         + rng.normal(0, 0.1, (6, 150)))
+    backend = get_backend("tpu", CFG, SOLVER)
+    state = backend.fit(t, jnp.asarray(y))
+    return backend, state, [f"s{i}" for i in range(6)]
+
+
+def _registry(tmp_path, fitted):
+    backend, state, ids = fitted
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG)
+    reg.publish(state, ids, step=np.ones(len(ids)))
+    return reg
+
+
+def _forecasts(engine, ids, horizons=HOT):
+    return {h: engine.forecast(list(ids), int(h), num_samples=0, seed=0)
+            for h in horizons}
+
+
+def _assert_bitwise(got, want):
+    for h in want:
+        np.testing.assert_array_equal(got[h].ds, want[h].ds)
+        assert set(got[h].values) == set(want[h].values)
+        for k in want[h].values:
+            np.testing.assert_array_equal(
+                got[h].values[k], want[h].values[k], err_msg=f"h={h} {k}"
+            )
+
+
+def test_bucket_ladder():
+    assert fplane.bucket_ladder(HOT) == (8, 16, 32)
+    assert fplane.bucket_ladder((3,)) == (8,)
+    assert fplane.bucket_ladder((9, 16, 17)) == (16, 32)
+
+
+def test_plane_columns_bitwise_equal_direct_predict(tmp_path, fitted):
+    """THE plane pin, full grid: every (series, bucket, key) cell of a
+    published plane is bitwise a direct backend.predict over the same
+    snapshot rows — the publisher's chunked/padded batch compute is
+    invisible in the bytes."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    pub = fplane.maybe_publish(reg, 1, backend)
+    assert pub["status"] == "published" and pub["buckets"] == [8, 16, 32]
+    view = fplane.attach(reg.version_dir(1))
+    snap = reg.load()
+    sub, step = snap.take(np.arange(len(ids)))
+    for hb in view.buckets:
+        grid = fplane.future_grid(sub, step, hb)
+        direct = backend.predict(sub, grid, num_samples=0)
+        for k in fplane.POINT_KEYS:
+            np.testing.assert_array_equal(
+                np.asarray(view.columns[hb][k]), np.asarray(direct[k]),
+                err_msg=f"hb={hb} {k}",
+            )
+    # plane_rows serves arbitrary row subsets with the recomputed ds
+    # grid, bitwise the gathered direct rows.
+    idx = np.asarray([3, 0, 5])
+    rows = fplane.plane_rows(view, snap, idx, 8)
+    sub2, step2 = snap.take(idx)
+    grid2 = fplane.future_grid(sub2, step2, 8)
+    direct2 = backend.predict(sub2, grid2, num_samples=0)
+    for i in range(len(idx)):
+        np.testing.assert_array_equal(rows[i]["ds"], grid2[i])
+        for k in fplane.POINT_KEYS:
+            np.testing.assert_array_equal(
+                rows[i][k], np.asarray(direct2[k])[i]
+            )
+
+
+def test_engine_plane_serves_bitwise_vs_compute_full_grid(tmp_path,
+                                                          fitted):
+    """Plane-served engine answers equal the forced-compute engine's
+    across the full hot grid, and actually come from the plane."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert fplane.maybe_publish(reg, 1, backend)["status"] == "published"
+
+    eng_plane = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_disp = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_disp._planes = {1: None}  # force the compute path
+    got = _forecasts(eng_plane, ids)
+    want = _forecasts(eng_disp, ids)
+    _assert_bitwise(got, want)
+    assert eng_plane.stats.plane_hits == len(ids) * len(HOT)
+    assert eng_plane.stats.dispatches == 0
+    assert eng_disp.stats.plane_hits == 0
+    assert eng_disp.stats.dispatches > 0
+
+
+def test_engine_plane_coverage_rules(tmp_path, fitted):
+    """Sampled requests and horizons past the plane's ladder stay on
+    the compute path — the plane covers deterministic hot reads only."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert fplane.maybe_publish(reg, 1, backend)
+    eng = PredictionEngine(reg, cache=ForecastCache(0))
+    sampled = eng.forecast(ids[:2], 7, num_samples=20, seed=3)
+    assert sampled.values["yhat"].shape == (2, 7)
+    long_tail = eng.forecast(ids[:2], 60, num_samples=0, seed=0)
+    assert long_tail.values["yhat"].shape == (2, 60)
+    assert eng.stats.plane_hits == 0
+    assert eng.stats.dispatches > 0
+    hot = eng.forecast(ids[:2], 7, num_samples=0, seed=0)
+    assert hot.values["yhat"].shape == (2, 7)
+    assert eng.stats.plane_hits == 2
+
+
+def test_delta_copy_forward_plane_flip(tmp_path, fitted):
+    """Delta flip: unchanged rows' plane cells are bitwise the BASE
+    plane's (copy-forward, no recompute), changed rows are bitwise a
+    fresh compute over the new snapshot, and the engine serves the
+    delta version's plane bitwise vs its compute path."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert fplane.maybe_publish(reg, 1, backend)["status"] == "published"
+    base_view = fplane.attach(reg.version_dir(1))
+
+    snap1 = reg.load()
+    changed = np.asarray([1, 3])
+    sub, step_sub = snap1.take(changed)
+    refit = sub._replace(theta=np.asarray(sub.theta) * 1.02)
+    v2 = reg.publish_delta(refit, changed.tolist(), step_sub=step_sub)
+    pub = fplane.maybe_publish(reg, v2, backend)
+    assert pub["status"] == "published-delta"
+
+    view2 = fplane.attach(reg.version_dir(v2))
+    snap2 = reg.load()
+    assert snap2.version == v2
+    unchanged = np.asarray([0, 2, 4, 5])
+    sub_ch, step_ch = snap2.take(changed)
+    for hb in view2.buckets:
+        grid = fplane.future_grid(sub_ch, step_ch, hb)
+        direct = backend.predict(sub_ch, grid, num_samples=0)
+        for k in fplane.POINT_KEYS:
+            np.testing.assert_array_equal(
+                np.asarray(view2.columns[hb][k])[unchanged],
+                np.asarray(base_view.columns[hb][k])[unchanged],
+                err_msg=f"copy-forward hb={hb} {k}",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(view2.columns[hb][k])[changed],
+                np.asarray(direct[k]),
+                err_msg=f"changed hb={hb} {k}",
+            )
+        # The perturbed rows really moved (yhat only: the additive-only
+        # config keeps the multiplicative column identically zero).
+        assert not np.array_equal(
+            np.asarray(view2.columns[hb]["yhat"])[changed],
+            np.asarray(base_view.columns[hb]["yhat"])[changed],
+        )
+    eng_plane = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_disp = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_disp._planes = {v2: None}
+    _assert_bitwise(_forecasts(eng_plane, ids),
+                    _forecasts(eng_disp, ids))
+    assert eng_plane.stats.plane_hits > 0
+
+
+def test_torn_publish_rejected_fallback_then_bitwise_retry(
+        tmp_path, fitted, monkeypatch):
+    """The torn-forecast-plane contract, in process: a publish killed
+    mid-column (armed ``fplane_publish`` fault) leaves a plane the CRC
+    sentinel REJECTS; the engine serves through compute — bitwise the
+    pre-tear answers, never an outage — and the retried publish lands a
+    plane whose served rows are bitwise the compute path's."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    vdir = reg.version_dir(1)
+    eng = PredictionEngine(reg, cache=ForecastCache(0))
+    ref = _forecasts(eng, ids)  # no plane yet: pure compute reference
+
+    plan = FaultPlan(state_dir=str(tmp_path / "faults"))
+    plan.fail("fplane_publish", after=3, mode="raise", tag="torn-fplane")
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    with pytest.raises(faults.FaultInjected):
+        fplane.write_plane(vdir, reg.load(), backend)
+    monkeypatch.delenv(faults.ENV_VAR)
+
+    assert not fplane.has_plane(vdir)          # sentinel never landed
+    assert not fplane.verify_plane(vdir)
+    with pytest.raises(fplane.ForecastPlaneError) as e:
+        fplane.attach(vdir)
+    assert e.value.reason == "corrupt"
+
+    eng_mid = PredictionEngine(reg, cache=ForecastCache(0))
+    mid = _forecasts(eng_mid, ids)
+    assert eng_mid.stats.plane_hits == 0
+    _assert_bitwise(mid, ref)
+
+    retry = fplane.maybe_publish(reg, 1, backend, force=True)
+    assert retry["status"] == "published"
+    assert fplane.verify_plane(vdir)
+    assert eng_mid.attach_plane(1)
+    after = _forecasts(eng_mid, ids)
+    assert eng_mid.stats.plane_hits > 0
+    _assert_bitwise(after, ref)
+
+
+def test_attach_rejects_corrupt_column(tmp_path, fitted):
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert fplane.maybe_publish(reg, 1, backend)
+    vdir = reg.version_dir(1)
+    path = os.path.join(vdir, "fcol_h8_yhat.npy")
+    mm = np.lib.format.open_memmap(path, mode="r+")
+    mm[2:3].view(np.uint32)[...] ^= np.uint32(0x5A5A5A5A)
+    mm.flush()
+    del mm
+    assert not fplane.verify_plane(vdir)
+    with pytest.raises(fplane.ForecastPlaneError) as e:
+        fplane.attach(vdir)
+    assert e.value.reason == "corrupt"
+    # The engine memoizes the rejection and serves compute — same
+    # numbers a plane-less registry would produce.
+    eng = PredictionEngine(reg, cache=ForecastCache(0))
+    res = eng.forecast(ids[:3], 7, num_samples=0, seed=0)
+    assert res.version == 1 and eng.stats.plane_hits == 0
+    eng_ref = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_ref._planes = {1: None}
+    ref = eng_ref.forecast(ids[:3], 7, num_samples=0, seed=0)
+    for k in ref.values:
+        np.testing.assert_array_equal(res.values[k], ref.values[k])
+
+
+def test_maybe_publish_idempotent_and_kill_switch(tmp_path, fitted,
+                                                  monkeypatch):
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert fplane.maybe_publish(reg, 1, backend)["status"] == "published"
+    again = fplane.maybe_publish(reg, 1, backend)
+    assert again == {"status": "present", "version": 1}
+    monkeypatch.setenv("TSSPARK_FPLANE", "0")
+    reg2 = ParamRegistry(str(tmp_path / "reg2"), CFG)
+    reg2.publish(state, ids, step=np.ones(len(ids)))
+    assert fplane.maybe_publish(reg2, 1, backend) is None
+    assert not fplane.has_plane(reg2.version_dir(1))

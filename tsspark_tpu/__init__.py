@@ -16,43 +16,54 @@ Quick start::
     fc = Forecaster(ProphetConfig(), backend="tpu")
     fc.fit(df)                       # long frame: series_id, ds, y
     out = fc.predict(horizon=28)     # long frame with yhat + intervals
-"""
 
-from tsspark_tpu.config import (
-    DAILY,
-    McmcConfig,
-    ProphetConfig,
-    RegressorConfig,
-    SeasonalityConfig,
-    ShardingConfig,
-    SolverConfig,
-    WEEKLY,
-    YEARLY,
-)
-from tsspark_tpu.backends.registry import (
-    ForecastBackend,
-    get_backend,
-    list_backends,
-    register_backend,
-)
-from tsspark_tpu.frame import Forecaster
-from tsspark_tpu.eval.diagnostics import cross_validation, performance_metrics
-from tsspark_tpu.models.holidays import (
-    Holiday,
-    add_holidays,
-    country_holidays,
-    holidays_from_df,
-)
-from tsspark_tpu.models.prophet.model import FitState, McmcState, ProphetModel
-from tsspark_tpu.models.prophet.seasonality import auto_seasonalities
-from tsspark_tpu.resilience import (
-    FaultPlan,
-    ResilienceReport,
-    ResilienceWarning,
-    RetryPolicy,
-    get_report,
-)
-from tsspark_tpu.serve import ParamRegistry, PredictionEngine
+The public names below resolve lazily (PEP 562): ``import
+tsspark_tpu.serve.replica`` must not drag in pandas/``frame``/``eval``
+— a serve replica's spawn wall is pure import time, and the forecast
+plane answers its hot reads without ever touching the fit stack, so a
+plane-covered replica pays only for the modules it actually serves
+from (the ``bench --serveplane`` TTFR numbers measure exactly this
+wall; docs/SERVING.md "AOT program bank")."""
+
+import importlib
+import importlib.util
+
+# Public name -> defining module.  Resolution imports the module on
+# first attribute access and caches the value in the package globals,
+# so repeat lookups are plain dict hits.
+_EXPORTS = {
+    "DAILY": "tsspark_tpu.config",
+    "McmcConfig": "tsspark_tpu.config",
+    "ProphetConfig": "tsspark_tpu.config",
+    "RegressorConfig": "tsspark_tpu.config",
+    "SeasonalityConfig": "tsspark_tpu.config",
+    "ShardingConfig": "tsspark_tpu.config",
+    "SolverConfig": "tsspark_tpu.config",
+    "WEEKLY": "tsspark_tpu.config",
+    "YEARLY": "tsspark_tpu.config",
+    "ForecastBackend": "tsspark_tpu.backends.registry",
+    "get_backend": "tsspark_tpu.backends.registry",
+    "list_backends": "tsspark_tpu.backends.registry",
+    "register_backend": "tsspark_tpu.backends.registry",
+    "Forecaster": "tsspark_tpu.frame",
+    "cross_validation": "tsspark_tpu.eval.diagnostics",
+    "performance_metrics": "tsspark_tpu.eval.diagnostics",
+    "Holiday": "tsspark_tpu.models.holidays",
+    "add_holidays": "tsspark_tpu.models.holidays",
+    "country_holidays": "tsspark_tpu.models.holidays",
+    "holidays_from_df": "tsspark_tpu.models.holidays",
+    "FitState": "tsspark_tpu.models.prophet.model",
+    "McmcState": "tsspark_tpu.models.prophet.model",
+    "ProphetModel": "tsspark_tpu.models.prophet.model",
+    "auto_seasonalities": "tsspark_tpu.models.prophet.seasonality",
+    "FaultPlan": "tsspark_tpu.resilience",
+    "ResilienceReport": "tsspark_tpu.resilience",
+    "ResilienceWarning": "tsspark_tpu.resilience",
+    "RetryPolicy": "tsspark_tpu.resilience",
+    "get_report": "tsspark_tpu.resilience",
+    "ParamRegistry": "tsspark_tpu.serve",
+    "PredictionEngine": "tsspark_tpu.serve",
+}
 
 __version__ = "0.4.0"
 
@@ -89,3 +100,24 @@ __all__ = [
     "performance_metrics",
     "register_backend",
 ]
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is not None:
+        value = getattr(importlib.import_module(mod), name)
+        globals()[name] = value
+        return value
+    # `tsspark_tpu.frame`-style attribute access without a prior
+    # submodule import: resolve it like the eager init used to, but
+    # only when the submodule really exists — a typo must stay an
+    # AttributeError, and a broken submodule must raise ITS error.
+    if importlib.util.find_spec(f"tsspark_tpu.{name}") is not None:
+        return importlib.import_module(f"tsspark_tpu.{name}")
+    raise AttributeError(
+        f"module 'tsspark_tpu' has no attribute {name!r}"
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -377,6 +377,41 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "npz, then the active->previous chain)",
     ),
     ArtifactSpec(
+        "forecast-plane",
+        ("fcol_", "fplane_spec.json", "fplaneok.json"),
+        ("write_plane", "write_plane_delta", "write_spec",
+         "write_column", "write_sentinel", "link_or_copy"),
+        "materialized forecast plane (serve/fplane.py): the active "
+        "version's full (series x horizon-bucket) point-forecast table "
+        "as mmap columns — spec first, one atomic .npy per (bucket, "
+        "output key), CRC sentinel LAST, the snapshot plane's exact "
+        "protocol.  Torn publishes fail the sentinel and attach() "
+        "REJECTS them; the engine then serves through its compute path "
+        "(never a wrong number, never an outage) and a retry publishes "
+        "bitwise-identical bytes.  Delta versions hardlink/copy-forward "
+        "unchanged series' columns like snapplane",
+    ),
+    ArtifactSpec(
+        "aot-bank", ("aot_bank.json",),
+        ("build_bank",),
+        "AOT program-bank manifest (serve/aotbank.py): the (width, "
+        "horizon-bucket) ladder pre-compiled into the shared JAX "
+        "persistent compilation cache at publish time, written "
+        "atomically AFTER every entry compiled; pure idempotency "
+        "record — a stale or missing manifest just means replicas "
+        "compile as before (the executables live in the cache's own "
+        "content-addressed files)",
+    ),
+    ArtifactSpec(
+        "serveplane-bench-report", ("BENCH_serveplane_",),
+        ("run_serveplane_bench",),
+        "forecast-plane serve benchmark (bench --serveplane; "
+        "serve/planebench.py): plane-vs-dispatch hot-read throughput, "
+        "plane publish wall, replica TTFR cold vs AOT-warm — written "
+        "once atomically and judged by the regression sentinel under "
+        "[tool.tsspark.slo.serve] plane budgets",
+    ),
+    ArtifactSpec(
         "scale-report", ("SCALE_",),
         ("_write_scale_report",),
         "scale-ladder rung report (tsspark_tpu.bench_scale): ingest/"
@@ -511,6 +546,9 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/perf/recorder.py",
     "tsspark_tpu/serve/registry.py",
     "tsspark_tpu/serve/snapplane.py",
+    "tsspark_tpu/serve/fplane.py",
+    "tsspark_tpu/serve/aotbank.py",
+    "tsspark_tpu/serve/planebench.py",
     "tsspark_tpu/serve/engine.py",
     "tsspark_tpu/serve/cache.py",
     "tsspark_tpu/serve/pool.py",

@@ -192,6 +192,44 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
                "registry manifest is the real flip",
     ),
     ProtocolSpec(
+        "forecast-plane",
+        "tsspark_tpu/serve/fplane.py", "write_plane",
+        steps=(
+            StepSpec("spec", "call:write_spec",
+                     reader="attach() requires spec + sentinel; a "
+                            "spec-only dir raises corrupt and the "
+                            "engine keeps its compute path"),
+            StepSpec("columns", "call:write_column",
+                     reader="forecast columns are invisible until the "
+                            "CRC sentinel lands; the fplane_publish "
+                            "fault point tears here and attach() "
+                            "rejects the plane whole"),
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
+                     certifies=("spec", "columns")),
+        ),
+        resume="a publisher killed mid-plane leaves no fplaneok.json: "
+               "the version serves through the compute path (bitwise "
+               "the same numbers) and any successor's maybe_publish "
+               "re-lands identical bytes",
+    ),
+    ProtocolSpec(
+        "forecast-plane-delta",
+        "tsspark_tpu/serve/fplane.py", "write_plane_delta",
+        steps=(
+            StepSpec("spec", "call:write_spec",
+                     reader="same attach() gate as the full plane"),
+            StepSpec("columns", "call:write_column",
+                     reader="hardlinked or scatter-patched columns are "
+                            "invisible until the recomputed-CRC "
+                            "sentinel lands"),
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
+                     certifies=("spec", "columns")),
+        ),
+        resume="the base version's plane is never touched; a torn "
+               "delta plane reads as absent/corrupt for the NEW "
+               "version only and the compute path covers it",
+    ),
+    ProtocolSpec(
         "registry-publish",
         "tsspark_tpu/serve/registry.py", "ParamRegistry.publish",
         steps=(

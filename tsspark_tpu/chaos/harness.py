@@ -1559,6 +1559,164 @@ def _run_storage_storm(scratch: str, storm: StormPlan, state, ids,
             os.environ[faults.ENV_VAR] = env_plan
 
 
+# ---------------------------------------------------------------------------
+# stage K: torn forecast plane (serve/fplane.py)
+# ---------------------------------------------------------------------------
+
+
+def _run_fplane_storm(scratch: str, storm: StormPlan, state, ids,
+                      mttr: Dict[str, Optional[float]],
+                      deadline_s: float) -> Tuple[Dict, Dict]:
+    """The torn-forecast-plane class: a publisher child is killed MID
+    forecast-plane publish (armed ``fplane_publish`` exit fault between
+    column writes — spec landed, CRC sentinel never did).  Invariants
+    (docs/SERVING.md "Forecast plane"): the sentinel REJECTS the torn
+    plane, the engine keeps answering through its compute path with
+    forecasts bitwise the direct dispatch math's (never a wrong number,
+    never an outage), the retried publish verifies clean, and the
+    plane-served rows afterwards are bitwise the fallback's answers.
+
+    Runs with the storm env plan popped: the stage's only fault is the
+    child's PRIVATE plan — an exit fault firing in-process would kill
+    the harness itself."""
+    import subprocess
+
+    from tsspark_tpu import orchestrate
+    from tsspark_tpu.backends.registry import get_backend
+    from tsspark_tpu.serve import fplane
+    from tsspark_tpu.serve.cache import ForecastCache
+    from tsspark_tpu.serve.engine import PredictionEngine
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    base = os.path.join(scratch, "fplane")
+    os.makedirs(base, exist_ok=True)
+    t0 = time.time()
+    env_plan = os.environ.pop(faults.ENV_VAR, None)
+    try:
+        cfg, solver = _config(storm.profile.max_iters)
+        registry = ParamRegistry(os.path.join(base, "registry"), cfg)
+        v1 = registry.publish(state, ids, step=np.ones(len(ids)))
+        vdir = registry.version_dir(v1)
+
+        # ---- the kill: a publisher child with fplane_publish armed --
+        inj_fp = storm.direct("torn-forecast-plane")
+        child_plan = faults.FaultPlan(
+            state_dir=os.path.join(base, "faults"))
+        child_plan.fail("fplane_publish", attempts=1,
+                        after=inj_fp.after, mode="exit", rc=inj_fp.rc,
+                        tag="torn-forecast-plane")
+        env = orchestrate._child_env()
+        env[faults.ENV_VAR] = child_plan.to_env()
+        obs.inject_env(env)
+        child = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "from tsspark_tpu.serve import fplane\n"
+             "from tsspark_tpu.serve.registry import ParamRegistry\n"
+             "reg = ParamRegistry.open(sys.argv[1])\n"
+             "fplane.maybe_publish(reg, int(sys.argv[2]))\n",
+             registry.root, str(v1)],
+            env=env, stdout=sys.stderr, timeout=deadline_s,
+        )
+        t_fault = time.time()
+        obs.event("fault", tag="torn-forecast-plane", mode="direct",
+                  rc=child.returncode)
+        fired = inv.fault_firing_times(
+            child_plan.state_dir,
+            {child_plan.rules[0]["id"]: "torn-forecast-plane"},
+            child_plan.rules,
+        ).get("torn-forecast-plane", [])
+
+        # ---- mid-tear: sentinel verdict + compute-path fallback -----
+        torn_rejected = not fplane.verify_plane(vdir)
+        engine = PredictionEngine(registry, cache=ForecastCache(0))
+        engine.refresh()
+        sids = [str(s) for s in ids[:4]]
+        horizons = fplane.DEFAULT_HOT_HORIZONS
+        fallback = {h: engine.forecast(sids, int(h), num_samples=0,
+                                       seed=0)
+                    for h in horizons}
+        stats_mid = engine.stats.snapshot()
+        outage_free = all(r.version == v1 for r in fallback.values())
+        no_plane_hits = not stats_mid.get("plane_hits")
+
+        # Wrong-number check: the fallback answers against the direct
+        # dispatch math over the same rows (the serve stage's oracle).
+        backend = get_backend("tpu", cfg, solver)
+        snap = registry.load()
+        parity = True
+        for h, res in fallback.items():
+            grid, ref = _direct_forecast(backend, snap, sids, int(h))
+            parity = (parity and np.array_equal(res.ds, grid)
+                      and all(np.array_equal(res.values[k], ref[k])
+                              for k in res.values))
+
+        # ---- retry: the in-process successor republishes ------------
+        retry = fplane.maybe_publish(registry, v1, backend,
+                                     force=True)
+        retry_ok = bool(retry and retry.get("status") == "published")
+        plane_good = fplane.verify_plane(vdir)
+        attached = engine.attach_plane(v1)
+        if plane_good:
+            mttr["torn-forecast-plane"] = time.time() - t_fault
+            obs.event("recovered", tag="torn-forecast-plane")
+        served = {h: engine.forecast(sids, int(h), num_samples=0,
+                                     seed=0)
+                  for h in horizons}
+        stats_after = engine.stats.snapshot()
+        plane_served = (stats_after.get("plane_hits") or 0) > 0
+        bitwise = all(
+            np.array_equal(served[h].ds, fallback[h].ds)
+            and all(np.array_equal(served[h].values[k],
+                                   fallback[h].values[k])
+                    for k in fallback[h].values)
+            for h in horizons
+        )
+
+        inv_fp = {
+            "ok": (child.returncode != 0 and len(fired) == 1
+                   and torn_rejected and outage_free and no_plane_hits
+                   and parity and retry_ok and plane_good
+                   and attached and plane_served and bitwise),
+            "child_rc": child.returncode,
+            "fault_fired": len(fired),
+            "sentinel_rejected_tear": torn_rejected,
+            "fallback_served_v1": outage_free,
+            "fallback_plane_hits": stats_mid.get("plane_hits"),
+            "fallback_vs_direct_bitwise": parity,
+            "retry_status": None if retry is None
+            else retry.get("status"),
+            "retry_plane_verified": plane_good,
+            "plane_served_after_retry": plane_served,
+            "plane_vs_compute_bitwise": bitwise,
+        }
+        errs = []
+        if child.returncode == 0:
+            errs.append("publisher child survived its armed "
+                        "fplane_publish exit fault")
+        if not torn_rejected:
+            errs.append("CRC sentinel accepted a torn forecast plane")
+        if not (outage_free and parity):
+            errs.append("compute fallback served a wrong number or an "
+                        "outage behind the torn plane")
+        if not bitwise:
+            errs.append("retried plane serves different bytes than "
+                        "the compute path")
+        if errs:
+            inv_fp["errors"] = errs
+        stage = {
+            "wall_s": round(time.time() - t0, 3),
+            "v1": v1,
+            "child_rc": child.returncode,
+            "kill_after_columns": inj_fp.after,
+            "retry": retry,
+        }
+        return stage, {"fplane_torn_publish": inv_fp}
+    finally:
+        if env_plan is not None:
+            os.environ[faults.ENV_VAR] = env_plan
+
+
 def run_storm(seed: int = 0, profile: str = "full",
               scratch: Optional[str] = None,
               keep_scratch: bool = False,
@@ -1838,6 +1996,14 @@ def run_storm(seed: int = 0, profile: str = "full",
                 )
             invariants.update(storage_inv)
 
+        # ---- stage K: torn forecast plane (serve/fplane.py) ----------
+        if prof.fplane_storm:
+            with obs.span("stage.fplane"):
+                stages["fplane"], fp_inv = _run_fplane_storm(
+                    scratch, storm, got_state, ids, mttr, deadline_s
+                )
+            invariants.update(fp_inv)
+
         # ---- cross-stage invariants ----------------------------------
         if out_dir is not None:
             corrupt_injected = sum(
@@ -1977,6 +2143,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "refit_series": prof.refit_series,
                 "sched_storm": prof.sched_storm,
                 "storage_storm": prof.storage_storm,
+                "fplane_storm": prof.fplane_storm,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),

@@ -203,6 +203,11 @@ class StormProfile:
     # lost-fsync against the registry's durable writes plus the
     # disk-pressure brownout driving the degradation ladder.
     storage_storm: bool = False
+    # Forecast-plane fault domain (serve/fplane.py): a publisher killed
+    # mid-plane (between column writes, sentinel never landed) — the
+    # engine must keep serving bitwise-correct forecasts through its
+    # compute path and a retry publish must land identical bytes.
+    fplane_storm: bool = False
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -250,7 +255,7 @@ PROFILES: Dict[str, StormProfile] = {
         plane_series=64, plane_shard_rows=16,
         resident_series=32, resident_chunk=8,
         refit_series=32, refit_chunk=8, refit_churn=0.25,
-        sched_storm=True, storage_storm=True,
+        sched_storm=True, storage_storm=True, fplane_storm=True,
     ),
 }
 
@@ -485,6 +490,19 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
         inj.append(Injection(
             cls="disk-pressure-brownout", stage="storage",
             point="disk-budget", mode="direct",
+        ))
+
+    # -- forecast-plane stage (the harness arms the publisher child's
+    # -- PRIVATE plan at the fplane_publish point; ``after`` picks
+    # -- which column write the kill lands between — the default hot
+    # -- ladder publishes 12 columns, so the tear always lands after
+    # -- the spec and before the sentinel) ----------------------------
+    if prof.fplane_storm:
+        inj.append(Injection(
+            cls="torn-forecast-plane", stage="fplane",
+            point="fplane_publish", mode="direct",
+            after=rng.randrange(1, 11),
+            rc=rng.choice((17, 23, 29)),
         ))
 
     # -- data-plane stage ---------------------------------------------

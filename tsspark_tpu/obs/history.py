@@ -215,10 +215,32 @@ def _serve_row(rep: Dict[str, Any]) -> Dict[str, Any]:
         for slot, st in sorted((pool.get("per_replica") or {}).items()):
             if isinstance(st, dict):
                 _put(m, f"replica{slot}_shed", st.get("shed"))
+    plane = rep.get("plane") or {}
+    if plane:
+        # Forecast-plane serve rows (bench --serveplane; docs/SERVING.md
+        # "Forecast plane"): plane hit rate and the zero-dispatch read
+        # p99 are SLO metrics ([tool.tsspark.slo.serve]); throughputs
+        # and TTFR ride along as trajectory context.
+        _put(m, "plane_hit_rate", plane.get("plane_hit_rate"))
+        _put(m, "plane_read_p99_ms",
+             (plane.get("read_latency_ms") or {}).get("p99"))
+        _put(m, "plane_requests_per_s",
+             (plane.get("hot_read") or {}).get("plane_rps"))
+        _put(m, "dispatch_requests_per_s",
+             (plane.get("hot_read") or {}).get("dispatch_rps"))
+        _put(m, "plane_publish_s", plane.get("publish_s"))
+        _put(m, "ttfr_cold_s", (plane.get("ttfr") or {}).get("cold_s"))
+        _put(m, "ttfr_aot_warm_s",
+             (plane.get("ttfr") or {}).get("aot_warm_s"))
     workload = (f"loadgen_{rep.get('n_requests')}"
                 f"x{rep.get('n_series')}")
     if pool:
         workload = f"pool{pool.get('replicas')}_{workload}"
+    if plane:
+        # Its own baseline family: a plane row's throughput/latency mix
+        # (cache-disabled hot reads) must never judge — or be judged
+        # by — an ordinary loadgen row.
+        workload = f"serveplane_{workload}"
     return {
         "kind": "serve",
         "trace_id": rep.get("trace_id"),

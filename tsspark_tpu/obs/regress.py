@@ -86,6 +86,11 @@ DEFAULT_SLO: Dict[str, Any] = {
             "failovers": {"direction": "lower", "max_rise_abs": 8},
             "flip_p99_ms": {"direction": "lower", "max_rise_frac": 1.0,
                             "slack_abs": 50.0},
+            "plane_hit_rate": {"direction": "higher",
+                               "max_drop_abs": 0.15},
+            "plane_read_p99_ms": {"direction": "lower",
+                                  "max_rise_frac": 1.0,
+                                  "slack_abs": 2.0},
         },
         "scale": {
             "rss_mb_per_replica": {"direction": "lower",

@@ -397,6 +397,22 @@ def publish_plan(
         step_sub=step_sub, data_stamp=plan["plan_stamp"],
         activate=False,
     )
+    # Forecast-plane copy-forward BEFORE the flip: unchanged series'
+    # columns hardlink/scatter from the base plane, only the refit rows
+    # recompute, and the replicas that refresh onto v_new adopt the
+    # plane immediately — hot reads stay zero-dispatch across a delta
+    # flip.  Best-effort by contract (fplane.maybe_publish sheds under
+    # disk pressure and a base without a plane publishes full); it must
+    # never fail the publish stage.
+    from tsspark_tpu.serve import fplane
+
+    try:
+        fpub = fplane.maybe_publish(registry, int(v_new),
+                                    horizons=tuple(horizons))
+    except Exception as e:
+        fpub = None
+        obs.event("fplane.publish_failed", version=int(v_new),
+                  error=repr(e))
     publish_s = round(time.time() - t0, 3)
 
     t0 = time.time()
@@ -419,6 +435,7 @@ def publish_plan(
         "flip_s": flip_s,
         "flipped": bool(pool is not None or flip_fn is not None
                         or activate),
+        "fplane": None if fpub is None else fpub.get("status"),
     }
 
 

@@ -719,6 +719,7 @@ class RefitScheduler:
                 pub = refit.publish_plan(
                     self.registry, plan, state_sub, step_sub,
                     self.scratch, flip_fn=self._flip, reap=False,
+                    horizons=self.horizons,
                 )
                 self._pub_result = dict(pub, ok=True, plan=plan,
                                         t0=t0, t1=time.time())

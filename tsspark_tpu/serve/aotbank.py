@@ -1,0 +1,193 @@
+"""AOT program bank: the serve tier's compile wall paid at publish.
+
+A cold replica's first request used to pay the full ``forecast_jit``
+compile ladder (SCALE_1m measured ``time_to_first_request_s`` = 7.466s,
+almost all compiles).  This module moves that cost to PUBLISH time: the
+flip orchestrator calls :func:`build_bank`, which walks the known
+(width, horizon-bucket) shape ladder the engine's pow-2 discipline
+produces and ``jax.jit(...).lower(...).compile()``s each program with
+the persistent JAX compilation cache armed at a shared directory.  A
+replica that arms the same directory (:func:`arm_from_env` — the
+``$TSSPARK_AOT_CACHE_DIR`` contract, inherited by pool children) then
+LOADS its first-request programs from the cache instead of compiling
+them, so cold start stops paying the wall.
+
+The bank is recorded in an ``aot_bank.json`` manifest (atomic write)
+keyed by config fingerprint + ladder, which makes :func:`build_bank`
+idempotent across flips of the same model shape — rebuilds happen only
+when the fingerprint or the ladder changes.
+
+The bank is an ACCELERATOR, never a correctness dependency: a missing
+or stale cache dir just means the replica compiles as before.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tsspark_tpu.io import atomic_write
+from tsspark_tpu.obs import context as obs
+from tsspark_tpu.parallel.sharding import next_pow2
+
+__all__ = [
+    "AOT_CACHE_ENV", "AOT_MANIFEST", "DEFAULT_WIDTHS",
+    "cache_dir_from_env", "arm", "arm_from_env", "shape_ladder",
+    "build_bank", "read_manifest",
+]
+
+#: The shared compilation-cache directory contract: set it and every
+#: process (publisher, front, replicas — children inherit the env)
+#: compiles into / loads from the same persistent cache.
+AOT_CACHE_ENV = "TSSPARK_AOT_CACHE_DIR"
+
+#: Bank manifest (written into the cache dir, atomically).
+AOT_MANIFEST = "aot_bank.json"
+
+#: Dispatch widths the engine's compaction ladder actually produces for
+#: hot traffic (``compacted_width`` floor .. a typical materialize
+#: chunk).  Widths above the snapshot's row count are skipped.
+DEFAULT_WIDTHS = (8, 16, 32, 64, 128, 256)
+
+
+def cache_dir_from_env() -> Optional[str]:
+    """The configured AOT cache directory, or None when unset."""
+    return os.environ.get(AOT_CACHE_ENV) or None
+
+
+def arm(dirpath: str) -> None:
+    """Point JAX's persistent compilation cache at ``dirpath`` with a
+    zero min-compile-time floor, so even the small serve programs
+    persist (the default 1s floor would skip exactly the programs a
+    replica's cold start pays for).
+
+    The cache singleton initializes LAZILY at the process's first
+    compile and then ignores config updates — a publisher that already
+    dispatched anything (e.g. the fit that produced the version) would
+    silently write nothing — so arming resets it when the configured
+    dir actually changed."""
+    import jax
+
+    os.makedirs(dirpath, exist_ok=True)
+    rearm = jax.config.jax_compilation_cache_dir != dirpath
+    jax.config.update("jax_compilation_cache_dir", dirpath)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if rearm:
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception:
+            pass  # older jax: the lazy init may still pick the dir up
+
+
+def arm_from_env() -> Optional[str]:
+    """Arm the cache from ``$TSSPARK_AOT_CACHE_DIR`` when set (the
+    replica/daemon entry hook).  Returns the armed dir or None."""
+    d = cache_dir_from_env()
+    if d:
+        arm(d)
+    return d
+
+
+def shape_ladder(n_series: int,
+                 horizons: Sequence[int],
+                 widths: Sequence[int] = DEFAULT_WIDTHS
+                 ) -> List[Tuple[int, int]]:
+    """The (width, horizon-bucket) pairs worth pre-compiling: the
+    engine pads widths up ``compacted_width``'s pow-2 ladder and
+    horizons up ``max(8, next_pow2(h))``, so this finite grid IS the
+    serve tier's hot program set."""
+    from tsspark_tpu.serve.fplane import bucket_ladder
+
+    cap = next_pow2(max(int(n_series), 1))
+    ws = sorted({int(w) for w in widths if int(w) <= cap} or {cap})
+    return [(w, hb) for w in ws for hb in bucket_ladder(horizons)]
+
+
+def read_manifest(dirpath: str) -> Optional[Dict]:
+    """The bank manifest in ``dirpath``, or None (absent/torn)."""
+    try:
+        with open(os.path.join(dirpath, AOT_MANIFEST)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def build_bank(snap, backend, *, dirpath: Optional[str] = None,
+               horizons: Sequence[int] = (7, 14, 28),
+               widths: Sequence[int] = DEFAULT_WIDTHS,
+               fingerprint: Optional[str] = None) -> Optional[Dict]:
+    """AOT-compile the serve program ladder against ``snap``'s
+    parameter shapes and persist the executables via the JAX
+    compilation cache in ``dirpath`` (default: the env contract; no
+    dir configured -> no-op, returns None).
+
+    Each (width, horizon-bucket) entry traces the engine's exact
+    dispatch: a ``width``-row gather, the float64 future grid, and the
+    deterministic ``forecast_jit`` program at num_samples=0 —
+    ``jit.lower(...).compile()``, so the trace happens here and the
+    replica's first request is a cache load.  Idempotent per
+    (fingerprint, ladder): an up-to-date manifest short-circuits."""
+    dirpath = dirpath or cache_dir_from_env()
+    if not dirpath:
+        return None
+    ladder = shape_ladder(
+        int(np.asarray(snap.state.theta).shape[0]), horizons, widths
+    )
+    want = {"fingerprint": fingerprint,
+            "ladder": [[w, hb] for w, hb in ladder]}
+    have = read_manifest(dirpath)
+    if have is not None \
+            and {k: have.get(k) for k in want} == want:
+        return dict(have, status="present")
+    arm(dirpath)
+    import jax
+
+    from tsspark_tpu.models.prophet import predict as predict_mod
+    from tsspark_tpu.serve.fplane import future_grid
+
+    model = getattr(backend, "_model", None)
+    if model is None:
+        return None  # non-prophet backend: nothing to pre-compile
+    entries = []
+    t_bank0 = time.time()
+    for width, hb in ladder:
+        idx = np.arange(min(width, len(np.asarray(snap.step))))
+        if width > len(idx):
+            idx = np.concatenate(
+                [idx, np.repeat(idx[:1], width - len(idx))]
+            )
+        state, step = snap.take(idx)
+        grid = future_grid(state, step, hb)
+        data = predict_mod.prepare_predict_data(
+            grid, state.meta, model.config
+        )
+        t0 = time.time()
+        lowered = predict_mod.forecast_jit.lower(
+            state.theta, data, state.meta, model.config,
+            key=jax.random.PRNGKey(0), num_samples=0,
+            return_samples=False,
+        )
+        lowered.compile()
+        entries.append({"width": int(width), "horizon_bucket": int(hb),
+                        "compile_s": round(time.time() - t0, 3)})
+    manifest = dict(
+        want,
+        entries=entries,
+        built_s=round(time.time() - t_bank0, 3),
+        unix=round(time.time(), 3),
+        jax=jax.__version__,
+    )
+    atomic_write(os.path.join(dirpath, AOT_MANIFEST),
+                 lambda fh: json.dump(manifest, fh, indent=1),
+                 mode="w")
+    obs.event("aotbank.built", dir=dirpath, n=len(entries),
+              built_s=manifest["built_s"])
+    return dict(manifest, status="built")

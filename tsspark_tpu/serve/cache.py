@@ -77,6 +77,12 @@ class ForecastCache:
         self.invalidations = 0
         self.evicted = 0
         self.carried = 0
+        # Misses the engine answered from the materialized forecast
+        # plane instead of a dispatch: those rows are deliberately NOT
+        # inserted here (the plane's shared pages are the cache), so
+        # without this counter a plane-dominated workload would read as
+        # a 0% hit rate when it is actually 100% dispatch-free.
+        self.plane_hits = 0
 
     def __len__(self) -> int:
         return len(self._data)
@@ -208,6 +214,12 @@ class ForecastCache:
         with self._lock:
             return sorted({k[0] for k in self._data})
 
+    def note_plane_hits(self, n: int) -> None:
+        """Record ``n`` misses that the forecast plane absorbed (the
+        engine's zero-dispatch read path)."""
+        with self._lock:
+            self.plane_hits += int(n)
+
     def stats(self) -> Dict:
         total = self.hits + self.misses
         return {
@@ -216,6 +228,11 @@ class ForecastCache:
             "hits": self.hits,
             "misses": self.misses,
             "hit_rate": round(self.hits / total, 4) if total else 0.0,
+            "plane_hits": self.plane_hits,
+            # Requests served WITHOUT a backend dispatch: LRU hits plus
+            # plane-absorbed misses over all lookups.
+            "hot_rate": (round((self.hits + self.plane_hits) / total, 4)
+                         if total else 0.0),
             "invalidations": self.invalidations,
             "evicted": self.evicted,
             "carried": self.carried,

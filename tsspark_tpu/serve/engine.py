@@ -46,6 +46,7 @@ from tsspark_tpu.obs.metrics import DEFAULT as METRICS
 from tsspark_tpu.parallel.sharding import compacted_width, next_pow2
 from tsspark_tpu.resilience import faults
 from tsspark_tpu.resilience.policy import CircuitBreaker
+from tsspark_tpu.serve import fplane
 from tsspark_tpu.serve.cache import ForecastCache
 from tsspark_tpu.serve.registry import (
     ParamRegistry,
@@ -198,7 +199,11 @@ class ForecastResult:
     values: Dict[str, np.ndarray]     # each (B, H)
     version: int
     latency_s: float
-    from_cache: int                   # series rows served from cache
+    from_cache: int                   # series rows served without a
+                                      # fresh dispatch: LRU hits plus
+                                      # plane-gathered rows (the plane
+                                      # is the shared materialized
+                                      # cache — cache.plane_hits)
 
 
 #: Rolling-window sizes for the per-request/per-dispatch samples below:
@@ -223,6 +228,11 @@ class EngineStats:
     # saturation signal the SERVE report and metrics surface.
     fast_failed: int = 0
     last_retry_after_s: Optional[float] = None
+    # Series-rows served straight from the materialized forecast plane
+    # (zero JAX dispatch) vs through ``backend.predict``: the split the
+    # serveplane bench's hit-rate SLO rides.
+    plane_hits: int = 0
+    plane_misses: int = 0
     latencies_s: "collections.deque" = dataclasses.field(
         default_factory=lambda: collections.deque(maxlen=_LATENCY_WINDOW)
     )
@@ -249,6 +259,13 @@ class EngineStats:
             "dispatches": self.dispatches,
             "fast_failed": self.fast_failed,
             "retry_after_s": self.last_retry_after_s,
+            "plane_hits": self.plane_hits,
+            "plane_misses": self.plane_misses,
+            "plane_hit_rate": (
+                round(self.plane_hits
+                      / (self.plane_hits + self.plane_misses), 4)
+                if (self.plane_hits + self.plane_misses) else None
+            ),
             "latency_ms": {
                 "p50": pct(50), "p95": pct(95), "p99": pct(99),
                 "mean": (round(float(lat.mean()) * 1e3, 3)
@@ -330,6 +347,11 @@ class PredictionEngine:
         # it in without a disk load — the flip-window latency saver the
         # pool's ahead-of-time materializer rides.
         self._prefetched: Optional[Snapshot] = None
+        # Attached forecast planes, version-keyed (None memoizes a
+        # failed/absent attach so a plane-less version costs one probe,
+        # not one per pump).  Bounded: the engine only ever serves the
+        # active version plus a prefetched successor.
+        self._planes: Dict[int, Optional[fplane.FPlaneView]] = {}
         self._pump_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -345,6 +367,13 @@ class PredictionEngine:
         self._m_dispatches = METRICS.counter(
             "tsspark_serve_dispatches_total"
         )
+        # Hot reads answered straight from the materialized forecast
+        # plane (zero JAX dispatch) vs sent to backend.predict.
+        self._m_plane = {
+            r: METRICS.counter("tsspark_serve_plane_reads_total",
+                               result=r)
+            for r in ("hit", "miss")
+        }
         self._m_queue = METRICS.gauge("tsspark_serve_queue_depth")
         # Live breaker state for the SLO watcher (obs.watch): 0 closed,
         # 1 open/half-open — updated at every dispatch outcome.
@@ -444,6 +473,10 @@ class PredictionEngine:
             self._snapshot = loaded
             self._active_seen = active
             snap = loaded
+            # Probe the new version's forecast plane at the flip (the
+            # attach CRC sweep doubles as page warming); a torn or
+            # absent plane memoizes None and the compute path serves.
+            self._plane_for(loaded.version)
         self._manifest_key = key
         return snap
 
@@ -475,6 +508,44 @@ class PredictionEngine:
         if br is not None:
             br.record_success()
         return snap
+
+    # -- forecast plane (zero-dispatch hot reads) ------------------------------
+
+    def _plane_for(self, version: int
+                   ) -> Optional[fplane.FPlaneView]:
+        """The attached forecast plane for ``version``, or None.
+
+        First probe per version attaches (CRC sweep = page warming);
+        the outcome — including a rejected torn plane — is memoized, so
+        a plane-less or corrupt version costs one probe and the engine
+        serves it through the compute path with ONE structured event,
+        never an outage (the torn-forecast-plane chaos contract)."""
+        version = int(version)
+        if version in self._planes:
+            return self._planes[version]
+        view: Optional[fplane.FPlaneView] = None
+        try:
+            vdir = self.registry.version_dir(version)
+            if fplane.has_plane(vdir):
+                view = fplane.attach(vdir)
+        except fplane.ForecastPlaneError as e:
+            obs.event("fplane.rejected", version=version,
+                      reason=e.reason, detail=str(e))
+        except Exception as e:
+            obs.event("fplane.attach_failed", version=version,
+                      error=repr(e))
+        self._planes[version] = view
+        while len(self._planes) > 4:
+            self._planes.pop(next(iter(self._planes)))
+        return view
+
+    def attach_plane(self, version: int) -> bool:
+        """Re-probe ``version``'s forecast plane, dropping any memoized
+        failure first — the pool's warm/retry hook: after a torn
+        publish is retried, the replica picks the fresh plane up here
+        instead of staying memoized on the tear."""
+        self._planes.pop(int(version), None)
+        return self._plane_for(version) is not None
 
     # -- version discipline (pool support) -------------------------------------
 
@@ -550,9 +621,19 @@ class PredictionEngine:
         _, missing = snap.rows(ids)
         absent = set(missing)
         ids = [s for s in ids if s not in absent]
+        # Fresh plane probe (not the memoized outcome): warming runs at
+        # flip/retry time, exactly when a just-published plane — or a
+        # retried one replacing a torn publish — should be adopted.
+        self.attach_plane(snap.version)
+        view = self._plane_for(snap.version)
         warmed = 0
         for h in horizons:
             hb = max(self.horizon_floor, next_pow2(int(h)))
+            if view is not None and view.covers(hb, num_samples):
+                # Plane-covered bucket: every replica already reads it
+                # from the shared pages — duplicating rows into this
+                # process's LRU would cost heap for no hit-rate.
+                continue
             todo = [
                 s for s in ids
                 if self.cache.peek((snap.version, s, hb, num_samples,
@@ -679,6 +760,7 @@ class PredictionEngine:
         hits: Dict[str, bool] = {}
         needed: List[str] = []          # unique cache misses, in order
         needed_set = set()
+        row_idx: Dict[str, int] = {}    # miss sid -> snapshot row
         live: List[PendingForecast] = []
         for pend in pends:
             if not pend.request.series_ids:
@@ -699,7 +781,11 @@ class PredictionEngine:
                                   version=version)
                 continue
             live.append(pend)
-            for sid in pend.request.series_ids:
+            # With missing empty, rows() returns one index per input id
+            # in input order on both the dict and the sorted-mmap path,
+            # so this zip lines up — the plane gather below reuses these
+            # indices instead of paying a second id resolution.
+            for sid, r in zip(pend.request.series_ids, idx):
                 if sid in rows or sid in needed_set:
                     continue
                 val = self.cache.get((version, sid, hb, num_samples,
@@ -707,9 +793,47 @@ class PredictionEngine:
                 if val is None:
                     needed.append(sid)
                     needed_set.add(sid)
+                    row_idx[sid] = r
                 else:
                     rows[sid] = val
                     hits[sid] = True
+        batch = None                    # (grid, gathered, pos) fast path
+        if needed:
+            # Materialized-forecast-plane fast path: a deterministic
+            # group whose bucket the active plane covers is answered by
+            # a vectorized memmap gather — zero JAX dispatch, one
+            # page-cache copy shared by every replica.  Not inserted
+            # into the cache: the plane IS the shared cache, and
+            # duplicating its rows into per-process LRUs would undo the
+            # one-copy memory story.  Long-tail buckets and sampled
+            # requests fall through to the compute path below.
+            view = self._plane_for(version)
+            if view is not None and view.covers(hb, num_samples):
+                idx = np.fromiter((row_idx[s] for s in needed),
+                                  np.int64, len(needed))
+                if not rows:
+                    # Every series of every live request is a cache
+                    # miss answered by this ONE gather, so the batch
+                    # arrays serve the group whole — fancy-indexing a
+                    # gathered column is bitwise np.stack over its
+                    # rows, minus the per-series dict scatter and the
+                    # restack.
+                    grid, gathered = fplane.plane_batch(view, snap,
+                                                        idx, hb)
+                    batch = (grid, gathered,
+                             {s: i for i, s in enumerate(needed)})
+                else:
+                    served = fplane.plane_rows(view, snap, idx, hb)
+                    for sid, row in zip(needed, served):
+                        rows[sid] = row
+                        hits[sid] = True
+                self.stats.plane_hits += len(needed)
+                self._m_plane["hit"].inc(len(needed))
+                self.cache.note_plane_hits(len(needed))
+                needed = []
+            else:
+                self.stats.plane_misses += len(needed)
+                self._m_plane["miss"].inc(len(needed))
         if needed:
             try:
                 fresh = self._dispatch(snap, needed, hb, num_samples,
@@ -739,14 +863,23 @@ class PredictionEngine:
             req = pend.request
             h = req.horizon
             sids = req.series_ids
-            values = {
-                k: np.stack([rows[s][k] for s in sids])[:, :h]
-                for k in rows[sids[0]] if k != "ds"
-            }
-            cached = sum(1 for s in sids if hits.get(s))
+            if batch is not None:
+                grid, gathered, pos = batch
+                sel = [pos[s] for s in sids]
+                ds = grid[sel][:, :h]
+                values = {k: v[sel][:, :h]
+                          for k, v in gathered.items()}
+                cached = len(sids)
+            else:
+                values = {
+                    k: np.stack([rows[s][k] for s in sids])[:, :h]
+                    for k in rows[sids[0]] if k != "ds"
+                }
+                ds = np.stack([rows[s]["ds"] for s in sids])[:, :h]
+                cached = sum(1 for s in sids if hits.get(s))
             pend._complete(ForecastResult(
                 series_ids=sids,
-                ds=np.stack([rows[s]["ds"] for s in sids])[:, :h],
+                ds=ds,
                 values=values,
                 version=version,
                 latency_s=done_s - pend.submitted_s,

@@ -1262,6 +1262,15 @@ class ReplicaPool:
         hot = [str(s) for s in (hot_series or ())]
         with self._activate_lock:
             t0 = time.time()
+            # Materialize the serve artifacts for the NEW version
+            # before any replica refreshes onto it: the forecast plane
+            # (replicas adopt it at warm/refresh and answer hot reads
+            # with zero JAX dispatch) and the AOT program bank (a
+            # respawned replica loads its first-request programs from
+            # the shared compilation cache).  Both are best-effort
+            # accelerators — a shed or failed publish leaves the
+            # compute path serving, never blocks the flip.
+            arts = self._publish_serve_artifacts(version, horizons)
             warmed = {}
             for slot in self.replicas:
                 try:
@@ -1294,7 +1303,39 @@ class ReplicaPool:
             self._write_state()
             obs.record("pool.activate", t0, time.time() - t0,
                        version=version, warmed=warmed,
-                       hot=len(hot))
+                       hot=len(hot), fplane=arts.get("fplane"),
+                       aot=arts.get("aot"))
+
+    def _publish_serve_artifacts(self, version: int,
+                                 horizons: Sequence[int]) -> Dict:
+        """Best-effort forecast plane + AOT program bank for the flip
+        target (both idempotent; see ``fplane.maybe_publish`` /
+        ``aotbank.build_bank``).  Failures degrade to an event — the
+        flip itself must never hinge on speculative precompute."""
+        out: Dict = {"fplane": None, "aot": None}
+        try:
+            from tsspark_tpu.serve import aotbank, fplane
+
+            pub = fplane.maybe_publish(self.registry, version,
+                                       horizons=horizons)
+            out["fplane"] = None if pub is None else pub.get("status")
+            bank_dir = aotbank.cache_dir_from_env()
+            if bank_dir:
+                from tsspark_tpu.backends.registry import get_backend
+                from tsspark_tpu.config import SolverConfig
+
+                snap = self.registry.load(int(version), fallback=False)
+                bank = aotbank.build_bank(
+                    snap,
+                    get_backend("tpu", self.registry.config,
+                                SolverConfig()),
+                    dirpath=bank_dir, horizons=horizons,
+                )
+                out["aot"] = None if bank is None else bank.get("status")
+        except Exception as e:
+            obs.event("pool.serve_artifacts_failed",
+                      version=int(version), error=repr(e))
+        return out
 
     # -- aggregation -----------------------------------------------------------
 
@@ -1325,6 +1366,8 @@ class ReplicaPool:
                     "rejected": st.get("rejected"),
                     "fast_failed": st.get("fast_failed"),
                     "latency_ms": st.get("latency_ms"),
+                    "plane_hits": st.get("plane_hits"),
+                    "plane_hit_rate": st.get("plane_hit_rate"),
                     "cache": resp.get("cache"),
                     # Sharing-aware memory (utils.procmem): rss_anon is
                     # the private heap an npz snapshot would live in;

@@ -31,6 +31,13 @@ def main(argv=None) -> int:
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", 0.5
         )
+    # The AOT program bank's cache wins when configured: first-request
+    # programs were compiled at publish time into this directory, so a
+    # cold replica LOADS them instead of paying the compile wall
+    # (docs/SERVING.md, "AOT program bank").
+    from tsspark_tpu.serve import aotbank
+
+    aotbank.arm_from_env()
 
     ap = argparse.ArgumentParser(
         prog="python -m tsspark_tpu.serve.replica",

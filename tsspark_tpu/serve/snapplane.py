@@ -42,6 +42,14 @@ format: ``ParamRegistry._load_version`` prefers the plane, degrades to
 the same version's npz when the plane is torn, and only then walks the
 active->previous fallback chain.  Predictions served from the two
 formats are pinned bitwise equal (tests/test_snapshot_plane.py).
+
+``serve/fplane.py`` extends the same protocol one level up the read
+path: where this plane shares the model PARAMETERS as pages, the
+forecast plane shares the hot-horizon forecast OUTPUTS themselves
+(``fcol_*`` columns under ``fplane_spec.json``/``fplaneok.json``), so a
+hot point-forecast read needs neither a parameter gather nor a JAX
+dispatch.  Its delta copy-forward and CRC-sentinel rejection semantics
+mirror ``write_plane_delta``/``verify_plane`` here column for column.
 """
 
 from __future__ import annotations
