@@ -507,6 +507,17 @@ def main() -> None:
     ap.add_argument("--serveplane-requests", type=int, default=2000,
                     help="--serveplane: hot reads through the plane "
                          "engine (the dispatch arm replays 1/8th)")
+    ap.add_argument("--uncertainty", nargs="?", const=24, default=None,
+                    type=int, metavar="N_SERIES",
+                    help="uncertainty-tier calibration benchmark "
+                         "(tsspark_tpu.uncertainty.calibrate): ADVI "
+                         "fit throughput, quantile-plane publish + "
+                         "mmap interval-read p50/p99, empirical-vs-"
+                         "nominal coverage on held-out data, and a "
+                         "small NUTS gold audit; emits "
+                         "BENCH_uncertainty_* judged under "
+                         "[tool.tsspark.slo.calibration] "
+                         "(docs/UNCERTAINTY.md)")
     ap.add_argument("--reuse-cold", default=None, metavar="DIR",
                     help="for --delta/--freshness: reuse (or record) "
                          "the cold fit+publish reference under DIR so "
@@ -552,6 +563,22 @@ def main() -> None:
             series=args.serveplane,
             requests=args.serveplane_requests,
             seed=0, dir=None, report=None, data_root=None,
+        )))
+    if args.uncertainty:
+        # Same device pinning as --serveplane: the calibration smoke is
+        # a serve-tier workload and must not block on an accelerator.
+        if os.environ.get("TSSPARK_SERVE_DEVICE", "cpu") == "cpu":
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        import argparse as _argparse
+
+        from tsspark_tpu.uncertainty import calibrate
+
+        sys.exit(calibrate.run_uncertainty_bench(_argparse.Namespace(
+            series=args.uncertainty, seed=0, dir=None, report=None,
+            data_root=None,
         )))
     if args.freshness:
         from tsspark_tpu.resident import force_virtual_host_mesh

@@ -446,3 +446,36 @@ def test_daemon_metrics_cmd_and_periodic_export(tmp_path):
     assert snap_path.exists()
     with open(snap_path) as fh:
         assert json.load(fh)["kind"] == "metrics-snapshot"
+
+
+def test_serveplane_reports_get_their_own_row_family(tmp_path):
+    """bench --serveplane reports (serve-loadgen + a "plane" block) are
+    re-kinded into the ``serveplane`` family: their own row_id
+    namespace, workload prefix, trajectory block, and SLO section
+    ([tool.tsspark.slo.serveplane]) — never baselined against ordinary
+    loadgen rows."""
+    rep = _serve_report("t-sp", 4.0)
+    rep["plane"] = {
+        "plane_hit_rate": 0.97,
+        "read_latency_ms": {"p50": 0.02, "p99": 0.08},
+        "hot_read": {"plane_rps": 5000.0, "dispatch_rps": 250.0},
+        "publish_s": 0.4,
+        "ttfr": {"cold_s": 9.0, "aot_warm_s": 2.5},
+    }
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+    row, appended = history.ingest(rep, hpath)
+    assert appended and row["kind"] == "serveplane"
+    assert row["row_id"] == "serveplane:t-sp"
+    assert row["workload"].startswith("serveplane_")
+    m = row["metrics"]
+    assert m["plane_hit_rate"] == 0.97
+    assert m["plane_read_p99_ms"] == 0.08
+    assert m["plane_requests_per_s"] == 5000.0
+    assert m["dispatch_requests_per_s"] == 250.0
+    assert m["ttfr_cold_s"] == 9.0 and m["ttfr_aot_warm_s"] == 2.5
+    # A plane-less loadgen still lands in the ordinary serve family.
+    row2, _ = history.ingest(_serve_report("t-plain", 4.0), hpath)
+    assert row2["kind"] == "serve"
+    lines = history.trajectory(history.read_history(hpath))
+    assert any("serveplane trajectory" in ln for ln in lines)
+    assert any("serve trajectory" in ln for ln in lines)

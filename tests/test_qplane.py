@@ -1,0 +1,310 @@
+"""Quantile forecast plane (tsspark_tpu/uncertainty/qplane.py,
+docs/UNCERTAINTY.md): bitwise parity of plane-served vs computed
+interval quantiles (MAP and ADVI modes), the full kill-point sweep on
+the spec-first/CRC-sentinel publish protocol, delta copy-forward, and
+the engine's coverage rules + compute fallback."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.resilience import FaultPlan, faults
+from tsspark_tpu.serve import (
+    ForecastCache,
+    ParamRegistry,
+    PredictionEngine,
+)
+from tsspark_tpu.uncertainty import advi, qplane
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+)
+SOLVER = SolverConfig(max_iters=25)
+HOT = qplane.DEFAULT_HOT_HORIZONS
+QS = qplane.DEFAULT_QUANTILES
+#: Columns a default publish lands: 3 buckets x 3 quantiles.
+N_COLS = 9
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(7)
+    t = np.arange(150.0)
+    y = (10 + 0.02 * t[None, :] + np.sin(2 * np.pi * t[None, :] / 7)
+         + rng.normal(0, 0.1, (6, 150)))
+    backend = get_backend("tpu", CFG, SOLVER)
+    state = backend.fit(t, jnp.asarray(y))
+    return backend, state, [f"s{i}" for i in range(6)]
+
+
+def _registry(tmp_path, fitted):
+    backend, state, ids = fitted
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG)
+    reg.publish(state, ids, step=np.ones(len(ids)))
+    return reg
+
+
+def _quantile_reads(engine, ids, horizons=HOT):
+    return {h: engine.quantiles(list(ids), int(h)) for h in horizons}
+
+
+def _assert_bitwise(got, want):
+    for h in want:
+        np.testing.assert_array_equal(got[h].ds, want[h].ds)
+        assert set(got[h].values) == set(want[h].values)
+        for k in want[h].values:
+            np.testing.assert_array_equal(
+                got[h].values[k], want[h].values[k], err_msg=f"h={h} {k}"
+            )
+
+
+def test_qplane_columns_bitwise_equal_compute_rows(tmp_path, fitted):
+    """THE interval pin, full grid: every (series, bucket, quantile)
+    cell of a published quantile plane is bitwise ``compute_rows`` over
+    the same snapshot rows — the publisher's batching is invisible in
+    the bytes because every cell is keyed on ``(seed, global_row)``
+    alone."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    pub = qplane.maybe_publish(reg, 1, backend)
+    assert pub["status"] == "published" and pub["mode"] == "map"
+    assert pub["buckets"] == [8, 16, 32]
+    view = qplane.attach(reg.version_dir(1))
+    snap = reg.load()
+    for hb in view.buckets:
+        ref = qplane.compute_rows(snap, CFG, backend,
+                                  np.arange(len(ids)), hb)
+        for pm in ref:
+            np.testing.assert_array_equal(
+                np.asarray(view.columns[hb][pm]), ref[pm],
+                err_msg=f"hb={hb} q{pm:03d}",
+            )
+    # quantile_rows serves arbitrary row subsets with the recomputed ds
+    # grid, bitwise the gathered full-plane rows.
+    idx = np.asarray([3, 0, 5])
+    rows = qplane.quantile_rows(view, snap, idx, 8)
+    for i, row in enumerate(idx):
+        for pm in view.columns[8]:
+            np.testing.assert_array_equal(
+                rows[i][f"q{pm:03d}"],
+                np.asarray(view.columns[8][pm])[row],
+            )
+
+
+def test_engine_quantiles_plane_vs_compute_bitwise(tmp_path, fitted):
+    """Plane-served engine intervals equal the forced-compute engine's
+    across the full hot grid, and actually come from the plane."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert qplane.maybe_publish(reg, 1, backend)["status"] == "published"
+
+    eng_plane = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_disp = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_disp._qplanes = {1: None}  # force the compute fallback
+    got = _quantile_reads(eng_plane, ids)
+    want = _quantile_reads(eng_disp, ids)
+    _assert_bitwise(got, want)
+    assert eng_plane.stats.qplane_hits == len(ids) * len(HOT)
+    assert eng_plane.stats.dispatches == 0
+    assert eng_disp.stats.qplane_hits == 0
+    assert eng_disp.stats.qplane_misses == len(ids) * len(HOT)
+
+
+def test_engine_quantile_coverage_rules(tmp_path, fitted):
+    """A quantile the plane does not carry routes the whole request to
+    compute; the plane covers published (bucket, quantile) pairs only."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert qplane.maybe_publish(reg, 1, backend)
+    eng = PredictionEngine(reg, cache=ForecastCache(0))
+    long_tail = eng.quantiles(ids[:2], 7, quantiles=(0.25, 0.75))
+    assert set(long_tail.values) == {"q250", "q750"}
+    assert long_tail.values["q250"].shape == (2, 7)
+    assert eng.stats.qplane_hits == 0
+    assert eng.stats.qplane_misses == 2
+    hot = eng.quantiles(ids[:2], 7)
+    assert set(hot.values) == {"q100", "q500", "q900"}
+    assert eng.stats.qplane_hits == 2
+    # Bands must be ordered at every cell.
+    assert np.all(hot.values["q100"] <= hot.values["q500"])
+    assert np.all(hot.values["q500"] <= hot.values["q900"])
+
+
+def test_full_kill_point_sweep_every_tear_rejected(tmp_path, fitted,
+                                                   monkeypatch):
+    """The acceptance sweep: a publish killed between ANY two of the 9
+    column writes (spec always landed, sentinel never) leaves a plane
+    the reader refuses — no kill point is survivable-but-corrupt."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    snap = reg.load()
+    for k in range(N_COLS):
+        vdir = str(tmp_path / f"tear{k}")
+        os.makedirs(vdir)
+        plan = FaultPlan(state_dir=str(tmp_path / "faults" / str(k)))
+        plan.fail("qplane_publish", after=k, mode="raise",
+                  tag=f"tear-{k}")
+        monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+        with pytest.raises(faults.FaultInjected):
+            qplane.write_qplane(vdir, snap, CFG, backend)
+        monkeypatch.delenv(faults.ENV_VAR)
+        assert not qplane.has_qplane(vdir), f"kill point {k}"
+        assert not qplane.verify_qplane(vdir), f"kill point {k}"
+        with pytest.raises(qplane.QuantilePlaneError) as e:
+            qplane.attach(vdir)
+        assert e.value.reason == "corrupt", f"kill point {k}"
+
+
+def test_torn_publish_fallback_then_bitwise_retry(tmp_path, fitted,
+                                                  monkeypatch):
+    """The torn-quantile-plane contract in process: mid-tear the engine
+    serves intervals through compute — bitwise the pre-tear answers —
+    and the retried publish lands a plane whose served rows are bitwise
+    the compute path's."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    vdir = reg.version_dir(1)
+    eng = PredictionEngine(reg, cache=ForecastCache(0))
+    ref = _quantile_reads(eng, ids)  # no plane yet: compute reference
+
+    plan = FaultPlan(state_dir=str(tmp_path / "faults"))
+    plan.fail("qplane_publish", after=3, mode="raise", tag="torn-qplane")
+    monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+    with pytest.raises(faults.FaultInjected):
+        qplane.write_qplane(vdir, reg.load(), CFG, backend)
+    monkeypatch.delenv(faults.ENV_VAR)
+
+    assert not qplane.has_qplane(vdir)
+    assert not qplane.verify_qplane(vdir)
+
+    eng_mid = PredictionEngine(reg, cache=ForecastCache(0))
+    mid = _quantile_reads(eng_mid, ids)
+    assert eng_mid.stats.qplane_hits == 0
+    _assert_bitwise(mid, ref)
+
+    retry = qplane.maybe_publish(reg, 1, backend, force=True)
+    assert retry["status"] == "published"
+    assert qplane.verify_qplane(vdir)
+    assert eng_mid.attach_qplane(1)
+    after = _quantile_reads(eng_mid, ids)
+    assert eng_mid.stats.qplane_hits > 0
+    _assert_bitwise(after, ref)
+
+
+def test_delta_copy_forward_quantile_columns(tmp_path, fitted):
+    """Delta flip: unchanged rows' quantile cells are bitwise the BASE
+    plane's (copy-forward, no re-sample), changed rows are bitwise a
+    fresh ``compute_rows`` over the new snapshot."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert qplane.maybe_publish(reg, 1, backend)["status"] == "published"
+    base_view = qplane.attach(reg.version_dir(1))
+
+    snap1 = reg.load()
+    changed = np.asarray([1, 3])
+    sub, step_sub = snap1.take(changed)
+    refit = sub._replace(theta=np.asarray(sub.theta) * 1.02)
+    v2 = reg.publish_delta(refit, changed.tolist(), step_sub=step_sub)
+    pub = qplane.maybe_publish(reg, v2, backend)
+    assert pub["status"] == "published-delta"
+
+    view2 = qplane.attach(reg.version_dir(v2))
+    snap2 = reg.load()
+    assert snap2.version == v2
+    unchanged = np.asarray([0, 2, 4, 5])
+    for hb in view2.buckets:
+        ref = qplane.compute_rows(snap2, CFG, backend, changed, hb)
+        for pm in ref:
+            np.testing.assert_array_equal(
+                np.asarray(view2.columns[hb][pm])[unchanged],
+                np.asarray(base_view.columns[hb][pm])[unchanged],
+                err_msg=f"copy-forward hb={hb} q{pm:03d}",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(view2.columns[hb][pm])[changed], ref[pm],
+                err_msg=f"changed hb={hb} q{pm:03d}",
+            )
+        # The perturbed rows really moved.
+        assert not np.array_equal(
+            np.asarray(view2.columns[hb][500])[changed],
+            np.asarray(base_view.columns[hb][500])[changed],
+        )
+
+
+def test_advi_mode_selected_and_bitwise(tmp_path, fitted):
+    """With a posterior artifact in the version dir the publish flips
+    to ADVI-mode sampling, and plane cells stay bitwise the ADVI
+    compute path's."""
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    mu = np.nan_to_num(np.asarray(state.theta, np.float32))
+    post = advi.AdviPosterior(
+        mu=mu, rho=np.full_like(mu, -2.0),
+        elbo=np.zeros(mu.shape[0], np.float32),
+    )
+    advi.save_posterior(reg.version_dir(1), post, seed=0, num_steps=0)
+    pub = qplane.maybe_publish(reg, 1, backend)
+    assert pub["status"] == "published" and pub["mode"] == "advi"
+    view = qplane.attach(reg.version_dir(1))
+    assert view.mode == "advi"
+    snap = reg.load()
+    for hb in view.buckets:
+        ref = qplane.compute_rows(snap, CFG, backend,
+                                  np.arange(len(ids)), hb,
+                                  posterior=post)
+        for pm in ref:
+            np.testing.assert_array_equal(
+                np.asarray(view.columns[hb][pm]), ref[pm],
+                err_msg=f"advi hb={hb} q{pm:03d}",
+            )
+    # Engine plane reads come from the mmap, bitwise the view's cells.
+    eng = PredictionEngine(reg, cache=ForecastCache(0))
+    res = eng.quantiles(ids[:3], 8)
+    assert eng.stats.qplane_hits == 3
+    np.testing.assert_array_equal(
+        res.values["q500"], np.asarray(view.columns[8][500])[:3]
+    )
+
+
+def test_attach_rejects_corrupt_column(tmp_path, fitted):
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert qplane.maybe_publish(reg, 1, backend)
+    vdir = reg.version_dir(1)
+    path = os.path.join(vdir, "qcol_h8_q500.npy")
+    mm = np.lib.format.open_memmap(path, mode="r+")
+    mm[2:3].view(np.uint32)[...] ^= np.uint32(0x5A5A5A5A)
+    mm.flush()
+    del mm
+    assert not qplane.verify_qplane(vdir)
+    with pytest.raises(qplane.QuantilePlaneError) as e:
+        qplane.attach(vdir)
+    assert e.value.reason == "corrupt"
+    # The engine memoizes the rejection and serves compute — same
+    # numbers a plane-less registry would produce.
+    eng = PredictionEngine(reg, cache=ForecastCache(0))
+    res = eng.quantiles(ids[:3], 7)
+    assert res.version == 1 and eng.stats.qplane_hits == 0
+    eng_ref = PredictionEngine(reg, cache=ForecastCache(0))
+    eng_ref._qplanes = {1: None}
+    ref = eng_ref.quantiles(ids[:3], 7)
+    for k in ref.values:
+        np.testing.assert_array_equal(res.values[k], ref.values[k])
+
+
+def test_maybe_publish_idempotent_and_kill_switch(tmp_path, fitted,
+                                                  monkeypatch):
+    backend, state, ids = fitted
+    reg = _registry(tmp_path, fitted)
+    assert qplane.maybe_publish(reg, 1, backend)["status"] == "published"
+    again = qplane.maybe_publish(reg, 1, backend)
+    assert again == {"status": "present", "version": 1}
+    monkeypatch.setenv("TSSPARK_QPLANE", "0")
+    reg2 = ParamRegistry(str(tmp_path / "reg2"), CFG)
+    reg2.publish(state, ids, step=np.ones(len(ids)))
+    assert qplane.maybe_publish(reg2, 1, backend) is None
+    assert not qplane.has_qplane(reg2.version_dir(1))

@@ -1,0 +1,169 @@
+"""Uncertainty tier (tsspark_tpu/uncertainty/, docs/UNCERTAINTY.md):
+the lazy package-export sweep, NUTS determinism under a fixed key (the
+contract uncertainty/gold.py builds on), the ADVI fit + posterior
+artifact roundtrip, and the end-to-end calibration smoke landing in
+RUNHISTORY as a ``calibration`` row within its SLO budget."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import tsspark_tpu
+from tsspark_tpu.config import (
+    AdviConfig,
+    McmcConfig,
+    ProphetConfig,
+    SeasonalityConfig,
+)
+from tsspark_tpu.models.prophet.design import prepare_fit_data
+from tsspark_tpu.ops import hmc
+from tsspark_tpu.uncertainty import advi, calibrate
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+)
+
+
+# ---------------------------------------------------------------------------
+# lazy package exports (PEP 562)
+# ---------------------------------------------------------------------------
+
+
+def test_every_lazy_export_resolves():
+    """A typo'd _EXPORTS entry must fail here, in tier-1, instead of
+    surfacing as a runtime AttributeError inside a serve replica."""
+    for name, module in tsspark_tpu._EXPORTS.items():
+        value = getattr(tsspark_tpu, name)
+        assert value is not None, f"{name} ({module})"
+    # __all__ and _EXPORTS agree, and __dir__ advertises every name.
+    assert set(tsspark_tpu.__all__) == set(tsspark_tpu._EXPORTS)
+    assert set(tsspark_tpu._EXPORTS) <= set(dir(tsspark_tpu))
+    with pytest.raises(AttributeError):
+        tsspark_tpu.definitely_not_an_export
+
+
+# ---------------------------------------------------------------------------
+# NUTS determinism (the gold tier's foundation)
+# ---------------------------------------------------------------------------
+
+
+def test_hmc_deterministic_under_fixed_key():
+    """Two sample() calls with the same key, init, and config return
+    bitwise-identical chains — gold.py's audit reports are only
+    reproducible if the sampler is."""
+    b, p = 2, 3
+    mu = jnp.asarray([[0.5, -1.0, 2.0], [1.5, 0.0, -0.5]], jnp.float32)
+
+    def logdensity(th):
+        z = th - mu
+        return -0.5 * jnp.sum(z * z, axis=-1), -z
+
+    cfg = McmcConfig(num_samples=16, num_warmup=8, num_leapfrog=4)
+    key = jax.random.PRNGKey(42)
+    theta0 = jnp.zeros((b, p), jnp.float32)
+    r1 = hmc.sample(logdensity, theta0, key, cfg)
+    r2 = hmc.sample(logdensity, theta0, key, cfg)
+    assert r1.samples.shape == (16, b, p)
+    np.testing.assert_array_equal(np.asarray(r1.samples),
+                                  np.asarray(r2.samples))
+    np.testing.assert_array_equal(np.asarray(r1.accept_rate),
+                                  np.asarray(r2.accept_rate))
+    np.testing.assert_array_equal(np.asarray(r1.step_size),
+                                  np.asarray(r2.step_size))
+    # A different key must actually move the draws.
+    r3 = hmc.sample(logdensity, theta0, jax.random.PRNGKey(43), cfg)
+    assert not np.array_equal(np.asarray(r1.samples),
+                              np.asarray(r3.samples))
+
+
+# ---------------------------------------------------------------------------
+# ADVI fit + posterior artifact
+# ---------------------------------------------------------------------------
+
+
+def _tiny_fit_data(b=3, n=96, seed=0):
+    rng = np.random.default_rng(seed)
+    ds = np.arange(float(n))
+    y = (8.0 + 0.03 * ds[None] + np.sin(2 * np.pi * ds[None] / 7.0)
+         + rng.normal(0, 0.15, (b, n))).astype(np.float32)
+    data, _meta = prepare_fit_data(ds, y, CFG)
+    return data
+
+
+def test_advi_fit_shapes_and_posterior_roundtrip(tmp_path):
+    data = _tiny_fit_data()
+    n_params = int(np.asarray(data.y).shape[0])
+    from tsspark_tpu.models.prophet.params import init_theta
+
+    theta0 = np.asarray(
+        init_theta(CFG, data.y, data.mask, data.t), np.float32
+    )
+    post = advi.fit_advi(theta0, data, jax.random.PRNGKey(0), CFG,
+                         AdviConfig(num_steps=40))
+    mu = np.asarray(post.mu)
+    rho = np.asarray(post.rho)
+    assert mu.shape == theta0.shape and rho.shape == theta0.shape
+    assert np.isfinite(mu).all() and np.isfinite(rho).all()
+    assert np.asarray(post.elbo).shape == (n_params,)
+    # Deterministic under the key.
+    post2 = advi.fit_advi(theta0, data, jax.random.PRNGKey(0), CFG,
+                          AdviConfig(num_steps=40))
+    np.testing.assert_array_equal(mu, np.asarray(post2.mu))
+    # Artifact roundtrip: bitwise payload + identity header.
+    advi.save_posterior(str(tmp_path), post, seed=5, num_steps=40)
+    loaded = advi.load_posterior(str(tmp_path))
+    assert loaded is not None
+    got, header = loaded
+    np.testing.assert_array_equal(np.asarray(got.mu), mu)
+    np.testing.assert_array_equal(np.asarray(got.rho), rho)
+    assert header["seed"] == 5 and header["num_steps"] == 40
+    assert advi.load_posterior(str(tmp_path / "nowhere")) is None
+
+
+# ---------------------------------------------------------------------------
+# calibration smoke -> RUNHISTORY within budget
+# ---------------------------------------------------------------------------
+
+
+def test_calibration_smoke_lands_in_history_within_budget(
+        tmp_path, monkeypatch):
+    """The acceptance pin: the uncertainty smoke runs the whole ladder
+    (MAP fit -> ADVI advance -> quantile publish -> coverage eval ->
+    gold audit), its report joins RUNHISTORY as a ``calibration`` row,
+    and the [tool.tsspark.slo.calibration] sentinel is green."""
+    from tsspark_tpu.obs import history, regress
+
+    report = calibrate.run_calibration_smoke(
+        str(tmp_path / "scratch"), n_series=8, seed=0, read_probes=25,
+        data_root=str(tmp_path / "data"),
+    )
+    cal = report["calibration"]
+    assert cal["mode"] == "advi"
+    # Coverage within the declared budget's absolute ceiling: nominal
+    # 0.8 interval, observed within half of reality at worst.
+    assert 0.0 <= cal["coverage_abs_gap"] <= 0.5
+    assert cal["qread_p99_ms"] is not None
+    assert cal["gold"] is not None and cal["gold"]["rows"]
+
+    hpath = str(tmp_path / "RUNHISTORY.jsonl")
+    row, appended = history.ingest(report, hpath)
+    assert appended and row["kind"] == "calibration"
+    assert row["workload"] == "calibration_8x28"
+    m = row["metrics"]
+    assert m["coverage_abs_gap"] == cal["coverage_abs_gap"]
+    assert m["mode_advi"] == 1
+    assert "advi_series_per_s" in m and "qread_p99_ms" in m
+    assert "qdiv_max" in m and "rhat_max" in m
+    # Rendered trajectory grows a calibration block.
+    lines = history.trajectory(history.read_history(hpath))
+    assert any("calibration trajectory" in ln for ln in lines)
+
+    monkeypatch.chdir(tmp_path)
+    verdict = regress.sentinel_report(report)
+    assert verdict is not None and verdict["ok"], verdict
+    budget_metrics = set(
+        regress.load_slo()["budgets"]["calibration"]
+    )
+    assert {"coverage_abs_gap", "advi_series_per_s",
+            "qread_p99_ms", "qdiv_max"} <= budget_metrics

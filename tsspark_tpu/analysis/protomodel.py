@@ -230,6 +230,48 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
                "version only and the compute path covers it",
     ),
     ProtocolSpec(
+        "quantile-plane",
+        "tsspark_tpu/uncertainty/qplane.py", "write_qplane",
+        steps=(
+            StepSpec("spec", "call:write_spec",
+                     reader="attach() requires spec + sentinel; a "
+                            "spec-only dir raises corrupt and interval "
+                            "reads stay on the sampled compute path"),
+            StepSpec("columns", "call:write_column",
+                     reader="quantile columns are invisible until the "
+                            "CRC sentinel lands; the qplane_publish "
+                            "fault point tears here and attach() "
+                            "rejects the plane whole"),
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
+                     certifies=("spec", "columns")),
+        ),
+        resume="a publisher killed mid-plane leaves no qplaneok.json: "
+               "intervals serve through the row-local compute path "
+               "(bitwise the same numbers, by the shared-sampler "
+               "construction) and any successor's maybe_publish "
+               "re-lands identical bytes",
+    ),
+    ProtocolSpec(
+        "quantile-plane-delta",
+        "tsspark_tpu/uncertainty/qplane.py", "write_qplane_delta",
+        steps=(
+            StepSpec("spec", "call:write_spec",
+                     reader="same attach() gate as the full quantile "
+                            "plane; the delta inherits the base spec's "
+                            "sampling identity so a mixed-identity "
+                            "plane cannot exist"),
+            StepSpec("columns", "call:write_column",
+                     reader="hardlinked or re-sampled columns are "
+                            "invisible until the recomputed-CRC "
+                            "sentinel lands"),
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
+                     certifies=("spec", "columns")),
+        ),
+        resume="the base version's quantile plane is never touched; a "
+               "torn delta reads as absent/corrupt for the NEW version "
+               "only and the compute fallback covers it",
+    ),
+    ProtocolSpec(
         "registry-publish",
         "tsspark_tpu/serve/registry.py", "ParamRegistry.publish",
         steps=(

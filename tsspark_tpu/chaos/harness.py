@@ -1717,6 +1717,170 @@ def _run_fplane_storm(scratch: str, storm: StormPlan, state, ids,
             os.environ[faults.ENV_VAR] = env_plan
 
 
+# ---------------------------------------------------------------------------
+# stage L: torn quantile plane (uncertainty/qplane.py)
+# ---------------------------------------------------------------------------
+
+
+def _run_qplane_storm(scratch: str, storm: StormPlan, state, ids,
+                      mttr: Dict[str, Optional[float]],
+                      deadline_s: float) -> Tuple[Dict, Dict]:
+    """The torn-quantile-plane class: a publisher child is killed MID
+    quantile-plane publish (armed ``qplane_publish`` exit fault between
+    column writes — spec landed, CRC sentinel never did).  Invariants
+    (docs/UNCERTAINTY.md): the sentinel REJECTS the torn plane, the
+    engine keeps answering interval reads through the row-local compute
+    fallback with bands bitwise the direct ``compute_rows`` math's
+    (never a wrong band, never an outage), the retried publish verifies
+    clean, and the plane-served rows afterwards are bitwise the
+    fallback's answers.
+
+    Runs with the storm env plan popped: the stage's only fault is the
+    child's PRIVATE plan — an exit fault firing in-process would kill
+    the harness itself."""
+    import subprocess
+
+    from tsspark_tpu import orchestrate
+    from tsspark_tpu.backends.registry import get_backend
+    from tsspark_tpu.parallel.sharding import next_pow2
+    from tsspark_tpu.serve.cache import ForecastCache
+    from tsspark_tpu.serve.engine import PredictionEngine
+    from tsspark_tpu.serve.fplane import DEFAULT_HOT_HORIZONS
+    from tsspark_tpu.serve.registry import ParamRegistry
+    from tsspark_tpu.uncertainty import qplane
+
+    base = os.path.join(scratch, "qplane")
+    os.makedirs(base, exist_ok=True)
+    t0 = time.time()
+    env_plan = os.environ.pop(faults.ENV_VAR, None)
+    try:
+        cfg, solver = _config(storm.profile.max_iters)
+        registry = ParamRegistry(os.path.join(base, "registry"), cfg)
+        v1 = registry.publish(state, ids, step=np.ones(len(ids)))
+        vdir = registry.version_dir(v1)
+
+        # ---- the kill: a publisher child with qplane_publish armed --
+        inj_qp = storm.direct("torn-quantile-plane")
+        child_plan = faults.FaultPlan(
+            state_dir=os.path.join(base, "faults"))
+        child_plan.fail("qplane_publish", attempts=1,
+                        after=inj_qp.after, mode="exit", rc=inj_qp.rc,
+                        tag="torn-quantile-plane")
+        env = orchestrate._child_env()
+        env[faults.ENV_VAR] = child_plan.to_env()
+        obs.inject_env(env)
+        child = subprocess.run(
+            [sys.executable, "-c",
+             "import sys\n"
+             "from tsspark_tpu.uncertainty import qplane\n"
+             "from tsspark_tpu.serve.registry import ParamRegistry\n"
+             "reg = ParamRegistry.open(sys.argv[1])\n"
+             "qplane.maybe_publish(reg, int(sys.argv[2]))\n",
+             registry.root, str(v1)],
+            env=env, stdout=sys.stderr, timeout=deadline_s,
+        )
+        t_fault = time.time()
+        obs.event("fault", tag="torn-quantile-plane", mode="direct",
+                  rc=child.returncode)
+        fired = inv.fault_firing_times(
+            child_plan.state_dir,
+            {child_plan.rules[0]["id"]: "torn-quantile-plane"},
+            child_plan.rules,
+        ).get("torn-quantile-plane", [])
+
+        # ---- mid-tear: sentinel verdict + compute-path fallback -----
+        torn_rejected = not qplane.verify_qplane(vdir)
+        engine = PredictionEngine(registry, cache=ForecastCache(0))
+        engine.refresh()
+        sids = [str(s) for s in ids[:4]]
+        horizons = DEFAULT_HOT_HORIZONS
+        fallback = {h: engine.quantiles(sids, int(h))
+                    for h in horizons}
+        stats_mid = engine.stats.snapshot()
+        outage_free = all(r.version == v1 for r in fallback.values())
+        no_plane_hits = not stats_mid.get("qplane_hits")
+
+        # Wrong-band check: the fallback answers against the row-local
+        # sampler run directly over the same snapshot rows (the
+        # interval tier's oracle — compute_rows IS the parity
+        # contract, so an independent call must land the same bytes).
+        backend = get_backend("tpu", cfg, solver)
+        snap = registry.load()
+        idx, _ = snap.rows(sids)
+        idx = np.asarray(idx, np.int64)
+        parity = True
+        for h, res in fallback.items():
+            hb = max(engine.horizon_floor, next_pow2(int(h)))
+            ref = qplane.compute_rows(snap, cfg, backend, idx, hb)
+            parity = parity and all(
+                np.array_equal(res.values[f"q{pm:03d}"],
+                               ref[pm][:, :int(h)])
+                for pm in ref
+            )
+
+        # ---- retry: the in-process successor republishes ------------
+        retry = qplane.maybe_publish(registry, v1, backend, force=True)
+        retry_ok = bool(retry and retry.get("status") == "published")
+        plane_good = qplane.verify_qplane(vdir)
+        attached = engine.attach_qplane(v1)
+        if plane_good:
+            mttr["torn-quantile-plane"] = time.time() - t_fault
+            obs.event("recovered", tag="torn-quantile-plane")
+        served = {h: engine.quantiles(sids, int(h)) for h in horizons}
+        stats_after = engine.stats.snapshot()
+        plane_served = (stats_after.get("qplane_hits") or 0) > 0
+        bitwise = all(
+            np.array_equal(served[h].ds, fallback[h].ds)
+            and all(np.array_equal(served[h].values[k],
+                                   fallback[h].values[k])
+                    for k in fallback[h].values)
+            for h in horizons
+        )
+
+        inv_qp = {
+            "ok": (child.returncode != 0 and len(fired) == 1
+                   and torn_rejected and outage_free and no_plane_hits
+                   and parity and retry_ok and plane_good
+                   and attached and plane_served and bitwise),
+            "child_rc": child.returncode,
+            "fault_fired": len(fired),
+            "sentinel_rejected_tear": torn_rejected,
+            "fallback_served_v1": outage_free,
+            "fallback_qplane_hits": stats_mid.get("qplane_hits"),
+            "fallback_vs_compute_bitwise": parity,
+            "retry_status": None if retry is None
+            else retry.get("status"),
+            "retry_plane_verified": plane_good,
+            "plane_served_after_retry": plane_served,
+            "plane_vs_compute_bitwise": bitwise,
+        }
+        errs = []
+        if child.returncode == 0:
+            errs.append("publisher child survived its armed "
+                        "qplane_publish exit fault")
+        if not torn_rejected:
+            errs.append("CRC sentinel accepted a torn quantile plane")
+        if not (outage_free and parity):
+            errs.append("compute fallback served a wrong band or an "
+                        "outage behind the torn quantile plane")
+        if not bitwise:
+            errs.append("retried quantile plane serves different "
+                        "bytes than the compute path")
+        if errs:
+            inv_qp["errors"] = errs
+        stage = {
+            "wall_s": round(time.time() - t0, 3),
+            "v1": v1,
+            "child_rc": child.returncode,
+            "kill_after_columns": inj_qp.after,
+            "retry": retry,
+        }
+        return stage, {"qplane_torn_publish": inv_qp}
+    finally:
+        if env_plan is not None:
+            os.environ[faults.ENV_VAR] = env_plan
+
+
 def run_storm(seed: int = 0, profile: str = "full",
               scratch: Optional[str] = None,
               keep_scratch: bool = False,
@@ -2004,6 +2168,14 @@ def run_storm(seed: int = 0, profile: str = "full",
                 )
             invariants.update(fp_inv)
 
+        # ---- stage L: torn quantile plane (uncertainty/qplane.py) ----
+        if prof.qplane_storm:
+            with obs.span("stage.qplane"):
+                stages["qplane"], qp_inv = _run_qplane_storm(
+                    scratch, storm, got_state, ids, mttr, deadline_s
+                )
+            invariants.update(qp_inv)
+
         # ---- cross-stage invariants ----------------------------------
         if out_dir is not None:
             corrupt_injected = sum(
@@ -2144,6 +2316,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "sched_storm": prof.sched_storm,
                 "storage_storm": prof.storage_storm,
                 "fplane_storm": prof.fplane_storm,
+                "qplane_storm": prof.qplane_storm,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),

@@ -208,6 +208,11 @@ class StormProfile:
     # engine must keep serving bitwise-correct forecasts through its
     # compute path and a retry publish must land identical bytes.
     fplane_storm: bool = False
+    # Quantile-plane fault domain (uncertainty/qplane.py): a publisher
+    # killed mid quantile-column publish (spec landed, CRC sentinel
+    # never did) — interval reads must shed to the compute path with
+    # bitwise-identical answers and a retry must verify clean.
+    qplane_storm: bool = False
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -256,6 +261,7 @@ PROFILES: Dict[str, StormProfile] = {
         resident_series=32, resident_chunk=8,
         refit_series=32, refit_chunk=8, refit_churn=0.25,
         sched_storm=True, storage_storm=True, fplane_storm=True,
+        qplane_storm=True,
     ),
 }
 
@@ -502,6 +508,17 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
             cls="torn-forecast-plane", stage="fplane",
             point="fplane_publish", mode="direct",
             after=rng.randrange(1, 11),
+            rc=rng.choice((17, 23, 29)),
+        ))
+
+    # -- quantile-plane stage (same shape at the qplane_publish point;
+    # -- the default publish is 3 buckets x 3 quantiles = 9 columns, so
+    # -- the tear always lands after the spec and before the sentinel) -
+    if prof.qplane_storm:
+        inj.append(Injection(
+            cls="torn-quantile-plane", stage="qplane",
+            point="qplane_publish", mode="direct",
+            after=rng.randrange(1, 9),
             rc=rng.choice((17, 23, 29)),
         ))
 

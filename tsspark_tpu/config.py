@@ -317,6 +317,39 @@ class McmcConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdviConfig:
+    """Batched mean-field ADVI settings (see uncertainty/advi.py).
+
+    The cheap member of the uncertainty ladder (MAP < ADVI < NUTS): a
+    diagonal-Gaussian posterior per series, fitted by maximizing a
+    reparameterized ELBO over the same padded (n_series, n_timesteps)
+    design tensors the L-BFGS MAP solve runs on, all series in
+    lockstep.  "Going NUTS with ADVI" (PAPERS.md) measures ADVI
+    intervals at NUTS quality for this model family at a fraction of
+    the cost, which is why it is the default served tier and NUTS is
+    the sampled gold audit.
+    """
+
+    num_steps: int = 200
+    num_elbo_samples: int = 4      # MC samples per ELBO gradient step
+    learning_rate: float = 0.05    # Adam step size
+    init_rho: float = -3.0         # initial log-stddev (softplus-free)
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    adam_eps: float = 1e-8
+
+    def __post_init__(self):
+        if self.num_steps < 1:
+            raise ValueError("num_steps must be >= 1")
+        if self.num_elbo_samples < 1:
+            raise ValueError("num_elbo_samples must be >= 1")
+        if self.learning_rate <= 0.0:
+            raise ValueError("learning_rate must be > 0")
+        if not 0.0 <= self.adam_b1 < 1.0 or not 0.0 <= self.adam_b2 < 1.0:
+            raise ValueError("adam betas must be in [0, 1)")
+
+
+@dataclasses.dataclass(frozen=True)
 class ShardingConfig:
     """How a fit batch is laid out over a jax.sharding.Mesh.
 

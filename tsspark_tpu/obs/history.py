@@ -254,6 +254,53 @@ def _serve_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _serveplane_row(rep: Dict[str, Any]) -> Dict[str, Any]:
+    """Forecast-plane serve rows (bench --serveplane): the ordinary
+    serve normalization re-kinded into its OWN row family.  A plane
+    row's metric mix (cache-disabled hot reads, TTFR probes, publish
+    walls) is a different experiment from an ordinary loadgen — giving
+    it a family gives it its own trajectory block and its own SLO
+    section ([tool.tsspark.slo.serveplane]) instead of riding serve's."""
+    return dict(_serve_row(rep), kind="serveplane")
+
+
+def _calibration_row(rep: Dict[str, Any]) -> Dict[str, Any]:
+    """Uncertainty-tier calibration rows (bench --uncertainty;
+    uncertainty/calibrate.py).  The headline is coverage_abs_gap —
+    |empirical - nominal| coverage of the served intervals on held-out
+    data, the one metric that catches a silently mis-calibrated
+    posterior — plus the ADVI fit throughput, the quantile plane's
+    zero-dispatch read p99, and the NUTS gold audit's divergence.
+    Budgeted in [tool.tsspark.slo.calibration]."""
+    cal = rep.get("calibration") or {}
+    m: Dict[str, float] = {}
+    for k in ("coverage_abs_gap", "fit_s", "advi_fit_s",
+              "advi_series_per_s", "publish_s", "nbytes",
+              "qread_p99_ms", "draws"):
+        _put(m, k, cal.get(k))
+    _put(m, "wall_s", rep.get("wall_s"))
+    _put(m, "mode_advi", cal.get("mode") == "advi")
+    for hb, b in sorted((cal.get("buckets") or {}).items()):
+        if isinstance(b, dict):
+            _put(m, f"coverage_abs_gap_h{hb}", b.get("coverage_abs_gap"))
+    gold = cal.get("gold") or {}
+    for k in ("qdiv_max", "qdiv_mean", "rhat_max", "ess_min",
+              "hmc_divergences"):
+        _put(m, k, gold.get(k))
+    return {
+        "kind": "calibration",
+        "trace_id": rep.get("trace_id"),
+        "unix": rep.get("unix"),
+        "workload": (f"calibration_{rep.get('n_series')}"
+                     f"x{rep.get('holdout')}"),
+        "device": rep.get("device"),
+        "numerics_rev": rep.get("numerics_rev"),
+        "config_fingerprint": rep.get("config_fingerprint"),
+        "git_rev": rep.get("git_rev"),
+        "metrics": m,
+    }
+
+
 def _scale_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     """Scale-ladder rung rows (bench --scale; tsspark_tpu.bench_scale).
     The rung name IS part of the workload key: a 1M-series row must
@@ -435,7 +482,11 @@ def classify(rep: Dict[str, Any]) -> Optional[str]:
     never feed back into the baselines that produced them)."""
     kind = rep.get("kind")
     if kind == "serve-loadgen":
-        return "serve"
+        # Plane-bearing loadgen reports (bench --serveplane) are their
+        # own family: different experiment, different baselines.
+        return "serveplane" if rep.get("plane") else "serve"
+    if kind == "calibration-eval":
+        return "calibration"
     if kind == "scale-ladder":
         return "scale"
     if kind == "freshness-bench":
@@ -460,6 +511,8 @@ def classify(rep: Dict[str, Any]) -> Optional[str]:
 _ROW_BUILDERS = {
     "bench": _bench_row,
     "serve": _serve_row,
+    "serveplane": _serveplane_row,
+    "calibration": _calibration_row,
     "scale": _scale_row,
     "freshness": _freshness_row,
     "analysis": _analysis_row,
@@ -616,6 +669,13 @@ _TRAJECTORY_COLUMNS = {
     "serve": ("requests_per_s", "p50_ms", "p99_ms", "shed_rate",
               "hit_rate", "agg_requests_per_s", "failovers",
               "flip_p99_ms"),
+    "serveplane": ("plane_hit_rate", "plane_read_p99_ms",
+                   "plane_requests_per_s", "dispatch_requests_per_s",
+                   "plane_publish_s", "ttfr_cold_s",
+                   "ttfr_aot_warm_s"),
+    "calibration": ("coverage_abs_gap", "mode_advi",
+                    "advi_series_per_s", "qread_p99_ms", "qdiv_max",
+                    "rhat_max", "hmc_divergences"),
     "scale": ("series_per_s", "agg_requests_per_s",
               "time_to_first_request_s", "flip_p99_ms",
               "rss_mb_per_replica", "rss_reduction_x", "complete"),
@@ -662,8 +722,8 @@ def trajectory(rows: Sequence[Dict[str, Any]]) -> List[str]:
     """Human-readable trajectory: one line per row, grouped by family
     in ingest order (the roadmap's 'bench trajectory' block)."""
     lines: List[str] = []
-    for kind in ("bench", "eval", "serve", "scale", "freshness",
-                 "analysis", "chaos", "ledger"):
+    for kind in ("bench", "eval", "serve", "serveplane", "calibration",
+                 "scale", "freshness", "analysis", "chaos", "ledger"):
         group = [r for r in rows if r.get("kind") == kind]
         if not group:
             continue

@@ -92,6 +92,29 @@ DEFAULT_SLO: Dict[str, Any] = {
                                   "max_rise_frac": 1.0,
                                   "slack_abs": 2.0},
         },
+        "serveplane": {
+            "plane_hit_rate": {"direction": "higher",
+                               "max_drop_abs": 0.15},
+            "plane_read_p99_ms": {"direction": "lower",
+                                  "max_rise_frac": 1.0,
+                                  "slack_abs": 2.0},
+            "plane_requests_per_s": {"direction": "higher",
+                                     "max_drop_frac": 0.5},
+            "ttfr_aot_warm_s": {"direction": "lower",
+                                "max_rise_frac": 1.0,
+                                "slack_abs": 5.0},
+        },
+        "calibration": {
+            "coverage_abs_gap": {"direction": "lower",
+                                 "max_rise_abs": 0.10,
+                                 "slack_abs": 0.05},
+            "advi_series_per_s": {"direction": "higher",
+                                  "max_drop_frac": 0.5},
+            "qread_p99_ms": {"direction": "lower",
+                             "max_rise_frac": 1.0, "slack_abs": 2.0},
+            "qdiv_max": {"direction": "lower", "max_rise_frac": 1.0,
+                         "slack_abs": 1.0},
+        },
         "scale": {
             "rss_mb_per_replica": {"direction": "lower",
                                    "max_rise_frac": 0.5,

@@ -372,6 +372,74 @@ def reap_cycles(scratch: str, keep: Sequence[str] = ()) -> None:
             shutil.rmtree(d, ignore_errors=True)
 
 
+def _advance_posterior(registry, plan, state_sub, changed, scratch,
+                       v_new) -> bool:
+    """Delta-cycle ADVI posterior advance: re-fit the changed rows'
+    variational posteriors (warm-started from the cycle's fresh MAP
+    theta, over the cycle's already-spilled data) and copy the rest
+    forward from the base version's posterior.  Without this, a delta
+    flip would silently drop the fleet from the ADVI tier to MAP —
+    intervals would change meaning across a routine refresh.
+
+    Returns True when a posterior landed in ``v_new``'s version dir.
+    Skips (False) when the base has no posterior (fleet never advanced
+    past MAP) or the config is outside the ADVI family."""
+    from tsspark_tpu.uncertainty import advi as advi_mod
+    from tsspark_tpu.uncertainty import qplane
+
+    base_loaded = advi_mod.load_posterior(
+        registry.version_dir(int(plan["base_version"])))
+    if base_loaded is None:
+        return False
+    base_post, header = base_loaded
+    config = registry.config
+    if not qplane._advi_eligible(config):
+        return False
+    n_base = int(np.asarray(base_post.mu).shape[0])
+    if len(changed) and int(changed.max()) >= n_base:
+        # Fleet grew past the posterior's row space — a scatter would
+        # mis-index; qplane re-gates n vs the snapshot at publish time.
+        return False
+
+    _cycle_dir, ddir, _out = cycle_paths(scratch, plan)
+    load = lambda name: (np.load(os.path.join(ddir, name))
+                         if os.path.exists(os.path.join(ddir, name))
+                         else None)
+    ds, y = np.load(os.path.join(ddir, "ds.npy")), load("y.npy")
+    from tsspark_tpu.models.prophet.design import prepare_fit_data
+
+    data, _meta = prepare_fit_data(
+        ds, y, config, mask=load("mask.npy"), cap=load("cap.npy"),
+    )
+    import jax
+
+    seed = int(header.get("seed", 0))
+    num_steps = int(header.get("num_steps", 0)) or None
+    from tsspark_tpu.config import AdviConfig
+
+    advi_cfg = (AdviConfig(num_steps=num_steps) if num_steps
+                else AdviConfig())
+    # Key on (seed, new version): deterministic per cycle, decorrelated
+    # across cycles.
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), int(v_new))
+    sub = advi_mod.fit_advi(
+        np.asarray(state_sub.theta, np.float32), data, key, config,
+        advi_cfg,
+    )
+    mu = np.array(base_post.mu, np.float32)
+    rho = np.array(base_post.rho, np.float32)
+    elbo = np.array(base_post.elbo, np.float32)
+    mu[changed] = np.asarray(sub.mu, np.float32)
+    rho[changed] = np.asarray(sub.rho, np.float32)
+    elbo[changed] = np.asarray(sub.elbo, np.float32)
+    advi_mod.save_posterior(
+        registry.version_dir(int(v_new)),
+        advi_mod.AdviPosterior(mu=mu, rho=rho, elbo=elbo),
+        seed=seed, num_steps=advi_cfg.num_steps,
+    )
+    return True
+
+
 def publish_plan(
     registry,
     plan: Dict,
@@ -413,6 +481,22 @@ def publish_plan(
         fpub = None
         obs.event("fplane.publish_failed", version=int(v_new),
                   error=repr(e))
+
+    # Uncertainty tier rides the same contract: advance the ADVI
+    # posterior for the refit rows (copy-forward the rest), then
+    # delta-publish the quantile plane.  Best-effort — a failure sheds
+    # to the MAP/compute interval path, never fails the flip.
+    from tsspark_tpu.uncertainty import qplane
+
+    qpub = None
+    try:
+        _advance_posterior(registry, plan, state_sub, changed, scratch,
+                           int(v_new))
+        qpub = qplane.maybe_publish(registry, int(v_new),
+                                    horizons=tuple(horizons))
+    except Exception as e:
+        obs.event("qplane.publish_failed", version=int(v_new),
+                  error=repr(e))
     publish_s = round(time.time() - t0, 3)
 
     t0 = time.time()
@@ -436,6 +520,7 @@ def publish_plan(
         "flipped": bool(pool is not None or flip_fn is not None
                         or activate),
         "fplane": None if fpub is None else fpub.get("status"),
+        "qplane": None if qpub is None else qpub.get("status"),
     }
 
 

@@ -233,6 +233,11 @@ class EngineStats:
     # serveplane bench's hit-rate SLO rides.
     plane_hits: int = 0
     plane_misses: int = 0
+    # Same split for interval reads against the quantile plane
+    # (uncertainty/qplane.py): rows answered by the mmap gather vs
+    # through the row-local compute fallback.
+    qplane_hits: int = 0
+    qplane_misses: int = 0
     latencies_s: "collections.deque" = dataclasses.field(
         default_factory=lambda: collections.deque(maxlen=_LATENCY_WINDOW)
     )
@@ -265,6 +270,13 @@ class EngineStats:
                 round(self.plane_hits
                       / (self.plane_hits + self.plane_misses), 4)
                 if (self.plane_hits + self.plane_misses) else None
+            ),
+            "qplane_hits": self.qplane_hits,
+            "qplane_misses": self.qplane_misses,
+            "qplane_hit_rate": (
+                round(self.qplane_hits
+                      / (self.qplane_hits + self.qplane_misses), 4)
+                if (self.qplane_hits + self.qplane_misses) else None
             ),
             "latency_ms": {
                 "p50": pct(50), "p95": pct(95), "p99": pct(99),
@@ -352,6 +364,10 @@ class PredictionEngine:
         # not one per pump).  Bounded: the engine only ever serves the
         # active version plus a prefetched successor.
         self._planes: Dict[int, Optional[fplane.FPlaneView]] = {}
+        # Attached quantile planes (uncertainty/qplane.py), same
+        # memoization discipline — a rejected/absent attach is cached
+        # so interval reads on a plane-less version cost one probe.
+        self._qplanes: Dict[int, Optional[object]] = {}
         self._pump_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -473,10 +489,11 @@ class PredictionEngine:
             self._snapshot = loaded
             self._active_seen = active
             snap = loaded
-            # Probe the new version's forecast plane at the flip (the
-            # attach CRC sweep doubles as page warming); a torn or
-            # absent plane memoizes None and the compute path serves.
+            # Probe the new version's forecast + quantile planes at the
+            # flip (the attach CRC sweep doubles as page warming); a
+            # torn or absent plane memoizes None and compute serves.
             self._plane_for(loaded.version)
+            self._qplane_for(loaded.version)
         self._manifest_key = key
         return snap
 
@@ -546,6 +563,123 @@ class PredictionEngine:
         instead of staying memoized on the tear."""
         self._planes.pop(int(version), None)
         return self._plane_for(version) is not None
+
+    # -- quantile plane (zero-dispatch interval reads) -------------------------
+
+    def _qplane_for(self, version: int):
+        """The attached quantile plane for ``version``, or None —
+        ``_plane_for``'s discipline applied to the interval tier:
+        first probe attaches (CRC sweep = page warming), every outcome
+        including a rejected torn plane is memoized, and a corrupt
+        plane degrades interval reads to the compute fallback with ONE
+        structured event."""
+        from tsspark_tpu.uncertainty import qplane
+
+        version = int(version)
+        if version in self._qplanes:
+            return self._qplanes[version]
+        view = None
+        try:
+            vdir = self.registry.version_dir(version)
+            if qplane.has_qplane(vdir):
+                view = qplane.attach(vdir)
+        except qplane.QuantilePlaneError as e:
+            obs.event("qplane.rejected", version=version,
+                      reason=e.reason, detail=str(e))
+        except Exception as e:
+            obs.event("qplane.attach_failed", version=version,
+                      error=repr(e))
+        self._qplanes[version] = view
+        while len(self._qplanes) > 4:
+            self._qplanes.pop(next(iter(self._qplanes)))
+        return view
+
+    def attach_qplane(self, version: int) -> bool:
+        """Re-probe ``version``'s quantile plane, dropping any memoized
+        failure first (the post-retry pickup hook, like
+        ``attach_plane``)."""
+        self._qplanes.pop(int(version), None)
+        return self._qplane_for(version) is not None
+
+    def quantiles(self, series_ids: Sequence, horizon: int,
+                  quantiles: Optional[Sequence[float]] = None
+                  ) -> ForecastResult:
+        """Interval forecast: per-series quantile rows, served from the
+        version's quantile plane when it covers every requested
+        (bucket, quantile) pair — a vectorized memmap gather, zero JAX
+        dispatch — else through the row-local compute fallback
+        (``uncertainty.qplane.compute_rows``), which reproduces
+        plane-covered cells bit for bit by construction.
+
+        Synchronous by design: the gather path does no device work to
+        coalesce, and the fallback is host-side sampling — neither
+        belongs in the dispatch pump's batch economics.  ``quantiles``
+        defaults to the plane's published set (or
+        ``DEFAULT_QUANTILES`` with no plane); a long-tail quantile the
+        plane does not carry routes the whole request to compute.
+
+        Returns a :class:`ForecastResult` whose values are keyed
+        ``"q<permille>"`` (``q100``/``q500``/``q900`` by default);
+        ``from_cache`` counts plane-served rows."""
+        from tsspark_tpu.uncertainty import advi as advi_mod
+        from tsspark_tpu.uncertainty import qplane
+
+        t0 = time.monotonic()
+        sids = [str(s) for s in series_ids]
+        if not sids:
+            raise ValueError("series_ids must be non-empty")
+        with self._pump_lock:
+            snap = self.refresh()
+        version = snap.version
+        idx, missing = snap.rows(sids)
+        if missing:
+            raise UnknownSeries(missing, version)
+        idx = np.asarray(idx, np.int64)
+        h = int(horizon)
+        hb = max(self.horizon_floor, next_pow2(h))
+        view = self._qplane_for(version)
+        qs = (tuple(float(q) for q in quantiles)
+              if quantiles is not None
+              else (view.quantiles if view is not None
+                    else qplane.DEFAULT_QUANTILES))
+        if view is not None and view.covers(hb, qs):
+            grid, gathered = qplane.quantile_batch(view, snap, idx, hb)
+            values = {f"q{qplane.permille(q):03d}":
+                      gathered[qplane.permille(q)][:, :h] for q in qs}
+            ds = grid[:, :h]
+            self.stats.qplane_hits += len(sids)
+            cached = len(sids)
+        else:
+            self.stats.qplane_misses += len(sids)
+            draws = view.draws if view is not None else \
+                qplane.DEFAULT_DRAWS
+            seed = view.seed if view is not None else \
+                qplane.DEFAULT_SEED
+            posterior = None
+            if view is not None and view.mode == "advi":
+                loaded = advi_mod.load_posterior(
+                    self.registry.version_dir(version)
+                )
+                if loaded is not None:
+                    posterior = loaded[0]
+            cols = qplane.compute_rows(
+                snap, self.registry.config, self.backend, idx, hb,
+                quantiles=qs, draws=draws, seed=seed,
+                posterior=posterior,
+            )
+            meta = snap.state.meta
+            last = (np.asarray(meta.ds_start, np.float64)[idx]
+                    + np.asarray(meta.ds_span, np.float64)[idx])
+            step = np.asarray(snap.step, np.float64)[idx]
+            grid = last[:, None] + step[:, None] * np.arange(1, hb + 1)
+            values = {f"q{qplane.permille(q):03d}":
+                      cols[qplane.permille(q)][:, :h] for q in qs}
+            ds = grid[:, :h]
+            cached = 0
+        return ForecastResult(
+            series_ids=sids, ds=ds, values=values, version=version,
+            latency_s=time.monotonic() - t0, from_cache=cached,
+        )
 
     # -- version discipline (pool support) -------------------------------------
 

@@ -326,6 +326,35 @@ class _Replica:
                 self.engine.ensure_version(-1)  # any flip: force reload
             return {"ok": True, "id": rid, "replica": self.slot,
                     "version": self.engine.served_version()}
+        if cmd == "quantiles":
+            # Interval read: synchronous on the engine (plane gather or
+            # row-local compute fallback — no dispatch pump involved).
+            import numpy as np
+
+            if self.fenced.is_set():
+                return self._error(rid, ReplicaFenced(
+                    f"slot {self.slot} lease lost"
+                ).to_dict())
+            try:
+                res = self.engine.quantiles(
+                    msg["series_ids"], int(msg["horizon"]),
+                    quantiles=msg.get("quantiles"),
+                )
+            except ServeError as e:
+                return self._error(rid, e.to_dict())
+            except (KeyError, TypeError, ValueError) as e:
+                return self._error(rid, {"type": "BadRequest",
+                                         "detail": str(e)})
+            return {
+                "ok": True, "id": rid, "replica": self.slot,
+                "version": res.version,
+                "latency_ms": round(res.latency_s * 1e3, 3),
+                "from_cache": res.from_cache,
+                "series_ids": list(res.series_ids),
+                "ds": np.asarray(res.ds).tolist(),
+                **{k: np.asarray(v).tolist()
+                   for k, v in res.values.items()},
+            }
         if cmd == "quit":
             self.stop.set()
             return {"ok": True, "id": rid, "replica": self.slot}
@@ -1088,6 +1117,25 @@ class ReplicaPool:
             resp["disk_ladder"] = current_state(self.registry_root)
         return resp
 
+    def quantiles(self, series_ids: Sequence, horizon: int,
+                  quantiles: Optional[Sequence[float]] = None) -> Dict:
+        """Route one interval read to the home replica: quantile-plane
+        mmap gather on the replica when covered, row-local compute
+        fallback otherwise.  Same failover and staleness marking as
+        :meth:`forecast`."""
+        payload = {
+            "id": self._next_rid(), "cmd": "quantiles",
+            "series_ids": [str(s) for s in series_ids],
+            "horizon": int(horizon),
+        }
+        if quantiles is not None:
+            payload["quantiles"] = [float(q) for q in quantiles]
+        resp = self._route(payload)
+        if isinstance(resp, dict) and stale_serving(self.registry_root):
+            resp["stale"] = True
+            resp["disk_ladder"] = current_state(self.registry_root)
+        return resp
+
     def _route(self, payload: Dict,
                skip_slot: Optional[int] = None) -> Dict:
         """``skip_slot``: a slot the caller just observed failing (the
@@ -1312,13 +1360,17 @@ class ReplicaPool:
         target (both idempotent; see ``fplane.maybe_publish`` /
         ``aotbank.build_bank``).  Failures degrade to an event — the
         flip itself must never hinge on speculative precompute."""
-        out: Dict = {"fplane": None, "aot": None}
+        out: Dict = {"fplane": None, "qplane": None, "aot": None}
         try:
             from tsspark_tpu.serve import aotbank, fplane
+            from tsspark_tpu.uncertainty import qplane
 
             pub = fplane.maybe_publish(self.registry, version,
                                        horizons=horizons)
             out["fplane"] = None if pub is None else pub.get("status")
+            qpub = qplane.maybe_publish(self.registry, version,
+                                        horizons=horizons)
+            out["qplane"] = None if qpub is None else qpub.get("status")
             bank_dir = aotbank.cache_dir_from_env()
             if bank_dir:
                 from tsspark_tpu.backends.registry import get_backend

@@ -1,0 +1,45 @@
+"""Uncertainty tier: intervals as a served product, not a side path.
+
+The ladder (cheap to gold), after "Going NUTS with ADVI" (PAPERS.md,
+arXiv 2601.20120) measured ADVI intervals at NUTS quality for this
+model family at a fraction of the cost:
+
+* **MAP predictive** — the existing ``models/prophet/predict.py``
+  recipe: simulated future changepoints + observation noise around the
+  MAP point.  Free (no extra fit), but ignores parameter uncertainty.
+* **ADVI** (:mod:`~tsspark_tpu.uncertainty.advi`) — a mean-field
+  Gaussian posterior per series, fitted by a vmapped ELBO loop over the
+  same padded design tensors as the L-BFGS MAP solve.  The default
+  served tier.
+* **NUTS gold** (:mod:`~tsspark_tpu.uncertainty.gold`) — full HMC
+  chains (``ops/hmc.py``) on a deterministic sampled subset per
+  version, auditing the ADVI intervals.
+
+Served through the **quantile plane**
+(:mod:`~tsspark_tpu.uncertainty.qplane`): quantile forecast columns
+published next to the point-forecast plane with the same spec-first /
+CRC-sentinel protocol, answered from an mmap gather with zero JAX
+dispatch, and regression-gated by the **calibration eval**
+(:mod:`~tsspark_tpu.uncertainty.calibrate`) — empirical coverage vs
+nominal per horizon bucket under ``[tool.tsspark.slo.calibration]``.
+"""
+
+from tsspark_tpu.uncertainty.advi import (  # noqa: F401
+    AdviPosterior,
+    fit_advi,
+    load_posterior,
+    save_posterior,
+)
+from tsspark_tpu.uncertainty import calibrate  # noqa: F401
+from tsspark_tpu.uncertainty import gold  # noqa: F401
+from tsspark_tpu.uncertainty import qplane  # noqa: F401
+
+__all__ = [
+    "AdviPosterior",
+    "fit_advi",
+    "load_posterior",
+    "save_posterior",
+    "calibrate",
+    "gold",
+    "qplane",
+]

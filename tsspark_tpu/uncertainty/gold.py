@@ -1,0 +1,257 @@
+"""NUTS gold tier: sampled full-posterior audit of the ADVI intervals.
+
+"Going NUTS with ADVI" (PAPERS.md) justifies serving ADVI intervals by
+measuring them against NUTS — this module is that measurement wired
+into the fleet.  Running full HMC chains (``ops/hmc.py``) over a
+million series per version is not a serving cost anyone pays, so the
+gold tier samples a **deterministic audit subset** per version
+(``SeedSequence((seed, version))`` — every operator who re-runs the
+audit for a version sees the same rows) and records the
+parameter-space quantile divergence between the two posteriors.
+
+The divergence unit is **NUTS posterior standard deviations**: for each
+audited quantile ``q``, parameter ``p`` and series ``b``,
+
+    |Q_nuts(q) - (mu + exp(rho) * z_q)| / sd_nuts
+
+maximized over parameters and quantiles.  ~0.1 sd means the mean-field
+fit is indistinguishable from gold at served-interval resolution; a
+drift upward across versions is the early-warning signal that the
+model family has outgrown the Gaussian approximation.  The report
+lands as ``gold_audit.json`` in the version dir (atomic, same identity
+header posture as every other published artifact) and flows into
+RUNHISTORY through the calibration row family.
+
+NUTS log density includes the ``log_sigma`` change-of-variables
+Jacobian (``models/prophet/model.mcmc_core``) while the ADVI objective
+is the MAP parameterization without it — a known, deliberate modeling
+difference that shows up as a small constant sigma-quantile offset in
+the divergence, not a regression signal.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from statistics import NormalDist
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from tsspark_tpu.config import NUMERICS_REV, McmcConfig
+from tsspark_tpu.io import atomic_write
+from tsspark_tpu.obs import context as obs
+
+__all__ = [
+    "GOLD_FILE",
+    "GOLD_FORMAT",
+    "DEFAULT_MAX_SERIES",
+    "select_rows",
+    "quantile_divergence",
+    "run_gold",
+    "audit_version",
+    "load_audit",
+]
+
+GOLD_FORMAT = 1
+GOLD_FILE = "gold_audit.json"
+
+#: Audit subset size.  Small on purpose: the gold tier exists to detect
+#: posterior-family drift, and eight full NUTS chains per version is
+#: already ~1e3x the evidence of zero.
+DEFAULT_MAX_SERIES = 8
+DEFAULT_QUANTILES = (0.1, 0.5, 0.9)
+
+
+def select_rows(
+    n_series: int,
+    version: int,
+    *,
+    max_series: int = DEFAULT_MAX_SERIES,
+    seed: int = 0,
+) -> np.ndarray:
+    """The version's deterministic audit subset (sorted row indices).
+
+    Keyed by ``SeedSequence((seed, version))``: re-running the audit for
+    a version always lands on the same rows, and consecutive versions
+    rotate coverage across the fleet instead of auditing one lucky
+    corner forever.
+    """
+    if n_series <= max_series:
+        return np.arange(n_series, dtype=np.int64)
+    rng = np.random.default_rng(
+        np.random.SeedSequence((int(seed), int(version)))
+    )
+    rows = rng.choice(n_series, size=int(max_series), replace=False)
+    return np.sort(rows).astype(np.int64)
+
+
+def quantile_divergence(
+    samples,
+    mu,
+    rho,
+    quantiles: Sequence[float] = DEFAULT_QUANTILES,
+) -> np.ndarray:
+    """ADVI-vs-NUTS quantile divergence per series, (B,).
+
+    For each quantile the NUTS empirical quantile of the (S, B, P)
+    draws is compared against the ADVI Gaussian quantile
+    ``mu + exp(rho) * z_q``, normalized by the WIDER of the two
+    posterior sds — the max over parameters and quantiles is the
+    series' divergence in posterior-sd units.  Normalizing by the
+    wider sd keeps the metric finite when a short chain collapses on
+    a marginal (NUTS sd ~ 0 would otherwise blow the ratio up on a
+    sampler artifact rather than a posterior-family failure).
+    """
+    s = np.asarray(samples, np.float64)
+    mu = np.asarray(mu, np.float64)
+    sd = np.exp(np.asarray(rho, np.float64))
+    scale = np.maximum(np.maximum(s.std(axis=0, ddof=1), sd), 1e-12)
+    div = np.zeros(mu.shape[0], np.float64)
+    for q in quantiles:
+        z = NormalDist().inv_cdf(float(q))
+        gap = np.abs(np.quantile(s, float(q), axis=0) - (mu + sd * z))
+        div = np.maximum(div, (gap / scale).max(axis=-1))
+    return div
+
+
+def run_gold(
+    data,
+    theta0,
+    config,
+    key,
+    mcmc_config: Optional[McmcConfig] = None,
+) -> Tuple:
+    """One batched NUTS run + split diagnostics over the audit subset.
+
+    Thin wrapper over the fleet's existing jitted sampler program
+    (``models/prophet/model.mcmc_core`` -> ``ops/hmc.sample``) — the
+    gold tier adds no new numerics, only selection and measurement.
+
+    Returns ``(HmcResult, rhat (B, P), ess (B, P))``.
+    """
+    from tsspark_tpu.models.prophet.model import mcmc_core
+    from tsspark_tpu.ops import hmc
+
+    mcmc_config = McmcConfig() if mcmc_config is None else mcmc_config
+    res = mcmc_core(data, theta0, key, config, mcmc_config)
+    rhat, ess = hmc.split_rhat_ess(res.samples)
+    return res, rhat, ess
+
+
+def audit_version(
+    registry,
+    data_dir: Optional[str] = None,
+    version: Optional[int] = None,
+    *,
+    arrays: Optional[Tuple] = None,
+    max_series: int = DEFAULT_MAX_SERIES,
+    seed: int = 0,
+    mcmc_config: Optional[McmcConfig] = None,
+    quantiles: Sequence[float] = DEFAULT_QUANTILES,
+) -> Optional[dict]:
+    """Audit one registry version's ADVI posterior against NUTS.
+
+    Loads the version's posterior + snapshot, gathers the audit rows'
+    data off the data plane (``data_dir``; or pass ``arrays=(ds, y,
+    mask, cap)`` directly — e.g. the holdout-truncated arrays the
+    calibration smoke fitted on, so the two posteriors condition on the
+    SAME data), runs the gold chains warm-started from the MAP theta,
+    and writes ``gold_audit.json`` into the version dir.  Returns the
+    report dict, or None when the version has no usable ADVI posterior
+    (nothing to audit — the fleet is serving MAP intervals).
+    """
+    import jax
+
+    from tsspark_tpu.models.prophet.design import prepare_fit_data
+    from tsspark_tpu.uncertainty import advi as advi_mod
+    from tsspark_tpu.uncertainty.qplane import _advi_eligible
+
+    version = (registry.active_version() if version is None
+               else int(version))
+    if version is None:
+        return None
+    vdir = registry.version_dir(int(version))
+    loaded = advi_mod.load_posterior(vdir)
+    if loaded is None or not _advi_eligible(registry.config):
+        obs.event("gold.skipped", version=int(version),
+                  reason="no-advi-posterior")
+        return None
+    post, header = loaded
+
+    snap = registry.load(int(version))
+    n = len(snap.series_ids)
+    if int(np.asarray(post.mu).shape[0]) != n:
+        obs.event("gold.skipped", version=int(version),
+                  reason="posterior-shape-mismatch")
+        return None
+    rows = select_rows(n, int(version), max_series=max_series,
+                       seed=seed)
+
+    if arrays is None:
+        from tsspark_tpu.data import plane
+
+        batch = plane.open_batch(data_dir)
+        ds, y = np.asarray(batch.ds), batch.y
+        mask, cap = batch.mask, batch.cap
+    else:
+        ds, y, mask, cap = arrays
+    sub = lambda a: (None if a is None
+                     else np.ascontiguousarray(np.asarray(a)[rows]))
+    data, _meta = prepare_fit_data(
+        np.asarray(ds, np.float64), sub(y), registry.config,
+        mask=sub(mask), cap=sub(cap),
+    )
+    state_sub, _step = snap.take(rows)
+    theta0 = np.nan_to_num(np.asarray(state_sub.theta, np.float32))
+
+    mcmc_config = McmcConfig() if mcmc_config is None else mcmc_config
+    key = jax.random.fold_in(jax.random.PRNGKey(int(seed)),
+                             int(version))
+    res, rhat, ess = run_gold(data, theta0, registry.config, key,
+                              mcmc_config)
+    div = quantile_divergence(
+        res.samples, np.asarray(post.mu)[rows],
+        np.asarray(post.rho)[rows], quantiles,
+    )
+    report = {
+        "format": GOLD_FORMAT,
+        "numerics_rev": NUMERICS_REV,
+        "version": int(version),
+        "seed": int(seed),
+        "posterior_seed": int(header.get("seed", 0)),
+        "rows": [int(r) for r in rows],
+        "quantiles": [float(q) for q in quantiles],
+        "num_warmup": int(mcmc_config.num_warmup),
+        "num_samples": int(mcmc_config.num_samples),
+        "qdiv": [round(float(d), 6) for d in div],
+        "qdiv_max": round(float(div.max()), 6),
+        "qdiv_mean": round(float(div.mean()), 6),
+        "rhat_max": round(float(np.max(rhat)), 6),
+        "ess_min": round(float(np.min(ess)), 3),
+        "accept_mean": round(
+            float(np.asarray(res.accept_rate).mean()), 6),
+        "hmc_divergences": int(np.asarray(res.divergences).sum()),
+    }
+    atomic_write(
+        os.path.join(vdir, GOLD_FILE),
+        lambda fh: json.dump(report, fh, indent=1), mode="w",
+    )
+    obs.event("gold.audit", version=int(version),
+              qdiv_max=report["qdiv_max"],
+              rhat_max=report["rhat_max"],
+              hmc_divergences=report["hmc_divergences"])
+    return report
+
+
+def load_audit(version_dir: str) -> Optional[dict]:
+    """The version's gold audit report, or None when absent/unreadable."""
+    path = os.path.join(version_dir, GOLD_FILE)
+    try:
+        with open(path) as fh:
+            report = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if report.get("format") != GOLD_FORMAT:
+        return None
+    return report
