@@ -1,0 +1,358 @@
+"""Exactly-once anomaly alert stream (tsspark_tpu/alerts,
+docs/ALERTS.md): deterministic scoring (interval vs z-score breach
+parity), the record/CRC-sentinel publish protocol under a full
+kill-point sweep, replay idempotence across randomized kill points and
+sink brownouts, the data-liveness kind's durable queue, and the alert
+key dedup that turns at-least-once delivery into exactly-once."""
+
+import collections
+import json
+import os
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tsspark_tpu.alerts.score import (
+    alert_key,
+    canonical_bytes,
+    record_crc,
+    score_delta,
+    score_rows,
+)
+from tsspark_tpu.alerts.sink import (
+    FlakySink,
+    JsonlSink,
+    SinkError,
+    build_sink,
+)
+from tsspark_tpu.alerts.stream import AlertStream
+from tsspark_tpu.backends.registry import get_backend
+from tsspark_tpu.config import ProphetConfig, SeasonalityConfig, SolverConfig
+from tsspark_tpu.data import plane
+from tsspark_tpu.resilience import FaultPlan, faults
+from tsspark_tpu.serve import ForecastCache, ParamRegistry, PredictionEngine
+
+CFG = ProphetConfig(
+    seasonalities=(SeasonalityConfig("weekly", 7.0, 2),), n_changepoints=3
+)
+SOLVER = SolverConfig(max_iters=25)
+N = 6
+#: Fires on any visible residual / silences data-liveness — the tests
+#: control WHICH alerts exist, not the model's accuracy.
+Z_FIRE, K_QUIET = 0.05, 1e9
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(7)
+    t = np.arange(120.0)
+    y = (10 + 0.02 * t[None, :] + np.sin(2 * np.pi * t[None, :] / 7)
+         + rng.normal(0, 0.1, (N, 120)))
+    backend = get_backend("tpu", CFG, SOLVER)
+    return backend.fit(t, jnp.asarray(y))
+
+
+@pytest.fixture()
+def world(tmp_path, fitted):
+    """(dset_dir, registry, engine): a plane dataset whose series ids
+    are what the registry serves — the scorer's whole universe."""
+    spec = plane.DatasetSpec(generator="demo_weekly", n_series=N,
+                             n_timesteps=64, seed=2)
+    dset = plane.ensure(spec, root=str(tmp_path / "plane"))
+    pids = plane.series_ids(spec)
+    reg = ParamRegistry(str(tmp_path / "registry"), CFG)
+    reg.publish(fitted, pids, step=np.ones(N))
+    engine = PredictionEngine(reg, cache=ForecastCache(0))
+    return dset, reg, engine
+
+
+def _stream(world, log_dir, sink=None, **kw):
+    dset, _reg, engine = world
+    kw.setdefault("z", Z_FIRE)
+    kw.setdefault("overdue_k", K_QUIET)
+    return AlertStream(str(log_dir), dset, engine,
+                       sink if sink is not None
+                       else JsonlSink(str(log_dir) + "_sink.jsonl"),
+                       horizon=1, **kw)
+
+
+def _land(dset, rows=(0, 2, 4)):
+    plane.land_synthetic_delta(
+        dset, 0.5, rows=np.asarray(rows, np.int64))
+
+
+def _rec_path(log_dir, seq):
+    return os.path.join(str(log_dir), f"alertrec_{seq:06d}.json")
+
+
+def _ok_path(log_dir, seq):
+    return os.path.join(str(log_dir), f"alertok_{seq:06d}.json")
+
+
+def test_score_rows_interval_vs_zscore_breach_parity():
+    """The mode-parity pin: where both representations describe the
+    SAME band (interval [lo, hi] == yhat +/- z*sigma), they make the
+    same breach decisions — mode degradation changes evidence fields,
+    never which alerts exist."""
+    y = np.array([0.0, 10.0, 5.0, 6.5, 3.5])
+    yhat = np.full(5, 5.0)
+    sigma = np.full(5, 0.5)
+    z = 3.0  # band [3.5, 6.5]
+    fired_i, sev_i, mode_i = score_rows(y, lo=yhat - z * sigma,
+                                        hi=yhat + z * sigma)
+    fired_z, sev_z, mode_z = score_rows(y, yhat=yhat, sigma=sigma, z=z)
+    assert mode_i == "interval" and mode_z == "zscore"
+    np.testing.assert_array_equal(fired_i, fired_z)
+    np.testing.assert_array_equal(fired_i,
+                                  [True, True, False, False, False])
+    # Severity is positive exactly on fired rows in both modes.
+    assert ((sev_i > 0) == fired_i).all()
+    assert ((sev_z > 0) == fired_z).all()
+
+
+def test_score_delta_is_deterministic_bitwise(world):
+    """Re-scoring the same delta yields byte-identical canonical
+    records — the property that makes a successor's re-score converge
+    on the dead scorer's bytes."""
+    dset, _reg, engine = world
+    _land(dset)
+    a = score_delta(engine, dset, 1, z=Z_FIRE)
+    b = score_delta(engine, dset, 1, z=Z_FIRE)
+    assert canonical_bytes(a) == canonical_bytes(b)
+    assert record_crc(a) == record_crc(b)
+    assert a["n_fired"] >= 1
+    assert a["mode"] in ("interval", "zscore")
+    for al in a["alerts"]:
+        assert al["key"] == alert_key(al["kind"], al["series"],
+                                      a["seq"])
+
+
+def test_publish_kill_point_sweep_rescore_bitwise(world, tmp_path,
+                                                  monkeypatch):
+    """The protocol sweep: a scorer killed at ANY of the three
+    alert_publish injection sites (before the record, between record
+    and sentinel, after the sentinel) leaves a log a successor heals
+    to the SAME certified bytes a fault-free scorer writes."""
+    dset, _reg, engine = world
+    _land(dset)
+    ref = _stream(world, tmp_path / "ref")
+    ref.poll_once()
+    with open(_rec_path(tmp_path / "ref", 1), "rb") as fh:
+        want = fh.read()
+
+    for k in range(3):
+        log_dir = tmp_path / f"kill{k}"
+        s = _stream(world, log_dir)
+        plan = FaultPlan(state_dir=str(tmp_path / "faults" / str(k)))
+        plan.fail("alert_publish", after=k, mode="raise",
+                  tag=f"kill-{k}")
+        monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+        with pytest.raises(faults.FaultInjected):
+            s.poll_once()
+        monkeypatch.delenv(faults.ENV_VAR)
+        # k=2 dies after certification; earlier sites leave the seq
+        # uncertified.  Either way the successor converges bitwise.
+        heal = _stream(world, log_dir)
+        res = heal.poll_once()
+        assert heal.record_ok(1) is not None, f"kill point {k}"
+        with open(_rec_path(log_dir, 1), "rb") as fh:
+            assert fh.read() == want, f"kill point {k}"
+        assert not res["stalled"]
+        assert heal.delivered_seq() == heal.scored_seq() == 1
+
+
+def test_torn_record_and_torn_sentinel_rejected_then_healed(
+        world, tmp_path):
+    """CRC discipline: a flipped byte in a certified record (or a torn
+    sentinel) makes record_ok refuse it; the re-score restores the
+    original bytes and redelivery dedups to zero duplicates."""
+    dset, _reg, engine = world
+    _land(dset)
+    log_dir = tmp_path / "log"
+    s = _stream(world, log_dir)
+    s.poll_once()
+    with open(_rec_path(log_dir, 1), "rb") as fh:
+        orig = fh.read()
+
+    with open(_rec_path(log_dir, 1), "r+b") as fh:
+        fh.seek(7)
+        fh.write(bytes([orig[7] ^ 0xFF]))
+    s2 = _stream(world, log_dir)
+    assert s2.record_ok(1) is None
+    res = s2.poll_once()
+    with open(_rec_path(log_dir, 1), "rb") as fh:
+        assert fh.read() == orig
+    assert res["deduped"] == 0 and res["delivered"] == 0
+
+    os.truncate(_ok_path(log_dir, 1), 5)
+    s3 = _stream(world, log_dir)
+    assert s3.record_ok(1) is None
+    s3.poll_once()
+    assert s3.record_ok(1) is not None
+    with open(_rec_path(log_dir, 1), "rb") as fh:
+        assert fh.read() == orig
+    # The sink holds each key exactly once through all of it.
+    keys = [a["key"] for a in JsonlSink(
+        str(log_dir) + "_sink.jsonl").alerts()]
+    assert len(keys) == len(set(keys))
+
+
+def test_replay_idempotent_across_randomized_kill_points(world,
+                                                         tmp_path,
+                                                         monkeypatch):
+    """The property behind the chaos storm, in process: a randomized
+    schedule of publish kills, delivery kills, sink brownouts, torn
+    sentinels, and torn records — whatever the interleaving, once the
+    faults clear the sink holds every certified alert key EXACTLY once
+    and the watermark sits at the scored head."""
+    dset, _reg, engine = world
+    log_dir = tmp_path / "log"
+    sink_path = str(log_dir) + "_sink.jsonl"
+
+    for seed in range(4):
+        rng = random.Random(f"alert-replay:{seed}")
+        _land(dset, rows=rng.sample(range(N), 3))
+        disruption = rng.choice(
+            ["pub_kill", "del_kill", "brownout", "tear_ok",
+             "tear_rec"])
+        flaky = FlakySink(JsonlSink(sink_path), fail_n=0)
+        s = _stream(world, log_dir, sink=flaky)
+        if disruption == "pub_kill":
+            plan = FaultPlan(
+                state_dir=str(tmp_path / "f" / f"p{seed}"))
+            plan.fail("alert_publish", after=rng.randrange(3),
+                      mode="raise", tag="p")
+            monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+            with pytest.raises(faults.FaultInjected):
+                s.poll_once()
+            monkeypatch.delenv(faults.ENV_VAR)
+        elif disruption == "del_kill":
+            plan = FaultPlan(
+                state_dir=str(tmp_path / "f" / f"d{seed}"))
+            plan.fail("alert_deliver", after=rng.randrange(2),
+                      mode="raise", tag="d")
+            monkeypatch.setenv(faults.ENV_VAR, plan.to_env())
+            res = s.poll_once()  # delivery stalls, never raises out
+            assert res["stalled"]
+            monkeypatch.delenv(faults.ENV_VAR)
+        elif disruption == "brownout":
+            flaky.fail_n = flaky.attempts + rng.randrange(3, 9)
+            res = s.poll_once()
+            assert res["stalled"]
+            flaky.fail_n = 0
+        elif disruption == "tear_ok":
+            s.poll_once()
+            seq = s.scored_seq()
+            os.truncate(_ok_path(log_dir, seq), rng.randrange(5))
+        elif disruption == "tear_rec":
+            s.poll_once()
+            seq = s.scored_seq()
+            with open(_rec_path(log_dir, seq), "r+b") as fh:
+                fh.seek(3)
+                fh.write(b"\x00")
+
+        # Recovery: a fresh stream over the same log, healthy sink.
+        import time as _time
+
+        heal = _stream(world, log_dir,
+                       sink=JsonlSink(sink_path),
+                       breaker=None)
+        deadline = _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            res = heal.poll_once()
+            if (not res["stalled"]
+                    and heal.delivered_seq() == heal.scored_seq()):
+                break
+            _time.sleep(0.2)  # breaker reset window
+
+        assert heal.scored_seq() == plane.delta_seq(dset)
+        assert heal.delivered_seq() == heal.scored_seq()
+        expected = []
+        for q in range(1, heal.scored_seq() + 1):
+            rec = heal.record_ok(q)
+            assert rec is not None, (seed, disruption, q)
+            assert record_crc(rec) is not None
+            expected += [a["key"] for a in rec["alerts"]]
+        counts = collections.Counter(
+            a["key"] for a in JsonlSink(sink_path).alerts())
+        dupes = {k: n for k, n in counts.items() if n > 1}
+        assert not dupes, (seed, disruption, dupes)
+        assert set(expected) <= set(counts), (seed, disruption)
+
+
+def test_liveness_alerts_queue_survives_brownout(world, tmp_path):
+    """The data-liveness kind rides the durable loose queue: overdue
+    series alert once per silence episode, a browned-out sink queues
+    them durably, and the drain delivers each exactly once."""
+    dset, _reg, engine = world
+    _land(dset, rows=(0, 1, 2, 3, 4, 5))
+    _land(dset, rows=(0, 1, 2, 3, 4, 5))
+    _land(dset, rows=(0, 3))
+    log_dir = tmp_path / "log"
+    sink_path = str(log_dir) + "_sink.jsonl"
+    flaky = FlakySink(JsonlSink(sink_path), fail_n=0)
+    s = _stream(world, log_dir, sink=flaky)  # liveness quiet for now
+    s.poll_once()
+    # Rows 1/2/4/5 saw two arrivals then silence: with a tiny overdue
+    # multiple they are overdue "now"; the browned-out sink queues.
+    s.overdue_k = 0.1
+    flaky.fail_n = 500
+    now = __import__("time").time() + 3600.0
+    live = s.liveness_alerts(now)
+    assert {a["kind"] for a in live} == {"data-liveness"}
+    assert {a["series"] for a in live} >= {"1", "2"} or len(live) >= 2
+    res = s.deliver_loose(live)
+    assert res["stalled"] and res["queued"] >= len(live)
+    q_path = os.path.join(str(log_dir), "alerts_queue.jsonl")
+    assert os.path.exists(q_path)
+
+    flaky.fail_n = 0
+    import time as _time
+
+    _time.sleep(1.1)  # default breaker reset window
+    drained = s.deliver_loose([])
+    assert not drained["stalled"] and drained["queued"] == 0
+    counts = collections.Counter(
+        a["key"] for a in JsonlSink(sink_path).alerts()
+        if a["kind"] == "data-liveness")
+    assert counts and all(n == 1 for n in counts.values())
+
+
+def test_sink_specs_and_recover():
+    with pytest.raises(ValueError):
+        build_sink("kafka://nope")
+    assert build_sink("jsonl:/tmp/x.jsonl").name == "jsonl"
+
+
+def test_alert_record_protocol_registered():
+    """The analysis tier models the alert log's write protocol (spec
+    FIRST, record, CRC sentinel LAST as the gate) — the gate that keeps
+    refactors from reordering the crash-safety dance."""
+    from tsspark_tpu.analysis import protomodel
+
+    spec = next(p for p in protomodel.PROTOCOLS
+                if p.name == "alert-record")
+    assert [s.name for s in spec.steps] == ["spec", "record",
+                                            "sentinel"]
+    gate = spec.steps[-1]
+    assert gate.role == "gate"
+    assert set(gate.certifies) == {"spec", "record"}
+
+
+def test_arrival_model_overdue_rows():
+    """The scheduler-side satellite: overdue_rows surfaces rows whose
+    silence exceeds k EWMAs — the gauge feed and the liveness kind's
+    trigger."""
+    from tsspark_tpu.sched import ArrivalModel
+
+    m = ArrivalModel()
+    for seq, t in ((1, 100.0), (2, 110.0), (3, 120.0)):
+        m.note_delta(seq, t, [0, 1])
+    m.note_delta(4, 130.0, [1])
+    # Row 0's EWMA gap is 10s, last seen t=120.  At t=200 it is 80s
+    # silent: overdue for any k below 8.
+    over = m.overdue_rows(200.0, k=3.0)
+    assert 0 in over and over[0] == pytest.approx(80.0 - 30.0)
+    assert m.overdue_rows(121.0, k=3.0) == {}

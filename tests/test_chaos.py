@@ -100,6 +100,41 @@ def test_smoke_storm_all_invariants_green(tmp_path):
     assert obs_main(["report", ledger_path]) == 0
 
 
+def test_alerts_storm_all_invariants_green(tmp_path):
+    """The alert-stream fault-domain smoke (docs/ALERTS.md,
+    docs/RESILIENCE.md § Alert-stream fault domain): the scorer child
+    SIGKILLed mid-publish (record landed, CRC sentinel not) and again
+    mid-delivery, a sink brownout opening the breaker with the
+    watermark held, and a torn certified record — judged by
+    alerts_exactly_once: every certified alert key in the sink exactly
+    once, watermark at the scored head."""
+    classes = set(compose(1, "alerts").by_class())
+    assert {"alert-scorer-kill", "alert-sink-brownout",
+            "torn-alert-record"} <= classes
+    # Both kill points are scheduled for the scorer-kill class.
+    points = {i.point for i in compose(1, "alerts").injections
+              if i.cls == "alert-scorer-kill"}
+    assert points == {"alert_publish", "alert_deliver"}
+    report = run_storm(seed=1, profile="alerts",
+                       scratch=str(tmp_path / "storm"))
+    assert report["ok"], report["invariants"]
+    inv = report["invariants"]
+    for key in ("alerts_scorer_kill", "alerts_sink_brownout",
+                "alerts_torn_record", "alerts_exactly_once"):
+        assert inv[key]["ok"], (key, inv[key])
+    eo = inv["alerts_exactly_once"]
+    assert eo["duplicates"] == 0 and eo["missing"] == 0
+    assert eo["watermark"] == eo["scored"] > 0
+    assert inv["alerts_scorer_kill"]["deliver"]["deduped"] >= 1
+    assert inv["alerts_sink_brownout"]["breaker_opened"]
+    assert inv["alerts_sink_brownout"]["watermark_held"]
+    assert inv["alerts_torn_record"]["crc_rejected_tear"]
+    assert inv["alerts_torn_record"]["rescore_bitwise"]
+    assert inv["recovery_within_budget"]["ok"]
+    assert inv["trace_joined"]["ok"], inv["trace_joined"]
+    assert report["workload"]["alerts_storm"] is True
+
+
 def test_storage_storm_all_invariants_green(tmp_path):
     """The storage-fault-domain smoke (docs/RESILIENCE.md § Storage
     fault domain): the five storage chaos classes — ENOSPC mid-publish,

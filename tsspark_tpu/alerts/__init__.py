@@ -1,0 +1,53 @@
+"""Exactly-once anomaly alert stream off the refit loop.
+
+The last open half of the forecasting-*service* story (ROADMAP item
+5(ii)): the scheduler already watches every landed delta and the
+uncertainty tier serves calibrated quantiles — exactly the thresholds
+an online anomaly scorer needs.  This package turns them into alerts a
+consumer can page on, with **exactly-once delivery** as the headline
+invariant:
+
+* ``score``  — deterministic vectorized scoring of each landed delta's
+  new observations against the active version's *served* forecast:
+  quantile-interval breach when the version publishes a quantile plane,
+  residual z-score fallback otherwise (degradation recorded per alert).
+  A re-score of (series, delta_seq, version) is bitwise the original.
+* ``stream`` — the durable alert log under the unified plane-protocol
+  discipline (spec FIRST, atomic per-cycle record, CRC sentinel LAST)
+  plus the delivery watermark: a scorer killed at ANY point resumes
+  from the watermark, and at-least-once delivery + keyed dedup
+  composes to an exactly-once effect.
+* ``sink``   — pluggable delivery sinks (JSONL first) behind
+  ``RetryPolicy`` + ``CircuitBreaker``; an open breaker queues alerts
+  durably and drains on recovery without duplicates; disk-ladder aware
+  (scoring detail is shed before any alert is dropped).
+* ``bench``  — ``python -m tsspark_tpu.alerts --bench RUNG``: the
+  land→alert freshness stream, judged under
+  ``[tool.tsspark.slo.alerts]``.
+
+The chaos storm's ``alerts`` stage (``tsspark_tpu.chaos``) SIGKILLs
+the scorer mid-publish and mid-delivery, browns out the sink, and
+tears a landed record; the ``alerts_exactly_once`` invariant proves
+zero dropped and zero duplicate alerts across every kill/resume.
+See docs/ALERTS.md for the scoring rules and the runbook.
+"""
+
+from tsspark_tpu.alerts.score import (  # noqa: F401
+    DEFAULT_Z,
+    canonical_bytes,
+    record_crc,
+    score_delta,
+    score_rows,
+)
+from tsspark_tpu.alerts.sink import (  # noqa: F401
+    AlertSink,
+    FlakySink,
+    JsonlSink,
+    SinkError,
+    build_sink,
+)
+from tsspark_tpu.alerts.stream import (  # noqa: F401
+    ALERT_DELIVER,
+    ALERT_PUBLISH,
+    AlertStream,
+)

@@ -301,6 +301,64 @@ ARTIFACTS: Tuple[ArtifactSpec, ...] = (
         "[tool.tsspark.slo.freshness]",
     ),
     ArtifactSpec(
+        "alerts-bench-report", ("BENCH_alerts_",),
+        ("_write_alerts_report",),
+        "alert-stream report (bench --alerts; tsspark_tpu.alerts."
+        "bench): land->alert-ack freshness p50/p95 under a churn "
+        "stream, written once atomically and judged by the regression "
+        "sentinel under [tool.tsspark.slo.alerts]",
+    ),
+    ArtifactSpec(
+        "alerts-spec", ("alerts_spec.json",),
+        ("AlertStream._ensure_spec",),
+        "alert-log identity record (alerts/stream.py): dataset/"
+        "horizon/quantiles/sink — the spec-FIRST step of the alert-"
+        "record protocol, written once atomically before any record",
+    ),
+    ArtifactSpec(
+        "alert-record", ("alertrec_",),
+        ("AlertStream.score_seq",),
+        "one delta's canonical alert record (alerts/stream.py): the "
+        "deterministic scorer's output bytes, landed atomically; "
+        "UNREADABLE until its alertok_ sentinel certifies the CRC — a "
+        "killed scorer leaves it unscored and the successor's "
+        "re-score converges bitwise",
+    ),
+    ArtifactSpec(
+        "alert-record-ok", ("alertok_",),
+        ("AlertStream.score_seq",),
+        "CRC sentinel certifying one alert record's canonical bytes "
+        "(the sentinel-LAST step): readers treat a missing/mismatched "
+        "sentinel as not-scored, never as empty",
+    ),
+    ArtifactSpec(
+        "alert-watermark", ("alerts_watermark.json",),
+        ("AlertStream._advance_watermark",),
+        "delivery watermark (alerts/stream.py): highest seq whose "
+        "alerts the sink has ALL acked, replaced atomically only "
+        "after the acks; a torn/absent watermark reads as 0 and the "
+        "keyed dedup makes redelivery harmless — fast-forward "
+        "pointer, never a correctness input",
+    ),
+    ArtifactSpec(
+        "alert-sink-queue", ("alerts_queue.jsonl",),
+        ("AlertStream.deliver_loose", "AlertStream._rewrite_queue"),
+        "durable overflow queue for loose alerts an open sink breaker "
+        "refused (alerts/stream.py): appended per refused alert, "
+        "drained with keyed dedup on recovery, rewritten atomically — "
+        "alerts are never dropped, only parked here",
+        append_ok=True,
+    ),
+    ArtifactSpec(
+        "alert-sink", (),
+        ("JsonlSink.emit", "JsonlSink.recover"),
+        "the JSONL delivery sink (alerts/sink.py): one alert per line "
+        "through the durable append path at a caller-supplied path; "
+        "readers tolerate a torn last line and recover() terminates "
+        "it so later appends never concatenate",
+        append_ok=True,
+    ),
+    ArtifactSpec(
         "delta-bench-report", ("BENCH_delta_",),
         ("run_delta_bench",),
         "delta-refit churn-sweep report (bench --delta): one "
@@ -555,6 +613,9 @@ PROTOCOL_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/serve/replica.py",
     "tsspark_tpu/serve/__main__.py",
     "tsspark_tpu/bench_scale.py",
+    "tsspark_tpu/alerts/stream.py",
+    "tsspark_tpu/alerts/sink.py",
+    "tsspark_tpu/alerts/bench.py",
     "tsspark_tpu/chaos/storm.py",
     "tsspark_tpu/chaos/harness.py",
     "tsspark_tpu/chaos/invariants.py",
@@ -837,6 +898,7 @@ IO_ROUTED_PREFIXES: Tuple[str, ...] = (
     "tsspark_tpu/data/",
     "tsspark_tpu/serve/",
     "tsspark_tpu/plane/",
+    "tsspark_tpu/alerts/",
 )
 IO_ROUTED_MODULES: Tuple[str, ...] = (
     "tsspark_tpu/refit.py",

@@ -331,6 +331,30 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
                "resumes, complete=true lets the reaper collect the "
                "cycle dir",
     ),
+    ProtocolSpec(
+        "alert-record",
+        "tsspark_tpu/alerts/stream.py", "AlertStream.score_seq",
+        steps=(
+            StepSpec("spec", "call:write_spec",
+                     reader="the alert log's identity lands before any "
+                            "record; record_ok never consults it for "
+                            "validity, so a duplicate ensure is a "
+                            "no-op"),
+            StepSpec("record", "art:alert-record",
+                     reader="canonical record bytes are UNSCORED until "
+                            "the CRC sentinel certifies them "
+                            "(record_ok); the alert_publish fault "
+                            "point tears here and the successor's "
+                            "re-score converges bitwise"),
+            StepSpec("sentinel", "call:write_sentinel", role="gate",
+                     certifies=("spec", "record")),
+        ),
+        resume="a scorer killed at any step leaves seq without a valid "
+               "sentinel: poll() re-scores it (deterministic, bitwise "
+               "the original) and the delivery watermark — advanced "
+               "only after sink ack — plus keyed dedup make the "
+               "redelivery exactly-once",
+    ),
 )
 
 

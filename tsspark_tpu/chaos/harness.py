@@ -1881,6 +1881,299 @@ def _run_qplane_storm(scratch: str, storm: StormPlan, state, ids,
             os.environ[faults.ENV_VAR] = env_plan
 
 
+def _run_alerts_storm(scratch: str, storm: StormPlan, state,
+                      mttr: Dict[str, Optional[float]],
+                      deadline_s: float) -> Tuple[Dict, Dict]:
+    """The alert-stream fault domain (tsspark_tpu.alerts): three
+    classes against a live exactly-once pipeline.
+
+    * alert-scorer-kill — the scorer CHILD (``python -m
+      tsspark_tpu.alerts --poll-once``) dies twice: once at the armed
+      ``alert_publish`` exit fault (before the record, between record
+      and CRC sentinel, or right after it — the draw picks), once at
+      ``alert_deliver`` mid sink emit with alerts already acked.  The
+      successor must re-score any uncertified delta BITWISE (the
+      orphan record's bytes are the oracle) and redeliver past the
+      watermark with the sink's key set deduping every repeat.
+    * alert-sink-brownout — the sink raises for a window: the breaker
+      opens, the watermark HOLDS, and the drain after relief is clean.
+    * torn-alert-record — a certified record's bytes are flipped under
+      its sentinel: the CRC check rejects it and the re-score
+      converges bitwise to the pre-tear bytes.
+
+    All of it collapses into ``alerts_exactly_once``: every alert key
+    the certified records expect is in the sink exactly once.
+
+    Runs with the storm env plan popped: the children get PRIVATE
+    plans — an exit fault firing in-process would kill the harness."""
+    import subprocess
+
+    from tsspark_tpu import orchestrate
+    from tsspark_tpu.alerts.sink import FlakySink, JsonlSink
+    from tsspark_tpu.alerts.stream import AlertStream
+    from tsspark_tpu.data import plane
+    from tsspark_tpu.serve.cache import ForecastCache
+    from tsspark_tpu.serve.engine import PredictionEngine
+    from tsspark_tpu.serve.registry import ParamRegistry
+
+    base = os.path.join(scratch, "alerts")
+    os.makedirs(base, exist_ok=True)
+    t0 = time.time()
+    prof = storm.profile
+    env_plan = os.environ.pop(faults.ENV_VAR, None)
+    try:
+        cfg, _solver = _config(prof.max_iters)
+        spec = plane.DatasetSpec(generator="demo_weekly",
+                                 n_series=prof.series,
+                                 n_timesteps=prof.days, seed=2)
+        dset = plane.ensure(spec, root=os.path.join(base, "plane"))
+        pids = plane.series_ids(spec)
+        registry = ParamRegistry(os.path.join(base, "registry"), cfg)
+        v1 = registry.publish(state, pids,
+                              step=np.ones(len(pids)))
+        log_dir = os.path.join(base, "log")
+        sink_path = os.path.join(base, "sink.jsonl")
+        # z tiny so every churned row FIRES (the storm needs alerts in
+        # flight, not a quiet fleet); overdue_k huge so data-liveness
+        # stays silent — the exactly-once ledger below is then exactly
+        # the certified records' alert keys.
+        z_fire, k_quiet = 0.05, 1e9
+        rng = np.random.default_rng([29, storm.seed])
+        churn_rows = max(4, prof.series // 3)
+
+        def _land():
+            rows = np.sort(rng.choice(prof.series, size=churn_rows,
+                                      replace=False)).astype(np.int64)
+            plane.land_synthetic_delta(dset, 0.25, rows=rows)
+
+        def _scorer_child(point: str, after: int, rc: int):
+            plan = faults.FaultPlan(
+                state_dir=os.path.join(base, f"faults_{point}"))
+            plan.fail(point, attempts=1, after=after, mode="exit",
+                      rc=rc, tag="alert-scorer-kill")
+            env = orchestrate._child_env()
+            env[faults.ENV_VAR] = plan.to_env()
+            obs.inject_env(env)
+            return subprocess.run(
+                [sys.executable, "-m", "tsspark_tpu.alerts",
+                 "--data", dset, "--registry", registry.root,
+                 "--alerts-dir", log_dir,
+                 "--sink", f"jsonl:{sink_path}",
+                 "--z", str(z_fire), "--overdue-k", str(k_quiet),
+                 "--poll-once"],
+                env=env, stdout=sys.stderr, timeout=deadline_s,
+            )
+
+        def _stream(sink=None, breaker=None) -> AlertStream:
+            engine = PredictionEngine(registry,
+                                      cache=ForecastCache(0))
+            return AlertStream(
+                log_dir, dset, engine,
+                sink if sink is not None else JsonlSink(sink_path),
+                horizon=1, z=z_fire, overdue_k=k_quiet,
+                breaker=breaker,
+            )
+
+        def _rec_bytes(seq: int) -> Optional[bytes]:
+            p = os.path.join(log_dir, f"alertrec_{seq:06d}.json")
+            if not os.path.exists(p):
+                return None
+            with open(p, "rb") as fh:
+                return fh.read()
+
+        # ---- class 1a: scorer killed MID-PUBLISH --------------------
+        _land()
+        _land()
+        inj_pub = next(i for i in storm.injections
+                       if i.cls == "alert-scorer-kill"
+                       and i.point == "alert_publish")
+        child1 = _scorer_child("alert_publish", inj_pub.after,
+                               inj_pub.rc)
+        t_fault = time.time()
+        obs.event("fault", tag="alert-scorer-kill", mode="direct",
+                  point="alert_publish", rc=child1.returncode)
+        probe = _stream()
+        orphans = {
+            seq: _rec_bytes(seq)
+            for seq in range(1, plane.delta_seq(dset) + 1)
+            if probe.record_ok(seq) is None
+            and _rec_bytes(seq) is not None
+        }
+        res1 = probe.poll_once()
+        mttr["alert-scorer-kill"] = time.time() - t_fault
+        obs.event("recovered", tag="alert-scorer-kill")
+        rescore_bitwise = all(_rec_bytes(s) == b
+                              for s, b in orphans.items())
+        pub_kill = {
+            "child_rc": child1.returncode,
+            "kill_after_sites": inj_pub.after,
+            "orphan_records": sorted(orphans),
+            "rescore_bitwise": rescore_bitwise,
+            "scored": probe.scored_seq(),
+            "watermark": probe.delivered_seq(),
+        }
+
+        # ---- class 1b: scorer killed MID-DELIVERY -------------------
+        _land()
+        inj_del = next(i for i in storm.injections
+                       if i.cls == "alert-scorer-kill"
+                       and i.point == "alert_deliver")
+        child2 = _scorer_child("alert_deliver", inj_del.after,
+                               inj_del.rc)
+        t_fault = time.time()
+        obs.event("fault", tag="alert-scorer-kill", mode="direct",
+                  point="alert_deliver", rc=child2.returncode)
+        s2 = _stream()
+        res2 = s2.poll_once()
+        mttr["alert-scorer-kill"] = max(mttr["alert-scorer-kill"],
+                                        time.time() - t_fault)
+        obs.event("recovered", tag="alert-scorer-kill")
+        del_kill = {
+            "child_rc": child2.returncode,
+            "kill_after_emits": inj_del.after,
+            "redelivered": res2["delivered"],
+            "deduped": res2["deduped"],
+            "watermark": s2.delivered_seq(),
+        }
+        inv_kill = {
+            "ok": (child1.returncode == inj_pub.rc
+                   and child2.returncode == inj_del.rc
+                   and rescore_bitwise
+                   and res2["deduped"] >= 1
+                   and s2.delivered_seq() == s2.scored_seq()),
+            **pub_kill,
+            "deliver": del_kill,
+        }
+        errs = []
+        if child1.returncode != inj_pub.rc or \
+                child2.returncode != inj_del.rc:
+            errs.append("a scorer child survived its armed exit fault")
+        if not rescore_bitwise:
+            errs.append("successor re-score diverged from the orphan "
+                        "record's bytes")
+        if res2["deduped"] < 1:
+            errs.append("redelivery after the mid-delivery kill "
+                        "deduped nothing — the pre-kill acks were "
+                        "lost or the kill landed before any emit")
+        if errs:
+            inv_kill["errors"] = errs
+
+        # ---- class 2: sink brownout ---------------------------------
+        inj_bro = storm.direct("alert-sink-brownout")
+        _land()
+        flaky = FlakySink(JsonlSink(sink_path),
+                          fail_n=inj_bro.attempts)
+        breaker = CircuitBreaker(failure_threshold=3,
+                                 reset_timeout_s=0.2,
+                                 name="alert-sink")
+        s3 = _stream(sink=flaky, breaker=breaker)
+        wm_before = s3.delivered_seq()
+        obs.event("fault", tag="alert-sink-brownout", mode="direct")
+        t_fault = time.time()
+        res3 = s3.poll_once()
+        opened = s3.breaker.snapshot()["state"] == "open"
+        held = s3.delivered_seq() == wm_before
+        flaky.fail_n = 0          # relief
+        time.sleep(0.25)          # past the breaker's reset window
+        res3b = s3.poll_once()
+        drained = (not res3b["stalled"]
+                   and s3.delivered_seq() == s3.scored_seq())
+        mttr["alert-sink-brownout"] = time.time() - t_fault
+        obs.event("recovered", tag="alert-sink-brownout")
+        inv_bro = {
+            "ok": (res3["stalled"] and opened and held and drained),
+            "fail_n": inj_bro.attempts,
+            "stalled": res3["stalled"],
+            "breaker_opened": opened,
+            "watermark_held": held,
+            "drained_after_relief": drained,
+            "sink_failures": flaky.failures,
+            "breaker": s3.breaker.snapshot(),
+        }
+        if not inv_bro["ok"]:
+            inv_bro["errors"] = [
+                "brownout did not stall/open/hold/drain as required"
+            ]
+
+        # ---- class 3: torn certified record -------------------------
+        tseq = s3.scored_seq()
+        orig = _rec_bytes(tseq)
+        obs.event("fault", tag="torn-alert-record", mode="direct",
+                  seq=tseq)
+        t_fault = time.time()
+        rp = os.path.join(log_dir, f"alertrec_{tseq:06d}.json")
+        # Tear through the blessed corruption injector (the one writer
+        # allowed to touch bytes non-atomically): a private
+        # corrupt-mode rule at alert_record, armed for exactly one
+        # call — same shape as the registry-corrupt class.
+        tear = faults.FaultPlan(
+            state_dir=os.path.join(base, "tear_faults")
+        )
+        tear.fail("alert_record", attempts=1, mode="corrupt",
+                  tag="torn-alert-record")
+        os.environ[faults.ENV_VAR] = tear.to_env()
+        try:
+            tore = faults.corrupt_file("alert_record", rp)
+        finally:
+            del os.environ[faults.ENV_VAR]
+        s4 = _stream()
+        crc_rejected = s4.record_ok(tseq) is None
+        res4 = s4.poll_once()
+        healed = s4.record_ok(tseq) is not None
+        torn_bitwise = _rec_bytes(tseq) == orig
+        mttr["torn-alert-record"] = time.time() - t_fault
+        obs.event("recovered", tag="torn-alert-record")
+        inv_torn = {
+            "ok": (tore and crc_rejected and healed and torn_bitwise
+                   and res4["deduped"] == 0),
+            "torn_seq": tseq,
+            "corruption_applied": tore,
+            "crc_rejected_tear": crc_rejected,
+            "rescored": healed,
+            "rescore_bitwise": torn_bitwise,
+            "spurious_redelivery": res4["delivered"],
+        }
+        if not inv_torn["ok"]:
+            inv_torn["errors"] = [
+                "torn record was accepted, re-scored differently, or "
+                "redelivered duplicates"
+            ]
+
+        # ---- the one observable truth: the sink ---------------------
+        fin = _stream()
+        expected: List[str] = []
+        for seq in range(1, fin.scored_seq() + 1):
+            rec = fin.record_ok(seq)
+            if rec is None:
+                expected.append(f"<uncertified:{seq}>")
+                continue
+            expected.extend(a["key"] for a in rec["alerts"])
+        inv_eo = inv.alerts_exactly_once(
+            expected, JsonlSink(sink_path).alerts(),
+            fin.delivered_seq(), fin.scored_seq(),
+        )
+
+        stage = {
+            "wall_s": round(time.time() - t0, 3),
+            "v1": v1,
+            "deltas": plane.delta_seq(dset),
+            "publish_kill": pub_kill,
+            "deliver_kill": del_kill,
+            "brownout_scored": res3["scored"],
+            "torn_seq": tseq,
+            "sink_alerts": inv_eo["delivered"],
+        }
+        return stage, {
+            "alerts_scorer_kill": inv_kill,
+            "alerts_sink_brownout": inv_bro,
+            "alerts_torn_record": inv_torn,
+            "alerts_exactly_once": inv_eo,
+        }
+    finally:
+        if env_plan is not None:
+            os.environ[faults.ENV_VAR] = env_plan
+
+
 def run_storm(seed: int = 0, profile: str = "full",
               scratch: Optional[str] = None,
               keep_scratch: bool = False,
@@ -2176,6 +2469,14 @@ def run_storm(seed: int = 0, profile: str = "full",
                 )
             invariants.update(qp_inv)
 
+        # ---- stage M: exactly-once alert stream ----------------------
+        if prof.alerts_storm:
+            with obs.span("stage.alerts"):
+                stages["alerts"], al_inv = _run_alerts_storm(
+                    scratch, storm, got_state, mttr, deadline_s
+                )
+            invariants.update(al_inv)
+
         # ---- cross-stage invariants ----------------------------------
         if out_dir is not None:
             corrupt_injected = sum(
@@ -2317,6 +2618,7 @@ def run_storm(seed: int = 0, profile: str = "full",
                 "storage_storm": prof.storage_storm,
                 "fplane_storm": prof.fplane_storm,
                 "qplane_storm": prof.qplane_storm,
+                "alerts_storm": prof.alerts_storm,
             },
             "schedule": storm.schedule(),
             "fault_classes": sorted(storm.by_class()),

@@ -223,6 +223,46 @@ def plane_consistent(spec, root: str) -> Dict:
             "shards": len(plane.shard_ranges(spec)), "errors": errs}
 
 
+def alerts_exactly_once(expected_keys: List[str],
+                        sink_alerts: List[Dict],
+                        watermark: int, scored: int) -> Dict:
+    """The alert stream's end state after the storm: every alert key
+    the certified records expect appears in the sink EXACTLY once — no
+    duplicate (a redelivery that slipped the dedup), no gap (a record
+    the watermark skipped past unacked) — and the delivery watermark
+    sits at the scored head (nothing certified is still undelivered).
+    Kill-point placement, brownouts, and torn records all have to
+    collapse into this one observable sink truth."""
+    errs: List[str] = []
+    delivered: Dict[str, int] = {}
+    for a in sink_alerts:
+        k = a.get("key")
+        if k is not None:
+            delivered[k] = delivered.get(k, 0) + 1
+    dupes = sorted(k for k, n in delivered.items() if n > 1)
+    expected = set(expected_keys)
+    missing = sorted(expected - set(delivered))
+    if dupes:
+        errs.append(f"{len(dupes)} alert key(s) delivered more than "
+                    f"once: {dupes[:4]}")
+    if missing:
+        errs.append(f"{len(missing)} expected alert key(s) never "
+                    f"reached the sink: {missing[:4]}")
+    if watermark != scored:
+        errs.append(f"delivery watermark {watermark} is behind the "
+                    f"scored head {scored}")
+    return {
+        "ok": not errs,
+        "expected": len(expected),
+        "delivered": len(delivered),
+        "duplicates": len(dupes),
+        "missing": len(missing),
+        "watermark": int(watermark),
+        "scored": int(scored),
+        "errors": errs,
+    }
+
+
 def refit_unchanged_bitwise(base_vdir: str, new_vdir: str,
                             changed_rows) -> Dict:
     """Delta-publish parity: every per-series column of the NEW

@@ -127,6 +127,25 @@ domain"):
                       version-producing writes must be refused by the
                       budget gate while the active version KEEPS
                       serving, and relief must resume ingestion
+
+Alert-stream fault-domain classes (the exactly-once anomaly alert
+pipeline ``tsspark_tpu.alerts``; profiles with ``alerts_storm``):
+
+  alert-scorer-kill   the scorer child (``python -m tsspark_tpu.alerts
+                      --poll-once``) dies at the ``alert_publish``
+                      point — between the record write and its CRC
+                      sentinel, then again at ``alert_deliver`` mid
+                      sink emit: the successor must re-score the
+                      uncertified delta BITWISE and redeliver past the
+                      watermark with zero duplicate keys
+  alert-sink-brownout the delivery sink raises for a window: the
+                      breaker opens, the watermark HOLDS (never
+                      advances past an unacked record), and recovery
+                      drains everything exactly once
+  torn-alert-record   a certified alert record's bytes are flipped
+                      under its sentinel: the CRC check must reject
+                      it, the re-score converge bitwise, and delivery
+                      dedup suppress every duplicate
 """
 
 from __future__ import annotations
@@ -213,6 +232,11 @@ class StormProfile:
     # never did) — interval reads must shed to the compute path with
     # bitwise-identical answers and a retry must verify clean.
     qplane_storm: bool = False
+    # Alert-stream fault domain (tsspark_tpu.alerts): the scorer child
+    # killed mid-publish and mid-delivery, a sink brownout opening the
+    # breaker, and a torn certified record — all judged by the
+    # alerts_exactly_once invariant (every key delivered exactly once).
+    alerts_storm: bool = False
 
 
 PROFILES: Dict[str, StormProfile] = {
@@ -247,6 +271,18 @@ PROFILES: Dict[str, StormProfile] = {
         recovery_budget_s=60.0, run_orchestrate=False,
         run_streaming=False, storage_storm=True,
     ),
+    # Alert-stream fault-domain smoke for tier-1 (<30 s budget): one
+    # in-process fit feeds a private registry + plane, then the three
+    # alert classes run against a live AlertStream — scorer child kills
+    # at both fault points, a sink brownout, and a torn record — with
+    # the alerts_exactly_once invariant judging the sink's final state.
+    "alerts": StormProfile(
+        name="alerts", series=12, days=48, chunk=8, max_iters=15,
+        phase1_iters=0, stream_series=0, stream_batches=0,
+        loadgen_requests=0, serve_queue=16, probe_accelerator=False,
+        recovery_budget_s=60.0, run_orchestrate=False,
+        run_streaming=False, alerts_storm=True,
+    ),
     # The acceptance storm (python -m tsspark_tpu.chaos --seed 0):
     # two-phase orchestrate, probe loop included, longer loadgen, the
     # replica pool under kill/split-brain/front-crash, the data plane
@@ -261,7 +297,7 @@ PROFILES: Dict[str, StormProfile] = {
         resident_series=32, resident_chunk=8,
         refit_series=32, refit_chunk=8, refit_churn=0.25,
         sched_storm=True, storage_storm=True, fplane_storm=True,
-        qplane_storm=True,
+        qplane_storm=True, alerts_storm=True,
     ),
 }
 
@@ -520,6 +556,37 @@ def compose(seed: int, profile: str = "full") -> StormPlan:
             point="qplane_publish", mode="direct",
             after=rng.randrange(1, 9),
             rc=rng.choice((17, 23, 29)),
+        ))
+
+    # -- alert-stream stage (the harness arms the scorer child's
+    # -- PRIVATE plan: ``after`` picks which alert_publish injection
+    # -- site the first kill lands on — 0 tears before the record,
+    # -- 1 between record and sentinel, 2 after the sentinel — and
+    # -- which sink emit the delivery kill lands on; ``series`` seeds
+    # -- the torn-record byte pick, ``attempts`` the brownout
+    # -- window) ------------------------------------------------------
+    if prof.alerts_storm:
+        inj.append(Injection(
+            cls="alert-scorer-kill", stage="alerts",
+            point="alert_publish", mode="direct",
+            after=rng.randrange(0, 3), rc=rng.choice((17, 23, 29)),
+        ))
+        inj.append(Injection(
+            cls="alert-scorer-kill", stage="alerts",
+            point="alert_deliver", mode="direct",
+            # after>=1: at least one alert reaches the sink before the
+            # kill, so the successor's redelivery MUST dedup.
+            after=rng.randrange(1, 4), rc=rng.choice((17, 23, 29)),
+        ))
+        inj.append(Injection(
+            cls="alert-sink-brownout", stage="alerts",
+            point="alert_deliver", mode="direct",
+            attempts=rng.randrange(4, 9),
+        ))
+        inj.append(Injection(
+            cls="torn-alert-record", stage="alerts",
+            point="alert_record", mode="direct",
+            series=rng.randrange(1 << 16),
         ))
 
     # -- data-plane stage ---------------------------------------------

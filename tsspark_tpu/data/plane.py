@@ -688,6 +688,14 @@ def _load_patch(dset_dir: str, seq: int) -> Optional[Dict]:
         z.close()
 
 
+def delta_patch(dset_dir: str, seq: int) -> Optional[Dict]:
+    """Public read of ONE visible delta's full patch payload
+    (CRC-verified ``{"rows", "window", "y", "mask"}``), or None when
+    absent/corrupt — the anomaly scorer's feed (``tsspark_tpu.alerts``),
+    which needs the landed values themselves, not just the row set."""
+    return _load_patch(dset_dir, int(seq))
+
+
 def delta_rows(dset_dir: str, seq: int) -> Optional[np.ndarray]:
     """The changed-row set of ONE visible delta (the arrival-model feed
     for the always-on scheduler's speculation), or None when the patch

@@ -377,6 +377,35 @@ def _freshness_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def _alerts_row(rep: Dict[str, Any]) -> Dict[str, Any]:
+    """Alert-stream rows (bench --alerts; tsspark_tpu.alerts).  The
+    workload key carries the rung, churn, AND scoring mode: interval
+    runs (quantile plane published) must never baseline zscore
+    fallback runs — their latency profiles differ by the qplane read
+    path itself."""
+    m: Dict[str, float] = {}
+    for k in ("alerts_p50_s", "alerts_p95_s", "alerts_mean_s",
+              "delivered_frac", "fired", "suppressed", "delivered",
+              "deduped", "queued", "breaker_opens", "cold_wall_s",
+              "complete", "wall_s"):
+        _put(m, k, rep.get(k))
+    churn = rep.get("churn")
+    churn_key = (f"c{int(round(float(churn) * 1000)):04d}"
+                 if isinstance(churn, (int, float)) else "c?")
+    return {
+        "kind": "alerts",
+        "trace_id": rep.get("trace_id"),
+        "unix": rep.get("unix"),
+        "workload": (f"alerts_{rep.get('rung')}_{churn_key}"
+                     f"+{rep.get('mode')}"),
+        "device": rep.get("device"),
+        "numerics_rev": rep.get("numerics_rev"),
+        "config_fingerprint": rep.get("config_fingerprint"),
+        "git_rev": rep.get("git_rev"),
+        "metrics": m,
+    }
+
+
 def _analysis_row(rep: Dict[str, Any]) -> Dict[str, Any]:
     """Static-analysis gate rows (python -m tsspark_tpu.analysis;
     analysis/report.py).  The gate's drift metrics — waiver creep,
@@ -491,6 +520,8 @@ def classify(rep: Dict[str, Any]) -> Optional[str]:
         return "scale"
     if kind == "freshness-bench":
         return "freshness"
+    if kind == "alerts-bench":
+        return "alerts"
     if kind == "analysis-gate":
         return "analysis"
     if kind == "chaos-storm":
@@ -515,6 +546,7 @@ _ROW_BUILDERS = {
     "calibration": _calibration_row,
     "scale": _scale_row,
     "freshness": _freshness_row,
+    "alerts": _alerts_row,
     "analysis": _analysis_row,
     "chaos": _chaos_row,
     "eval": _eval_row,
@@ -682,6 +714,9 @@ _TRAJECTORY_COLUMNS = {
     "freshness": ("freshness_p50_s", "freshness_p95_s",
                   "freshness_vs_cold_frac", "cycle_overhead_frac",
                   "spec_hit_rate", "wrong_version", "complete"),
+    "alerts": ("alerts_p50_s", "alerts_p95_s", "delivered_frac",
+               "fired", "suppressed", "deduped", "breaker_opens",
+               "complete"),
     "analysis": ("ok", "findings", "suppressed", "waivers_inline",
                  "waivers_baseline", "wall_s"),
     "chaos": ("ok", "invariant_fails"),
@@ -723,7 +758,8 @@ def trajectory(rows: Sequence[Dict[str, Any]]) -> List[str]:
     in ingest order (the roadmap's 'bench trajectory' block)."""
     lines: List[str] = []
     for kind in ("bench", "eval", "serve", "serveplane", "calibration",
-                 "scale", "freshness", "analysis", "chaos", "ledger"):
+                 "scale", "freshness", "alerts", "analysis", "chaos",
+                 "ledger"):
         group = [r for r in rows if r.get("kind") == kind]
         if not group:
             continue

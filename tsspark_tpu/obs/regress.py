@@ -140,6 +140,13 @@ DEFAULT_SLO: Dict[str, Any] = {
             "spec_hit_rate": {"direction": "higher",
                               "max_drop_abs": 0.5},
         },
+        "alerts": {
+            "alerts_p95_s": {"direction": "lower",
+                             "max_rise_frac": 1.0,
+                             "slack_abs": 2.0},
+            "delivered_frac": {"direction": "higher",
+                               "max_drop_abs": 0.25},
+        },
         "chaos": {
             "ok": {"direction": "higher", "max_drop_abs": 0.5},
             "mttr_*": {"direction": "lower", "max_rise_frac": 1.0,

@@ -204,6 +204,29 @@ def observe_run(scratch: str,
                 float(_np.percentile(_np.asarray(recent_fr), 95)), 4
             )
 
+    # Live land->alert freshness off the alert stream's
+    # alerts.freshness spans (t0 = the delta's land time, dur = land ->
+    # sink ack of its last alert), plus the stream's own counters from
+    # the metric snapshot — the alerts row of the dashboard.
+    al = [(ledger._span_end(s), s.get("dur_s")) for s in spans
+          if s.get("name") == "alerts.freshness"
+          and ledger._span_end(s) is not None
+          and isinstance(s.get("dur_s"), (int, float))]
+    alerts_p95_s = None
+    if al:
+        t_last = max(e for e, _d in al)
+        recent_al = [d for e, d in al if e >= t_last - RATE_WINDOW_S]
+        if recent_al:
+            import numpy as _np
+
+            alerts_p95_s = round(
+                float(_np.percentile(_np.asarray(recent_al), 95)), 4
+            )
+    alerts_fired = flat.get("tsspark_alerts_fired_total")
+    alerts_suppressed = flat.get("tsspark_alerts_suppressed_total")
+    alerts_queued = flat.get("tsspark_alerts_queued")
+    alerts_breaker = flat.get("tsspark_alerts_breaker_open")
+
     # The live row(s), judged by the same sentinel machinery the
     # post-run gate uses — one pseudo-row per family so bench budgets
     # gate throughput and serve budgets gate the read path.
@@ -230,6 +253,12 @@ def observe_run(scratch: str,
             "device_class": dev_class,
             "metrics": {"freshness_p95_s": freshness_p95_s},
         })
+    if alerts_p95_s is not None:
+        live_rows.append({
+            "kind": "alerts", "row_id": "live:alerts",
+            "device_class": dev_class,
+            "metrics": {"alerts_p95_s": alerts_p95_s},
+        })
     verdicts = []
     for live in live_rows:
         v = regress.evaluate(live, history_rows, slo=slo)
@@ -251,6 +280,13 @@ def observe_run(scratch: str,
         "p99_ms": p99_ms,
         "carried": carried,
         "freshness_p95_s": freshness_p95_s,
+        "alerts_p95_s": alerts_p95_s,
+        "alerts_fired": alerts_fired,
+        "alerts_suppressed": alerts_suppressed,
+        "alerts_queued": alerts_queued,
+        "alerts_breaker": (None if alerts_breaker is None
+                           else ("open" if alerts_breaker >= 1.0
+                                 else "closed")),
         "breaches": breaches,
         "verdicts": verdicts,
     }
@@ -302,6 +338,14 @@ def format_line(st: Dict[str, Any]) -> str:
         bits.append(f"carried={int(st['carried'])}")
     if st.get("freshness_p95_s") is not None:
         bits.append(f"fresh_p95={st['freshness_p95_s']}s")
+    if st.get("alerts_p95_s") is not None:
+        bits.append(f"alert_p95={st['alerts_p95_s']}s")
+    if st.get("alerts_fired") is not None:
+        bits.append(f"alerts={int(st['alerts_fired'])}"
+                    f"/{int(st.get('alerts_suppressed') or 0)}supp"
+                    f"/{int(st.get('alerts_queued') or 0)}q")
+    if st.get("alerts_breaker") is not None:
+        bits.append(f"alert_sink={st['alerts_breaker']}")
     if st["breaches"]:
         worst = ", ".join(
             f"{c['metric']}={c['value']} vs bound {c['bound']}"

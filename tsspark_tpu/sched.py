@@ -88,6 +88,11 @@ SCHED_STATE_FILE = "sched_state.json"
 #: Bounded freshness sample window (the daemon runs indefinitely).
 FRESHNESS_WINDOW = 4096
 
+#: A series is data-overdue when no delta arrived for more than this
+#: multiple of its EWMA inter-arrival (the ``tsspark_sched_overdue_series``
+#: gauge and the alert stream's data-liveness kind share this default).
+OVERDUE_K = 3.0
+
 
 class ArrivalModel:
     """Per-series inter-arrival EWMA off the landed patch stream.
@@ -153,6 +158,22 @@ class ArrivalModel:
         due = last + dts
         order = np.argsort(due, kind="stable")
         return np.sort(rows[order[: int(cap)]])
+
+    def overdue_rows(self, now: float, k: float = 3.0) -> Dict[int, float]:
+        """Rows whose learned cadence says a delta is OVERDUE: no
+        arrival for more than ``k``x the series' EWMA inter-arrival.
+        Returns ``{row: seconds overdue beyond the threshold}`` — the
+        data-liveness complement to value anomalies (a series that
+        stops arriving pages just like one that breaches its interval).
+        Like :meth:`predicted_rows`, only rows with a LEARNED cadence
+        qualify; a one-shot row has no baseline to be overdue against."""
+        out: Dict[int, float] = {}
+        now = float(now)
+        for r, dt in self._ewma.items():
+            gap = now - self._last[r] - float(k) * dt
+            if gap > 0.0:
+                out[int(r)] = gap
+        return out
 
     def tracked(self) -> int:
         return len(self._last)
@@ -261,6 +282,7 @@ class RefitScheduler:
         self.resumed_cycles = 0
         self.failures = 0
         self.probe_failures = 0
+        self.probe_errors = 0
         self.wrong_version = 0
         self.spec_predicted = 0
         self.spec_hits = 0
@@ -302,6 +324,11 @@ class RefitScheduler:
         self._m_spec_hit = METRICS.counter(
             "tsspark_sched_spec_hits_total"
         )
+        self._m_overdue = METRICS.gauge("tsspark_sched_overdue_series")
+        self._m_spec_fail = METRICS.counter(
+            "tsspark_sched_spec_attach_failures_total"
+        )
+        self._last_overdue_probe = 0.0
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -360,6 +387,7 @@ class RefitScheduler:
             "spec": self.spec_summary(),
             "wrong_version": self.wrong_version,
             "probe_failures": self.probe_failures,
+            "probe_errors": self.probe_errors,
             "pipeline": self.pipeline,
             "disk_ladder": current_state(self.scratch),
             "ok": self._fail_streak == 0,
@@ -576,6 +604,16 @@ class RefitScheduler:
             self._last_reprobe = time.monotonic()
             self._after_publish(int(self._head_version),
                                 int(self._head_stamp or 0))
+        if time.monotonic() - self._last_overdue_probe >= 1.0:
+            # Data-liveness: series overdue by >k× their EWMA
+            # inter-arrival (the alert stream reads the same model for
+            # its data-liveness alert kind; the gauge is the fleet-wide
+            # at-a-glance view).
+            self._last_overdue_probe = time.monotonic()
+            self._m_overdue.set(
+                float(len(self.model.overdue_rows(time.time(),
+                                                  k=OVERDUE_K)))
+            )
         lad = active_ladder(self.scratch)
         if lad is not None and lad.should_reap():
             # Ladder rung 2 (reap): shrinking headroom — drop retained
@@ -622,8 +660,14 @@ class RefitScheduler:
             view = snapplane.attach(
                 self.registry.version_dir(int(head)), verify=False
             )
-        except Exception:
-            return  # no plane to pre-gather from: speculation is moot
+        except (snapplane.SnapshotPlaneError, StorageError,
+                OSError, ValueError):
+            # No attachable plane (absent version dir, torn/partial
+            # snapshot, classified disk fault): speculation is moot,
+            # but count it — a version that NEVER attaches is a publish
+            # bug this counter surfaces.
+            self._m_spec_fail.inc()
+            return
         t0 = time.time()
         theta = refit.warm_theta_gather(view.state.theta, rows)
         self._spec = {
@@ -783,8 +827,16 @@ class RefitScheduler:
             while time.monotonic() < deadline:
                 try:
                     served = self.freshness_probe(int(version))
-                except Exception:
+                except Exception:  # broad by design: the probe
+                    # invokes caller-supplied serve-side code (engine
+                    # forecast, HTTP shim, test stubs) whose failure
+                    # surface is unbounded; ANY probe failure means
+                    # only "not confirmed yet" and is retried until the
+                    # deadline, where the counted probe_failures path
+                    # records the episode; probe_errors counts the raw
+                    # raising attempts.
                     served = None
+                    self.probe_errors += 1
                 if served is not None:
                     # A served version going BACKWARDS (below one
                     # already confirmed) is the wrong-version signal
